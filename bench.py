@@ -1,0 +1,103 @@
+#!/usr/bin/env python
+"""Benchmark harness: prints ONE JSON line with the headline metric.
+
+Headline (BASELINE.md): ResNet-50 ImageNet-shape data-parallel training
+throughput, img/s/chip, target >=70% of A100 NCCL-DDP per-chip throughput.
+A100 DDP ResNet-50 (mixed precision, per-chip) is ~2500 img/s; vs_baseline
+is measured against 0.7 * 2500 = 1750 img/s/chip.
+
+Runs on however many chips are visible (the driver provides one real TPU
+chip); DP sharding is exercised whenever device_count > 1.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+A100_DDP_RESNET50_IMG_S = 2500.0  # per-chip, AMP, the BASELINE §3 yardstick
+TARGET_FRACTION = 0.70
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    import distributeddataparallel_tpu as ddp
+    from distributeddataparallel_tpu.data.loader import shard_batch
+    from distributeddataparallel_tpu.models.resnet import ResNet50
+    from distributeddataparallel_tpu.ops import cross_entropy_loss
+
+    mesh = ddp.make_mesh(("data",))
+    n_dev = len(jax.devices())
+
+    model = ResNet50(num_classes=1000, dtype=jnp.bfloat16)
+    image_shape = (224, 224, 3)
+    num_classes = 1000
+    per_chip_batch = 128
+    name = "resnet50_imagenet_dp"
+
+    rng = jax.random.PRNGKey(0)
+    sample = jnp.zeros((1,) + image_shape, jnp.float32)
+    variables = model.init(rng, sample)
+    params = variables["params"]
+    model_state = {k: v for k, v in variables.items() if k != "params"}
+
+    def loss_fn(params, ms, batch, rng):
+        logits, new_vars = model.apply(
+            {"params": params, **ms}, batch["image"], train=True,
+            mutable=list(ms.keys()),
+        )
+        return cross_entropy_loss(logits, batch["label"]), ({}, new_vars)
+
+    state = ddp.TrainState.create(
+        apply_fn=model.apply,
+        params=params,
+        tx=optax.sgd(0.1, momentum=0.9),
+        model_state=model_state,
+    )
+    state = ddp.broadcast_params(state, mesh)
+    step = ddp.make_train_step(loss_fn, mesh=mesh, with_model_state=True)
+
+    B = per_chip_batch * n_dev
+    npr = np.random.default_rng(0)
+    batch = {
+        "image": npr.normal(size=(B,) + image_shape).astype(np.float32),
+        "label": npr.integers(0, num_classes, size=(B,)).astype(np.int32),
+    }
+    batch = shard_batch(batch, mesh)
+    key = jax.random.PRNGKey(1)
+
+    # compile + warmup
+    state, _ = step(state, batch, key)
+    jax.block_until_ready(state.params)
+    for _ in range(3):
+        state, _ = step(state, batch, key)
+    jax.block_until_ready(state.params)
+
+    iters = 20
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, metrics = step(state, batch, key)
+    jax.block_until_ready(state.params)
+    dt = time.perf_counter() - t0
+
+    img_s = iters * B / dt
+    img_s_chip = img_s / n_dev
+    target = TARGET_FRACTION * A100_DDP_RESNET50_IMG_S
+    print(
+        json.dumps(
+            {
+                "metric": f"img/s/chip ({name})",
+                "value": round(img_s_chip, 2),
+                "unit": "img/s/chip",
+                "vs_baseline": round(img_s_chip / target, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,6 @@
+from distributeddataparallel_tpu.data.datasets import (  # noqa: F401
+    ArrayDataset,
+    SyntheticClassification,
+    load_cifar10,
+)
+from distributeddataparallel_tpu.data.loader import DataLoader, shard_batch  # noqa: F401

@@ -1,0 +1,156 @@
+"""Datasets: host-side NumPy storage with a torch-free CIFAR-10 reader.
+
+The reference downloads CIFAR-10 on every rank concurrently with
+``datasets.CIFAR10(root="data", train=True, download=True, ...)`` — a
+filesystem race (ref dpp.py:33, SURVEY.md §2d.2).  This environment has no
+network egress, so the build reads a pre-staged copy of the standard
+python-pickle CIFAR batches if present, acquires a per-host file lock if it
+ever needs to materialize anything, and otherwise falls back to a clearly
+labeled synthetic set so every config stays runnable.
+
+Transforms: the reference composes ToTensor + Normalize(0.5, 0.5)
+(ref dpp.py:32) — i.e. uint8/255 then (x-0.5)/0.5 → values in [-1, 1].
+``normalize_images`` reproduces exactly that.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import tarfile
+from typing import Iterator
+
+import numpy as np
+
+
+class ArrayDataset:
+    """In-memory dataset of (images, labels) NumPy arrays."""
+
+    def __init__(self, images: np.ndarray, labels: np.ndarray):
+        if len(images) != len(labels):
+            raise ValueError("images/labels length mismatch")
+        self.images = images
+        self.labels = labels
+
+    def __len__(self) -> int:
+        return len(self.images)
+
+    def __getitem__(self, idx):
+        return self.images[idx], self.labels[idx]
+
+
+def normalize_images(images_u8: np.ndarray) -> np.ndarray:
+    """uint8 HWC → float32 in [-1, 1]: ToTensor + Normalize((0.5,), (0.5,))
+    from ref dpp.py:32, broadcast over channels exactly as torch does."""
+    return (images_u8.astype(np.float32) / 255.0 - 0.5) / 0.5
+
+
+class SyntheticClassification(ArrayDataset):
+    """Deterministic fake classification data (BASELINE config 1's "random
+    tensors"), with class-conditional means so loss can actually decrease."""
+
+    def __init__(
+        self,
+        num_examples: int = 2048,
+        shape: tuple[int, ...] = (32, 32, 3),
+        num_classes: int = 10,
+        seed: int = 0,
+        proto_seed: int = 0,
+    ):
+        rng = np.random.default_rng(seed)
+        labels = rng.integers(0, num_classes, size=(num_examples,), dtype=np.int32)
+        # Class-dependent signal + noise: learnable but nontrivial.  The
+        # class prototypes come from `proto_seed` (NOT `seed`) so train and
+        # eval splits built with different example seeds still share the
+        # same underlying classification task.
+        proto_rng = np.random.default_rng(proto_seed)
+        protos = proto_rng.normal(size=(num_classes,) + shape).astype(np.float32)
+        images = protos[labels] + 0.5 * rng.normal(size=(num_examples,) + shape).astype(
+            np.float32
+        )
+        super().__init__(images.astype(np.float32), labels)
+        self.num_classes = num_classes
+
+
+def _cifar_batch_files(root: str) -> list[str] | None:
+    """Locate the standard cifar-10-batches-py payload under root, direct or
+    inside the usual tar.gz."""
+    d = os.path.join(root, "cifar-10-batches-py")
+    names = [f"data_batch_{i}" for i in range(1, 6)]
+    if all(os.path.exists(os.path.join(d, n)) for n in names):
+        return [os.path.join(d, n) for n in names]
+    tgz = os.path.join(root, "cifar-10-python.tar.gz")
+    if os.path.exists(tgz):
+        # Extract once per host under a lock (fixes the ref's §2d.2 race).
+        lock = tgz + ".lock"
+        fd = None
+        try:
+            while True:
+                try:
+                    fd = os.open(lock, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+                    break
+                except FileExistsError:
+                    import time
+
+                    time.sleep(0.1)
+                    if all(os.path.exists(os.path.join(d, n)) for n in names):
+                        return [os.path.join(d, n) for n in names]
+            if not all(os.path.exists(os.path.join(d, n)) for n in names):
+                with tarfile.open(tgz) as tf:
+                    tf.extractall(root)
+        finally:
+            if fd is not None:
+                os.close(fd)
+                os.unlink(lock)
+        if all(os.path.exists(os.path.join(d, n)) for n in names):
+            return [os.path.join(d, n) for n in names]
+    return None
+
+
+def load_cifar10(
+    root: str = "data",
+    train: bool = True,
+    *,
+    normalize: bool = True,
+    synthetic_fallback: bool = True,
+) -> ArrayDataset:
+    """CIFAR-10 as NHWC float32, matching the reference's transform output.
+
+    Reads the standard python-pickle batches (pre-staged; no network).
+    With ``synthetic_fallback`` (default), a missing payload yields a
+    synthetic 32×32×3/10-class stand-in of the same shape so smoke runs
+    work anywhere; the fallback is logged loudly.
+    """
+    files = _cifar_batch_files(root)
+    if files is None:
+        if not synthetic_fallback:
+            raise FileNotFoundError(
+                f"CIFAR-10 not found under {root!r}; pre-stage "
+                "cifar-10-batches-py or cifar-10-python.tar.gz (no egress here)"
+            )
+        from distributeddataparallel_tpu.utils.logging import log0
+
+        log0(
+            "CIFAR-10 payload not found under %r — using synthetic stand-in "
+            "(50000 fake 32x32x3 examples). Pre-stage the real batches for "
+            "meaningful accuracy.",
+            root,
+        )
+        n = 50000 if train else 10000
+        return SyntheticClassification(n, (32, 32, 3), 10, seed=0 if train else 1)
+
+    if not train:
+        files = [os.path.join(os.path.dirname(files[0]), "test_batch")]
+    imgs, labels = [], []
+    for f in files:
+        with open(f, "rb") as fh:
+            d = pickle.load(fh, encoding="bytes")
+        # stored as (N, 3072) uint8, CHW planes
+        x = d[b"data"].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+        imgs.append(x)
+        labels.append(np.asarray(d[b"labels"], dtype=np.int32))
+    images = np.concatenate(imgs)
+    labels = np.concatenate(labels)
+    if normalize:
+        images = normalize_images(images)
+    return ArrayDataset(images, labels)

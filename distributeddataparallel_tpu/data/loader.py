@@ -1,0 +1,144 @@
+"""Batching + device feed: the ``DataLoader`` analog for a sharded world.
+
+The reference builds ``DataLoader(dataset, batch_size=32, sampler=sampler)``
+per process (ref dpp.py:35): each rank iterates its sampler shard, 32 rows
+at a time, and H2D-copies every batch (ref dpp.py:48).  Global batch is
+therefore ``32 × world_size``.
+
+Here one host feeds *all* of its local replicas: the loader walks the
+per-replica index shards from ``parallel.sampler``, materializes a host
+batch of ``per_replica_batch × local_replicas`` rows (ordered so row-blocks
+line up with mesh positions), and ``shard_batch`` places it along the
+``data`` mesh axis — single sharded device_put on one host,
+``make_array_from_process_local_data`` across hosts.  A one-batch prefetch
+overlaps host gather with device compute (the role of DataLoader workers).
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Any, Iterator
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distributeddataparallel_tpu.parallel.sampler import DistributedSampler
+
+Pytree = Any
+
+
+def shard_batch(batch: Pytree, mesh: Mesh, axis_name: str = "data") -> Pytree:
+    """Place a host batch on the mesh, sharded along the data axis.
+
+    The analog of ``data.to(rank)`` (ref dpp.py:48), except one call covers
+    every local device and, multi-host, assembles the global array from
+    process-local rows.
+    """
+    sharding = NamedSharding(mesh, P(axis_name))
+    if jax.process_count() > 1:
+        return jax.tree.map(
+            lambda x: jax.make_array_from_process_local_data(
+                sharding, np.asarray(x)
+            ),
+            batch,
+        )
+    return jax.device_put(batch, sharding)
+
+
+class DataLoader:
+    """Iterates (images, labels) batches for this host's replicas.
+
+    Per epoch: for each step, takes ``per_replica_batch`` indices from each
+    local replica's sampler shard and concatenates them replica-major, so
+    when ``shard_batch`` splits the leading dim across the data axis each
+    mesh position receives exactly the rows its DDP-rank counterpart would
+    have (ref dpp.py:34-35 semantics, lifted to 1-process-per-host).
+
+    ``drop_last`` defaults to True for training (static shapes for jit —
+    a ragged final batch would trigger recompilation; the reference's
+    default keeps the ragged batch, torch has no compile cost).
+    """
+
+    def __init__(
+        self,
+        dataset,
+        *,
+        per_replica_batch: int,
+        mesh: Mesh,
+        axis_name: str = "data",
+        shuffle: bool = True,
+        seed: int = 0,
+        drop_last: bool = True,
+        device_feed: bool = True,
+        prefetch: int = 1,
+    ):
+        self.dataset = dataset
+        self.per_replica_batch = per_replica_batch
+        self.mesh = mesh
+        self.axis_name = axis_name
+        self.num_replicas = mesh.shape[axis_name]
+        self.local_replicas = max(
+            1, self.num_replicas // jax.process_count()
+        )
+        self.host_id = jax.process_index()
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self.device_feed = device_feed
+        self.prefetch = prefetch
+        self._epoch = 0
+
+        self._samplers = [
+            DistributedSampler(
+                len(dataset),
+                num_replicas=self.num_replicas,
+                rank=self.host_id * self.local_replicas + r,
+                shuffle=shuffle,
+                seed=seed,
+                drop_last=False,
+            )
+            for r in range(self.local_replicas)
+        ]
+        per_replica_samples = self._samplers[0].num_samples
+        if drop_last:
+            self.steps_per_epoch = per_replica_samples // per_replica_batch
+        else:
+            self.steps_per_epoch = -(-per_replica_samples // per_replica_batch)
+
+    def set_epoch(self, epoch: int) -> None:
+        """Reshuffle for a new epoch (analog of ref dpp.py:46)."""
+        self._epoch = epoch
+        for s in self._samplers:
+            s.set_epoch(epoch)
+
+    def __len__(self) -> int:
+        return self.steps_per_epoch
+
+    def _host_batches(self) -> Iterator[Pytree]:
+        shards = [s.local_indices() for s in self._samplers]
+        B = self.per_replica_batch
+        for step in range(self.steps_per_epoch):
+            rows = []
+            for shard in shards:
+                idx = shard[step * B : (step + 1) * B]
+                rows.append(idx)
+            idx = np.concatenate(rows)
+            images = self.dataset.images[idx]
+            labels = self.dataset.labels[idx]
+            yield {"image": images, "label": labels}
+
+    def __iter__(self) -> Iterator[Pytree]:
+        it = self._host_batches()
+        if not self.device_feed:
+            yield from it
+            return
+        # Software pipeline: keep `prefetch` batches in flight on device so
+        # host gather overlaps device compute (DataLoader-workers analog).
+        queue: collections.deque = collections.deque()
+        for host_batch in it:
+            queue.append(shard_batch(host_batch, self.mesh, self.axis_name))
+            if len(queue) > self.prefetch:
+                yield queue.popleft()
+        while queue:
+            yield queue.popleft()

@@ -1,0 +1,8 @@
+from distributeddataparallel_tpu.models.simple_cnn import SimpleCNN, TinyMLP  # noqa: F401
+from distributeddataparallel_tpu.models.resnet import (  # noqa: F401
+    ResNet,
+    ResNet18,
+    ResNet34,
+    ResNet50,
+    ResNet101,
+)

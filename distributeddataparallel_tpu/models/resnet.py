@@ -1,0 +1,141 @@
+"""ResNet family, TPU-first (NHWC, bf16-capable, MXU-aligned widths).
+
+The reference's entire model layer is ``models.resnet18(pretrained=True)``
+with a 10-class head swap (ref dpp.py:14-15).  This is the TPU-native
+equivalent of that torchvision dependency: ResNet-18/34/50 in Flax with
+
+- NHWC layout (XLA's native conv layout on TPU);
+- a ``stem`` switch: ``"imagenet"`` = 7×7/2 conv + 3×3/2 maxpool (the
+  torchvision topology), ``"cifar"`` = 3×3/1 conv, no maxpool — fixing the
+  reference's geometry mismatch of feeding 32×32 CIFAR through the
+  ImageNet stem (SURVEY.md §2d.4);
+- BatchNorm with framework-managed running stats (see ``training.state``;
+  stats are averaged across data-parallel replicas each step — the SPMD
+  equivalent of DDP keeping replica buffers consistent);
+- ``dtype=bfloat16`` support for MXU throughput, params and BN math in
+  float32.
+
+Weight loading from torch-free checkpoints lives in ``models.io``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+ModuleDef = Callable
+
+
+class BasicBlock(nn.Module):
+    """Two 3×3 convs (ResNet-18/34)."""
+
+    filters: int
+    strides: tuple[int, int]
+    conv: ModuleDef
+    norm: ModuleDef
+    expansion: int = 1
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (3, 3), self.strides)(x)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters, (3, 3))(y)
+        y = self.norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = self.conv(
+                self.filters * self.expansion, (1, 1), self.strides,
+                name="conv_proj",
+            )(residual)
+            residual = self.norm(name="norm_proj")(residual)
+        return nn.relu(residual + y)
+
+
+class BottleneckBlock(nn.Module):
+    """1×1 → 3×3 → 1×1 (ResNet-50/101/152), v1.5: stride on the 3×3."""
+
+    filters: int
+    strides: tuple[int, int]
+    conv: ModuleDef
+    norm: ModuleDef
+    expansion: int = 4
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (1, 1))(x)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters, (3, 3), self.strides)(y)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters * self.expansion, (1, 1))(y)
+        y = self.norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = self.conv(
+                self.filters * self.expansion, (1, 1), self.strides,
+                name="conv_proj",
+            )(residual)
+            residual = self.norm(name="norm_proj")(residual)
+        return nn.relu(residual + y)
+
+
+class ResNet(nn.Module):
+    stage_sizes: Sequence[int]
+    block_cls: ModuleDef
+    num_classes: int = 1000
+    num_filters: int = 64
+    dtype: jnp.dtype = jnp.float32
+    stem: str = "imagenet"  # "imagenet" (7x7/2 + maxpool) | "cifar" (3x3/1)
+    bn_momentum: float = 0.9
+    bn_epsilon: float = 1e-5
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = partial(
+            nn.Conv, use_bias=False, dtype=self.dtype, padding="SAME"
+        )
+        norm = partial(
+            nn.BatchNorm,
+            use_running_average=not train,
+            momentum=self.bn_momentum,
+            epsilon=self.bn_epsilon,
+            dtype=self.dtype,
+        )
+        x = x.astype(self.dtype)
+        if self.stem == "imagenet":
+            x = conv(self.num_filters, (7, 7), (2, 2), name="conv_init")(x)
+            x = norm(name="bn_init")(x)
+            x = nn.relu(x)
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        elif self.stem == "cifar":
+            x = conv(self.num_filters, (3, 3), (1, 1), name="conv_init")(x)
+            x = norm(name="bn_init")(x)
+            x = nn.relu(x)
+        else:
+            raise ValueError(f"unknown stem {self.stem!r}")
+
+        for i, num_blocks in enumerate(self.stage_sizes):
+            for j in range(num_blocks):
+                strides = (2, 2) if i > 0 and j == 0 else (1, 1)
+                x = self.block_cls(
+                    filters=self.num_filters * 2**i,
+                    strides=strides,
+                    conv=conv,
+                    norm=norm,
+                )(x)
+        x = jnp.mean(x, axis=(1, 2))
+        # Head in float32 (logits precision), like the ref's fresh nn.Linear
+        # 512->10 head swap (ref dpp.py:15).
+        x = nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+        return x
+
+
+ResNet18 = partial(ResNet, stage_sizes=(2, 2, 2, 2), block_cls=BasicBlock)
+ResNet34 = partial(ResNet, stage_sizes=(3, 4, 6, 3), block_cls=BasicBlock)
+ResNet50 = partial(ResNet, stage_sizes=(3, 4, 6, 3), block_cls=BottleneckBlock)
+ResNet101 = partial(ResNet, stage_sizes=(3, 4, 23, 3), block_cls=BottleneckBlock)

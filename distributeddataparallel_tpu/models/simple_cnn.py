@@ -1,0 +1,55 @@
+"""Toy models for the end-to-end slice (BASELINE config 1).
+
+The reference's model is ``SimpleCNN``: a torchvision ResNet-18 with the FC
+head swapped for 10 classes (ref dpp.py:11-18).  The full ResNet lives in
+``models.resnet``; this module provides the tiny MLP/CNN the toy CPU config
+calls for, in the same Flax idiom the rest of the zoo uses.
+
+TPU notes: NHWC layout (XLA-native on TPU), feature dims padded to
+MXU/VPU-friendly multiples where it matters (the toy nets are too small for
+the MXU either way — they exist to prove the plumbing, not the FLOPs).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class TinyMLP(nn.Module):
+    """Minimal MLP on flattened inputs — the fastest plumbing-proof model."""
+
+    features: tuple[int, ...] = (128, 128)
+    num_classes: int = 10
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.reshape((x.shape[0], -1)).astype(self.dtype)
+        for f in self.features:
+            x = nn.Dense(f, dtype=self.dtype)(x)
+            x = nn.relu(x)
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+
+
+class SimpleCNN(nn.Module):
+    """Small conv net for 32×32 images — the toy-CNN variant of config 1.
+
+    Named for the reference's wrapper class (ref dpp.py:11) but sized for
+    what that config actually needs: a few conv blocks and a linear head.
+    Inputs are NHWC.
+    """
+
+    num_classes: int = 10
+    widths: tuple[int, ...] = (32, 64)
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.astype(self.dtype)
+        for w in self.widths:
+            x = nn.Conv(w, (3, 3), padding="SAME", dtype=self.dtype)(x)
+            x = nn.relu(x)
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.mean(axis=(1, 2))
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)

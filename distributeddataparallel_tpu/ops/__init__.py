@@ -1,0 +1,4 @@
+from distributeddataparallel_tpu.ops.losses import (  # noqa: F401
+    cross_entropy_loss,
+    accuracy,
+)

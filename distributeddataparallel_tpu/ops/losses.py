@@ -1,0 +1,21 @@
+"""Losses/metrics: the ``nn.CrossEntropyLoss`` analog (ref dpp.py:40,51).
+
+Mean-reduced softmax cross entropy over integer labels — identical math to
+torch's default CrossEntropyLoss reduction. Computed in float32 regardless
+of activation dtype (logits are upcast) for numerical parity.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import optax
+
+
+def cross_entropy_loss(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+    """Mean softmax CE with integer labels; logits (B, C), labels (B,)."""
+    logits = logits.astype(jnp.float32)
+    return optax.softmax_cross_entropy_with_integer_labels(logits, labels).mean()
+
+
+def accuracy(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+    return (jnp.argmax(logits, axis=-1) == labels).mean()

@@ -1,0 +1,7 @@
+from distributeddataparallel_tpu.parallel.sampler import DistributedSampler  # noqa: F401
+from distributeddataparallel_tpu.parallel.data_parallel import (  # noqa: F401
+    DataParallel,
+    all_reduce_gradients,
+    broadcast_params,
+    bucket_gradients,
+)

@@ -1,0 +1,184 @@
+"""Data-parallel gradient synchronization: the TPU-native DDP core.
+
+What ``DDP(model, device_ids=[rank])`` (ref dpp.py:39) does imperatively —
+broadcast initial params, hook autograd, bucket gradients into 25 MiB
+groups, all-reduce each bucket asynchronously overlapped with backward,
+divide by world size — falls out declaratively in SPMD JAX:
+
+- *param broadcast*  → ``broadcast_params``: replicate across the mesh
+  (and across hosts from process 0, the exact analog of DDP's rank-0
+  broadcast).
+- *grad hooks + all-reduce* → ``all_reduce_gradients``: ``lax.pmean`` over
+  the ``data`` mesh axis inside the jit'd step; XLA's latency-hiding
+  scheduler overlaps the collective with remaining backward compute (the
+  performance property SURVEY.md §3.4 calls out as THE thing to reproduce).
+- *bucketing* → ``bucket_gradients``: optional explicit 25 MiB-style
+  coalescing of gradient leaves into a few large all-reduces.  Stock XLA
+  usually makes this unnecessary; it exists for parity with BASELINE
+  config 4 ("bucketed psum all-reduce") and as a measured fallback.
+- *no_sync / grad accumulation* → handled in ``training.train_step`` by
+  accumulating microbatch grads locally and reducing once per boundary.
+
+All reduction helpers are designed to run **inside** ``shard_map`` (they
+reference a named mesh axis).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+Pytree = Any
+
+#: DDP's default bucket size: 25 MiB (SURVEY.md §2b, torch Reducer default).
+DEFAULT_BUCKET_BYTES = 25 * 1024 * 1024
+
+
+def all_reduce_gradients(
+    grads: Pytree,
+    axis_name: str = "data",
+    *,
+    op: str = "mean",
+    bucket_bytes: int | None = None,
+) -> Pytree:
+    """All-reduce a gradient pytree across the data axis (inside shard_map).
+
+    ``op='mean'`` reproduces DDP's divide-by-world-size so every replica
+    holds averaged gradients and stays in lockstep under a local optimizer
+    step (ref dpp.py:52-53 semantics).
+    """
+    if op not in ("mean", "sum"):
+        raise ValueError(f"op must be 'mean' or 'sum', got {op!r}")
+    if bucket_bytes is not None:
+        return bucket_gradients(
+            grads, axis_name, op=op, bucket_bytes=bucket_bytes
+        )
+    if op == "mean":
+        return jax.tree.map(lambda g: lax.pmean(g, axis_name), grads)
+    return jax.tree.map(lambda g: lax.psum(g, axis_name), grads)
+
+
+def bucket_gradients(
+    grads: Pytree,
+    axis_name: str = "data",
+    *,
+    op: str = "mean",
+    bucket_bytes: int = DEFAULT_BUCKET_BYTES,
+) -> Pytree:
+    """Coalesced all-reduce: flatten grad leaves into ~bucket_bytes groups,
+    reduce each group as one flat vector, scatter back.
+
+    The explicit analog of DDP's Reducer bucketing (25 MiB default).  Like
+    DDP, buckets are formed in *reverse* leaf order so the bucket containing
+    the last-computed (earliest-layer) grads is reduced last — giving the
+    XLA scheduler the same freedom to overlap early buckets with remaining
+    backward work.
+    """
+    leaves, treedef = jax.tree.flatten(grads)
+    order = list(range(len(leaves)))[::-1]
+
+    buckets: list[list[int]] = []
+    cur: list[int] = []
+    cur_bytes = 0
+    for i in order:
+        nbytes = leaves[i].size * leaves[i].dtype.itemsize
+        if cur and cur_bytes + nbytes > bucket_bytes:
+            buckets.append(cur)
+            cur, cur_bytes = [], 0
+        cur.append(i)
+        cur_bytes += nbytes
+    if cur:
+        buckets.append(cur)
+
+    reduced: list[Any] = [None] * len(leaves)
+    for bucket in buckets:
+        flat = jnp.concatenate(
+            [leaves[i].reshape(-1).astype(jnp.float32) for i in bucket]
+        )
+        flat = lax.psum(flat, axis_name)
+        if op == "mean":
+            flat = flat / lax.psum(1, axis_name)
+        offset = 0
+        for i in bucket:
+            n = leaves[i].size
+            reduced[i] = (
+                flat[offset : offset + n]
+                .reshape(leaves[i].shape)
+                .astype(leaves[i].dtype)
+            )
+            offset += n
+    return jax.tree.unflatten(treedef, reduced)
+
+
+def broadcast_params(params: Pytree, mesh: Mesh) -> Pytree:
+    """Replicate params across every device of the mesh.
+
+    The analog of DDP's construction-time broadcast of rank-0 parameters
+    (SURVEY.md §2b "Gradient synchronization" (i)).  Within one process this
+    is a replicated ``device_put``; across processes, values from process 0
+    are broadcast to all so every host starts from identical weights even if
+    their host-side RNG diverged.
+    """
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        params = multihost_utils.broadcast_one_to_all(params)
+    return jax.device_put(params, NamedSharding(mesh, P()))
+
+
+class DataParallel:
+    """Object-style facade over the mesh, mirroring the DDP wrapper's role.
+
+    Where the reference writes::
+
+        model = DDP(model, device_ids=[rank])          # ref dpp.py:39
+
+    this framework writes::
+
+        dp = DataParallel(mesh)                        # or DataParallel()
+        params = dp.replicate(params)                  # DDP ctor broadcast
+        step = make_train_step(loss_fn, opt, mesh=dp.mesh)
+        batch = dp.shard_batch(batch)                  # data -> 'data' axis
+
+    It owns no gradient machinery itself — synchronization lives inside the
+    compiled step — but centralizes mesh construction, replication, and
+    batch sharding so user code never touches device objects (the analog of
+    ``.to(rank)`` at ref dpp.py:38,48 disappearing).
+    """
+
+    def __init__(
+        self,
+        mesh: Mesh | None = None,
+        *,
+        axis_name: str = "data",
+        devices: Sequence[jax.Device] | None = None,
+    ):
+        if mesh is None:
+            from distributeddataparallel_tpu.runtime.distributed import make_mesh
+
+            mesh = make_mesh((axis_name,), devices=devices)
+        if axis_name not in mesh.axis_names:
+            raise ValueError(
+                f"axis {axis_name!r} not in mesh axes {mesh.axis_names}"
+            )
+        self.mesh = mesh
+        self.axis_name = axis_name
+
+    @property
+    def num_replicas(self) -> int:
+        return self.mesh.shape[self.axis_name]
+
+    def replicate(self, tree: Pytree) -> Pytree:
+        return broadcast_params(tree, self.mesh)
+
+    def shard_batch(self, batch: Pytree) -> Pytree:
+        """Place a host batch sharded along the data axis (single impl in
+        ``data.loader.shard_batch``: sharded device_put on one host,
+        per-process global-array assembly multi-host)."""
+        from distributeddataparallel_tpu.data.loader import shard_batch
+
+        return shard_batch(batch, self.mesh, self.axis_name)

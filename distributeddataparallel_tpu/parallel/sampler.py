@@ -1,0 +1,123 @@
+"""Deterministic per-replica index sharding: the ``DistributedSampler`` analog.
+
+The reference shards CIFAR-10 with
+``DistributedSampler(dataset, num_replicas=world_size, rank=rank, shuffle=True)``
+(ref dpp.py:34) and reshuffles per epoch via ``sampler.set_epoch(epoch)``
+(ref dpp.py:46).  The semantics the build must reproduce (SURVEY.md §2b):
+
+1. Optionally shuffle ``range(N)`` with a generator seeded ``seed + epoch``.
+2. If not ``drop_last``: pad by repeating indices until
+   ``total_size = ceil(N / num_replicas) * num_replicas`` so every replica
+   gets the same count.  If ``drop_last``: truncate to the floor multiple.
+3. Each replica takes the strided slice ``indices[rank::num_replicas]``.
+
+On TPU this object feeds the *per-host* input pipeline: each host loads only
+its replicas' rows and the global batch is assembled with
+``jax.make_array_from_process_local_data`` (see ``data.loader``).  The
+sampler itself is pure host-side NumPy — no device work.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Iterator, Sequence
+
+import numpy as np
+
+
+class DistributedSampler:
+    """Epoch-seeded, padded, strided index shard for one replica.
+
+    Matches torch's ``DistributedSampler`` contract (padding, striding,
+    ``set_epoch``) without depending on torch.  ``dataset`` may be anything
+    with ``__len__``, or an int length.
+    """
+
+    def __init__(
+        self,
+        dataset,
+        num_replicas: int,
+        rank: int,
+        shuffle: bool = True,
+        seed: int = 0,
+        drop_last: bool = False,
+    ):
+        if not (0 <= rank < num_replicas):
+            raise ValueError(f"rank {rank} not in [0, {num_replicas})")
+        self.dataset_len = dataset if isinstance(dataset, int) else len(dataset)
+        self.num_replicas = num_replicas
+        self.rank = rank
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self.epoch = 0
+        if self.drop_last and self.dataset_len % num_replicas != 0:
+            self.num_samples = self.dataset_len // num_replicas
+        else:
+            self.num_samples = math.ceil(self.dataset_len / num_replicas)
+        self.total_size = self.num_samples * num_replicas
+
+    def set_epoch(self, epoch: int) -> None:
+        """Reseed the shuffle for a new epoch (analog of ref dpp.py:46)."""
+        self.epoch = epoch
+
+    def _global_indices(self) -> np.ndarray:
+        if self.shuffle:
+            rng = np.random.default_rng(self.seed + self.epoch)
+            indices = rng.permutation(self.dataset_len)
+        else:
+            indices = np.arange(self.dataset_len)
+        if self.drop_last:
+            indices = indices[: self.total_size]
+        else:
+            pad = self.total_size - len(indices)
+            if pad > 0:
+                # Repeat from the head, wrapping if the dataset is smaller
+                # than one full round — same rule torch uses.
+                reps = math.ceil(pad / len(indices))
+                indices = np.concatenate([indices, np.tile(indices, reps)[:pad]])
+        return indices
+
+    def local_indices(self) -> np.ndarray:
+        """This replica's indices for the current epoch (rank::num_replicas)."""
+        return self._global_indices()[self.rank :: self.num_replicas]
+
+    def __iter__(self) -> Iterator[int]:
+        return iter(self.local_indices().tolist())
+
+    def __len__(self) -> int:
+        return self.num_samples
+
+
+def shard_indices_for_hosts(
+    dataset_len: int,
+    *,
+    num_hosts: int,
+    host_id: int,
+    replicas_per_host: int,
+    epoch: int = 0,
+    seed: int = 0,
+    shuffle: bool = True,
+    drop_last: bool = False,
+) -> np.ndarray:
+    """Indices for all of one host's replicas, interleaved batch-compatibly.
+
+    On TPU a host feeds ``replicas_per_host`` mesh positions at once.  This
+    returns the concatenation of each local replica's strided shard in
+    replica order, shaped ``(replicas_per_host, num_samples)`` — row r is
+    global replica ``host_id * replicas_per_host + r``, exactly what that
+    device would have received under 1-process-per-device DDP.
+    """
+    rows = []
+    for r in range(replicas_per_host):
+        s = DistributedSampler(
+            dataset_len,
+            num_replicas=num_hosts * replicas_per_host,
+            rank=host_id * replicas_per_host + r,
+            shuffle=shuffle,
+            seed=seed,
+            drop_last=drop_last,
+        )
+        s.set_epoch(epoch)
+        rows.append(s.local_indices())
+    return np.stack(rows)

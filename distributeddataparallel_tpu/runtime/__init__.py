@@ -1,0 +1,12 @@
+from distributeddataparallel_tpu.runtime.distributed import (  # noqa: F401
+    init_process_group,
+    destroy_process_group,
+    get_rank,
+    get_world_size,
+    local_device_count,
+    global_device_count,
+    is_initialized,
+    make_mesh,
+    barrier,
+)
+from distributeddataparallel_tpu.runtime.launcher import spawn  # noqa: F401

@@ -1,0 +1,170 @@
+"""Process-group runtime: the TPU-native analog of ``torch.distributed``.
+
+The reference calls ``dist.init_process_group("nccl", rank=..., world_size=...)``
+(ref dpp.py:20-21) and ``dist.destroy_process_group()`` (ref dpp.py:23-24),
+with env:// TCPStore rendezvous and one process per GPU.
+
+On TPU the shape of the world is different and this module embraces that:
+
+- One **process per host**, each owning all its local chips
+  (``jax.local_devices()``), instead of one process per device.
+- Rendezvous is ``jax.distributed.initialize`` — auto-configured on Cloud
+  TPU VMs, explicit ``coordinator_address`` elsewhere — replacing the
+  reference's TCPStore + MASTER_ADDR/MASTER_PORT env vars (which the
+  reference never sets; see SURVEY.md §2d.1 — our init is self-contained).
+- There is no user-visible communicator object: collectives are XLA ops
+  (``lax.psum`` et al.) compiled into the training step and scheduled over
+  ICI/DCN by XLA.
+
+Single-process use (one host, or CPU with
+``--xla_force_host_platform_device_count=N`` fake devices) requires no
+rendezvous at all; ``init_process_group`` detects this and is a no-op
+beyond recording state.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+@dataclasses.dataclass
+class _ProcessGroupState:
+    initialized: bool = False
+    multi_process: bool = False
+    backend: str = "tpu"
+
+
+_STATE = _ProcessGroupState()
+
+
+def init_process_group(
+    backend: str | None = None,
+    *,
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+    local_device_ids: Sequence[int] | None = None,
+) -> None:
+    """Initialize the distributed runtime (analog of ref dpp.py:21).
+
+    Unlike the reference — which requires the caller to export
+    MASTER_ADDR/MASTER_PORT and crashes otherwise (SURVEY.md §2d.1) — this
+    is self-contained:
+
+    - If explicit coordinator args are given, or the environment announces a
+      multi-process job (``JAX_COORDINATOR_ADDRESS`` / Cloud TPU metadata),
+      run ``jax.distributed.initialize`` for control-plane rendezvous.
+    - Otherwise run single-process: all devices are local, no rendezvous.
+
+    ``backend`` is advisory ("tpu", "cpu", "cuda"); device selection itself
+    is done via ``JAX_PLATFORMS`` before import, by the CLI layer.
+    """
+    if _STATE.initialized:
+        raise RuntimeError(
+            "init_process_group called twice; call destroy_process_group first"
+        )
+
+    explicit = coordinator_address is not None or num_processes is not None
+    env_multiproc = (
+        os.environ.get("JAX_COORDINATOR_ADDRESS")
+        or os.environ.get("JAX_NUM_PROCESSES")
+        or os.environ.get("CLOUD_TPU_TASK_ID")
+    )
+
+    if explicit or env_multiproc:
+        kwargs = {}
+        if coordinator_address is not None:
+            kwargs["coordinator_address"] = coordinator_address
+        if num_processes is not None:
+            kwargs["num_processes"] = num_processes
+        if process_id is not None:
+            kwargs["process_id"] = process_id
+        if local_device_ids is not None:
+            kwargs["local_device_ids"] = list(local_device_ids)
+        jax.distributed.initialize(**kwargs)
+        _STATE.multi_process = True
+
+    _STATE.initialized = True
+    _STATE.backend = backend or jax.default_backend()
+
+
+def destroy_process_group() -> None:
+    """Tear down the distributed runtime (analog of ref dpp.py:23-24)."""
+    if _STATE.multi_process:
+        jax.distributed.shutdown()
+    _STATE.initialized = False
+    _STATE.multi_process = False
+
+
+def is_initialized() -> bool:
+    return _STATE.initialized
+
+
+def get_rank() -> int:
+    """Process index (the analog of the reference's per-process ``rank``).
+
+    Note the unit change: the reference's rank is per *device* (1 proc/GPU,
+    ref dpp.py:62); here it is per *host* — devices within a host are
+    addressed through the mesh, not through process identity.
+    """
+    return jax.process_index()
+
+
+def get_world_size() -> int:
+    """Number of processes (hosts), not devices."""
+    return jax.process_count()
+
+
+def local_device_count() -> int:
+    return jax.local_device_count()
+
+
+def global_device_count() -> int:
+    return len(jax.devices())
+
+
+def make_mesh(
+    axes: Sequence[str] = ("data",),
+    shape: Sequence[int] | None = None,
+    *,
+    devices: Sequence[jax.Device] | None = None,
+) -> Mesh:
+    """Build the device mesh that replaces the reference's process group.
+
+    With the default 1-D ``('data',)`` axis over all addressable devices this
+    is the direct analog of the NCCL communicator created at ref dpp.py:21 —
+    the set of participants in gradient all-reduce. Multi-axis meshes (e.g.
+    ``('data', 'model')``) are supported so the same runtime carries tensor/
+    sequence-parallel extensions without redesign.
+
+    ``shape`` defaults to putting all devices on the first axis.
+    """
+    devs = list(devices) if devices is not None else jax.devices()
+    n = len(devs)
+    if shape is None:
+        shape = (n,) + (1,) * (len(axes) - 1)
+    shape = tuple(shape)
+    if int(np.prod(shape)) != n:
+        raise ValueError(f"mesh shape {shape} does not cover {n} devices")
+    mesh_devices = np.asarray(devs, dtype=object).reshape(shape)
+    return Mesh(mesh_devices, tuple(axes))
+
+
+def barrier(name: str = "ddp_tpu_barrier") -> None:
+    """Block until all processes reach this point.
+
+    The reference has no explicit barrier (NCCL init is its implicit one);
+    this is provided for host-side coordination (e.g. checkpoint writes).
+    Single-process: trivially returns.  Multi-process: a true global sync
+    over all devices via multihost_utils.
+    """
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(name)

@@ -1,0 +1,91 @@
+"""Launcher: the TPU-native analog of ``torch.multiprocessing.spawn``.
+
+The reference fans out one OS process per GPU with
+``mp.spawn(train, args=(world_size,), nprocs=world_size, join=True)``
+(ref dpp.py:62).  On TPU the idiomatic topology is one process per *host*,
+with all local chips driven through the mesh by a single jit'd SPMD program —
+so on a single host, "spawn" is simply a function call, and across hosts the
+fan-out is done by the cluster scheduler (one command per TPU VM), not by
+forking.
+
+``spawn`` therefore:
+
+- runs ``fn(process_id, *args)`` in-process for the common one-host case
+  (covering every local chip via the mesh — the work the reference needed
+  ``world_size`` processes for happens inside one XLA program);
+- when ``nprocs > 1`` is requested explicitly (CPU simulation of a
+  multi-host job), forks real OS processes, each with its own
+  ``jax.distributed`` rendezvous over a localhost coordinator — the moral
+  equivalent of the reference's TCPStore env:// rendezvous, but
+  self-contained (no MASTER_ADDR/MASTER_PORT to export; SURVEY.md §2d.1).
+
+``join=True`` semantics from the reference (block, propagate child failure)
+are preserved.
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+import socket
+from typing import Any, Callable, Sequence
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _child(fn, process_id, nprocs, coordinator, env, args):
+    # Runs in a fresh interpreter (spawn start method): configure the JAX
+    # runtime before anything imports jax.
+    os.environ.update(env)
+    os.environ["JAX_COORDINATOR_ADDRESS"] = coordinator
+    os.environ["JAX_NUM_PROCESSES"] = str(nprocs)
+    os.environ["JAX_PROCESS_ID"] = str(process_id)
+    fn(process_id, *args)
+
+
+def spawn(
+    fn: Callable[..., Any],
+    args: Sequence[Any] = (),
+    nprocs: int = 1,
+    join: bool = True,
+    *,
+    env: dict[str, str] | None = None,
+):
+    """Run ``fn(i, *args)`` for i in range(nprocs).
+
+    nprocs=1 (the TPU-native default): direct call, no fork — one process
+    drives all local chips. nprocs>1: real OS processes with a localhost
+    coordinator, used to exercise the true multi-process code path on CPU.
+    """
+    if nprocs < 1:
+        raise ValueError(f"nprocs must be >= 1, got {nprocs}")
+    if nprocs == 1:
+        fn(0, *args)
+        return None
+
+    coordinator = f"127.0.0.1:{_free_port()}"
+    ctx = mp.get_context("spawn")
+    procs = []
+    for i in range(nprocs):
+        p = ctx.Process(
+            target=_child,
+            args=(fn, i, nprocs, coordinator, dict(env or {}), tuple(args)),
+            daemon=False,
+        )
+        p.start()
+        procs.append(p)
+    if not join:
+        return procs
+    failed = []
+    for i, p in enumerate(procs):
+        p.join()
+        if p.exitcode != 0:
+            failed.append((i, p.exitcode))
+    if failed:
+        # Mirror mp.spawn join=True: surface child failure in the parent.
+        raise RuntimeError(f"spawned processes failed (rank, exitcode): {failed}")
+    return None

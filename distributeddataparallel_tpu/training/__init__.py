@@ -1,0 +1,2 @@
+from distributeddataparallel_tpu.training.state import TrainState  # noqa: F401
+from distributeddataparallel_tpu.training.train_step import make_train_step  # noqa: F401

@@ -1,0 +1,65 @@
+"""Checkpoint/resume via Orbax.
+
+The reference has none — training state dies with the process (SURVEY.md
+§2d.5 / §5).  BASELINE configs 3-5 are multi-hour runs, so save/restore is
+table stakes here: async Orbax saves of the full TrainState pytree keyed by
+epoch, multi-host safe (every process participates; Orbax coordinates the
+single logical write).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+import jax
+import orbax.checkpoint as ocp
+
+Pytree = Any
+
+
+class Checkpointer:
+    """Epoch-keyed async checkpoints of a TrainState-like pytree."""
+
+    def __init__(self, directory: str, *, max_to_keep: int = 3):
+        self._mgr = ocp.CheckpointManager(
+            os.path.abspath(directory),
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep, enable_async_checkpointing=True
+            ),
+        )
+
+    def save(self, state: Pytree, epoch: int) -> None:
+        self._mgr.save(epoch, args=ocp.args.StandardSave(_arrays_only(state)))
+
+    def restore_latest(self, state: Pytree) -> tuple[Pytree, int]:
+        """Restore into the structure of ``state``; returns (state, next_epoch).
+
+        With no checkpoint present, returns the input state and epoch 0.
+        """
+        step = self._mgr.latest_step()
+        if step is None:
+            return state, 0
+        restored = self._mgr.restore(
+            step, args=ocp.args.StandardRestore(_arrays_only(state))
+        )
+        state = _merge_arrays(state, restored)
+        return state, step + 1
+
+    def wait(self) -> None:
+        self._mgr.wait_until_finished()
+
+
+def _arrays_only(state: Pytree) -> Pytree:
+    """TrainState carries static fields (apply_fn, tx) that are not
+    checkpointable; flax.struct already excludes them from the pytree, so
+    this is just the identity on leaves — kept as a hook for filtering."""
+    return jax.tree.map(lambda x: x, state)
+
+
+def _merge_arrays(template: Pytree, restored: Pytree) -> Pytree:
+    leaves, treedef = jax.tree.flatten(template)
+    new_leaves = jax.tree.leaves(restored)
+    if len(leaves) != len(new_leaves):
+        raise ValueError("restored checkpoint structure mismatch")
+    return jax.tree.unflatten(treedef, new_leaves)

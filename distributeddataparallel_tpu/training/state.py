@@ -1,0 +1,64 @@
+"""Train state: the functional replacement for (model, optimizer) mutation.
+
+The reference mutates module parameters in place via ``optimizer.step()``
+(ref dpp.py:53).  Here all training state is one immutable pytree threaded
+through the compiled step — params, optimizer state, step counter — which
+is what makes donation, replication, and checkpointing trivial.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import flax.struct
+import jax
+import optax
+
+Pytree = Any
+
+
+@flax.struct.dataclass
+class TrainState:
+    """Immutable training state pytree.
+
+    ``apply_fn`` and ``tx`` are static (not traced); everything else is
+    device data.  Mirrors the information DDP + SGD hold across iterations.
+    """
+
+    step: jax.Array
+    params: Pytree
+    opt_state: optax.OptState
+    # Non-gradient model state (e.g. BatchNorm running stats) — the analog
+    # of torch module *buffers*, which DDP broadcasts to keep replicas
+    # consistent; here they live in the state pytree and the train step
+    # keeps them replicated (pmean across the data axis).
+    model_state: Pytree
+    apply_fn: Callable = flax.struct.field(pytree_node=False)
+    tx: optax.GradientTransformation = flax.struct.field(pytree_node=False)
+
+    @classmethod
+    def create(
+        cls,
+        *,
+        apply_fn: Callable,
+        params: Pytree,
+        tx: optax.GradientTransformation,
+        model_state: Pytree | None = None,
+    ) -> "TrainState":
+        import jax.numpy as jnp
+
+        return cls(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            opt_state=tx.init(params),
+            model_state=model_state if model_state is not None else {},
+            apply_fn=apply_fn,
+            tx=tx,
+        )
+
+    def apply_gradients(self, grads: Pytree) -> "TrainState":
+        updates, new_opt_state = self.tx.update(grads, self.opt_state, self.params)
+        new_params = optax.apply_updates(self.params, updates)
+        return self.replace(
+            step=self.step + 1, params=new_params, opt_state=new_opt_state
+        )

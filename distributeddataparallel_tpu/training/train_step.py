@@ -1,0 +1,210 @@
+"""The compiled data-parallel train step — the heart of the framework.
+
+One call of the returned function performs what the reference's per-batch
+loop body does across ``world_size`` processes (ref dpp.py:47-53):
+
+    zero_grad → forward → loss → backward (+ bucketed NCCL all-reduce
+    overlapped with backward) → optimizer.step()
+
+but as a single jit'd SPMD program over the mesh:
+
+- the batch arrives sharded along the ``data`` axis (one shard per mesh
+  position — the role DDP gave to a whole process);
+- ``jax.value_and_grad`` replaces the autograd engine + hooks;
+- ``lax.pmean`` over the data axis replaces the Reducer's bucketed
+  all-reduce, with XLA's latency-hiding scheduler providing the
+  comm/compute overlap (SURVEY.md §3.4); set ``bucket_bytes`` to force
+  explicit DDP-style bucket coalescing instead;
+- the optax update replaces ``optimizer.step()`` — replicas stay in
+  lockstep because they apply identical averaged grads to identical params;
+- gradient accumulation (``accum_steps > 1``) reproduces DDP's
+  ``no_sync()``: microbatch grads accumulate locally in a ``lax.scan``;
+  the all-reduce fires once, on the accumulation boundary.
+
+The step donates the input state, so parameters and optimizer state are
+updated in place in device memory (no copy per step).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distributeddataparallel_tpu.parallel.data_parallel import all_reduce_gradients
+from distributeddataparallel_tpu.training.state import TrainState
+
+Pytree = Any
+# loss_fn(params, batch, rng) -> (scalar loss, aux dict)
+LossFn = Callable[[Pytree, Pytree, jax.Array], tuple[jax.Array, dict]]
+
+
+def make_train_step(
+    loss_fn: LossFn,
+    *,
+    mesh: Mesh,
+    axis_name: str = "data",
+    accum_steps: int = 1,
+    bucket_bytes: int | None = None,
+    donate: bool = True,
+    with_model_state: bool = False,
+):
+    """Build the jit'd DP train step.
+
+    Returns ``step(state, batch, rng) -> (state, metrics)`` where ``batch``
+    is a pytree whose leaves have a leading per-replica batch dimension
+    (global batch = per-replica batch × num replicas, the reference's
+    ``32 × world_size`` rule, ref dpp.py:35) and ``metrics`` contains the
+    globally averaged ``loss`` plus anything in the loss_fn's aux dict.
+
+    ``rng`` is folded with the replica index so stochastic layers (dropout,
+    etc.) decorrelate across replicas while params stay in lockstep.
+
+    With ``with_model_state=True``, the loss_fn signature becomes
+    ``loss_fn(params, model_state, batch, rng) -> (loss, (aux, new_state))``
+    — for models with non-gradient state such as BatchNorm running stats.
+    New model state is pmean'd across replicas each step, the SPMD
+    equivalent of DDP keeping module buffers consistent across ranks.
+    """
+    if accum_steps < 1:
+        raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
+
+    def _micro(params, model_state, mb, rng):
+        """One microbatch: returns (loss, aux, new_model_state, grads)."""
+        if with_model_state:
+            (loss, (aux, new_ms)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(params, model_state, mb, rng)
+        else:
+            (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                params, mb, rng
+            )
+            new_ms = model_state
+        return loss, aux, new_ms, grads
+
+    def _replica_step(state: TrainState, batch: Pytree, rng: jax.Array):
+        # Runs per mesh position under shard_map: `batch` is this replica's
+        # shard; params/opt state are replicated.
+        idx = lax.axis_index(axis_name)
+        rng = jax.random.fold_in(rng, idx)
+
+        if accum_steps == 1:
+            loss, aux, new_ms, grads = _micro(
+                state.params, state.model_state, batch, rng
+            )
+        else:
+            # no_sync analog: accumulate locally, reduce once at the end.
+            for leaf in jax.tree.leaves(batch):
+                if leaf.shape[0] % accum_steps != 0:
+                    raise ValueError(
+                        f"per-replica batch {leaf.shape[0]} is not divisible "
+                        f"by accum_steps={accum_steps}; choose a batch size "
+                        f"that is a multiple of accum_steps"
+                    )
+            micro = jax.tree.map(
+                lambda x: x.reshape((accum_steps, x.shape[0] // accum_steps) + x.shape[1:]),
+                batch,
+            )
+
+            def body(carry, xs):
+                acc_grads, acc_loss, acc_aux, ms = carry
+                mb, step_rng = xs
+                l, a, ms, g = _micro(state.params, ms, mb, step_rng)
+                acc_grads = jax.tree.map(jnp.add, acc_grads, g)
+                return (acc_grads, acc_loss + l, jax.tree.map(jnp.add, acc_aux, a), ms), None
+
+            # Seed the scan carry with the first microbatch's grads/aux (so
+            # the aux tree structure is known without a separate probe).
+            first_mb = jax.tree.map(lambda x: x[0], micro)
+            l0, a0, ms0, g0 = _micro(
+                state.params, state.model_state, first_mb,
+                jax.random.fold_in(rng, 0),
+            )
+            rest = jax.tree.map(lambda x: x[1:], micro)
+            rngs = jax.vmap(lambda i: jax.random.fold_in(rng, i))(
+                jnp.arange(1, accum_steps)
+            )
+            (grads, loss, aux, new_ms), _ = lax.scan(
+                body, (g0, l0, a0, ms0), (rest, rngs)
+            )
+            inv = 1.0 / accum_steps
+            grads = jax.tree.map(lambda g: g * inv, grads)
+            loss = loss * inv
+            aux = jax.tree.map(lambda a: a * inv, aux)
+
+        # THE DDP moment: average grads across the data axis.
+        grads = all_reduce_gradients(
+            grads, axis_name, op="mean", bucket_bytes=bucket_bytes
+        )
+        new_state = state.apply_gradients(grads)
+        if with_model_state:
+            # Keep buffers replicated (SyncBN-flavored: average the stats).
+            new_ms = jax.tree.map(lambda s: lax.pmean(s, axis_name), new_ms)
+            new_state = new_state.replace(model_state=new_ms)
+        metrics = {"loss": lax.pmean(loss, axis_name)}
+        metrics.update(
+            {k: lax.pmean(v, axis_name) for k, v in aux.items()}
+        )
+        return new_state, metrics
+
+    # Params/opt-state replicated (P()), batch sharded on the data axis,
+    # rng replicated; outputs replicated.
+    #
+    # check_vma=False: with varying-manual-axes tracking on, the AD
+    # transpose of replicated (unvarying) params inserts an implicit psum,
+    # so grads would arrive pre-summed and the explicit reduction below
+    # would silently become a no-op (sum semantics = world_size× the DDP
+    # learning rate).  This framework keeps the DDP-style *explicit* sync
+    # point — grads stay per-replica until all_reduce_gradients — which is
+    # also what makes the bucketed/overlap variants possible.
+    data_axes = (axis_name,)
+    sharded = jax.shard_map(
+        _replica_step,
+        mesh=mesh,
+        in_specs=(P(), P(*data_axes), P()),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+
+    jit_kwargs = {"donate_argnums": (0,)} if donate else {}
+    step = jax.jit(sharded, **jit_kwargs)
+    return step
+
+
+def make_eval_step(
+    metric_fn: Callable[..., dict],
+    *,
+    mesh: Mesh,
+    axis_name: str = "data",
+    with_model_state: bool = False,
+):
+    """Jit'd eval step: per-replica metrics pmean'd across the data axis.
+
+    ``metric_fn(params, batch)`` or, with model state,
+    ``metric_fn(params, model_state, batch)``.  The reference has no
+    evaluation at all (SURVEY.md §2d.5); this is the beyond-parity minimum
+    for the BASELINE configs.
+    """
+
+    def _replica_eval(params: Pytree, model_state: Pytree, batch: Pytree):
+        if with_model_state:
+            metrics = metric_fn(params, model_state, batch)
+        else:
+            metrics = metric_fn(params, batch)
+        return jax.tree.map(lambda m: lax.pmean(m, axis_name), metrics)
+
+    sharded = jax.shard_map(
+        _replica_eval,
+        mesh=mesh,
+        in_specs=(P(), P(), P(axis_name)),
+        out_specs=P(),
+        check_vma=False,
+    )
+    jitted = jax.jit(sharded)
+    if with_model_state:
+        return jitted
+    return lambda params, batch: jitted(params, {}, batch)

@@ -1,0 +1,1 @@
+from distributeddataparallel_tpu.utils.logging import log0, get_logger  # noqa: F401

@@ -1,0 +1,40 @@
+"""Process-0-gated logging: the analog of ``if rank == 0: print(...)``.
+
+The reference logs loss every 100 batches from rank 0 only and pays a device
+sync per log via ``loss.item()`` (ref dpp.py:54-55).  Here logging is gated
+on ``jax.process_index() == 0`` and callers are expected to pass metrics
+that are already host-side or to log at a cadence where the implied
+``device_get`` is off the hot path (metrics from the jit'd step are async
+jax.Arrays; formatting them forces the sync, so format only when printing).
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+import jax
+
+_LOGGER: logging.Logger | None = None
+
+
+def get_logger() -> logging.Logger:
+    global _LOGGER
+    if _LOGGER is None:
+        logger = logging.getLogger("ddp_tpu")
+        if not logger.handlers:
+            h = logging.StreamHandler(sys.stderr)
+            h.setFormatter(
+                logging.Formatter("[%(asctime)s ddp-tpu] %(message)s", "%H:%M:%S")
+            )
+            logger.addHandler(h)
+            logger.setLevel(logging.INFO)
+            logger.propagate = False
+        _LOGGER = logger
+    return _LOGGER
+
+
+def log0(msg: str, *args) -> None:
+    """Log from process 0 only (analog of the rank-0 gate at ref dpp.py:54)."""
+    if jax.process_index() == 0:
+        get_logger().info(msg, *args)

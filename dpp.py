@@ -1,0 +1,282 @@
+#!/usr/bin/env python
+"""Single-entrypoint data-parallel trainer — the reference `dpp.py`, TPU-native.
+
+Usage (mirrors `python dpp.py` of the reference, ref dpp.py:60-65, plus the
+flags SURVEY.md §5 notes the reference hard-codes):
+
+    python dpp.py                              # toy CNN on synthetic data
+    python dpp.py --model resnet18 --dataset cifar10 --device tpu
+    python dpp.py --device cpu --fake-devices 8   # 8-way DP on one CPU
+
+Structure intentionally parallels the reference script:
+  setup()  -> runtime.init_process_group + mesh        (ref dpp.py:20-21)
+  train()  -> build data/model/loss/optimizer, loop    (ref dpp.py:27-57)
+  main()   -> device selection + launch                (ref dpp.py:60-62)
+
+Differences by design (SURVEY.md §2d): self-contained init (no
+MASTER_ADDR/PORT), no download race, multi-host capable, checkpoint/resume
+and eval available, logging off the hot path.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--device", choices=["tpu", "cpu", "cuda", "auto"], default="auto",
+                   help="backend selector (north-star --device flag)")
+    p.add_argument("--fake-devices", type=int, default=0,
+                   help="force N host-platform devices (CPU DP simulation)")
+    p.add_argument("--model", default="cnn",
+                   choices=["mlp", "cnn", "resnet18", "resnet50", "gpt2", "llama"],
+                   help="model family (resnet18 matches the reference)")
+    p.add_argument("--dataset", default="synthetic",
+                   choices=["synthetic", "cifar10"])
+    p.add_argument("--data-root", default="data")
+    p.add_argument("--epochs", type=int, default=5)          # ref dpp.py:27
+    p.add_argument("--batch-size", type=int, default=32,     # ref dpp.py:35
+                   help="per-replica batch (global = batch × replicas)")
+    p.add_argument("--lr", type=float, default=0.01)         # ref dpp.py:41
+    p.add_argument("--momentum", type=float, default=0.0)
+    p.add_argument("--seed", type=int, default=0)            # ref dpp.py:29
+    p.add_argument("--accum-steps", type=int, default=1,
+                   help="gradient accumulation (DDP no_sync analog)")
+    p.add_argument("--bucket-mb", type=float, default=None,
+                   help="explicit DDP-style gradient bucket size in MiB "
+                        "(default: let XLA schedule the all-reduce)")
+    p.add_argument("--log-every", type=int, default=100)     # ref dpp.py:54
+    p.add_argument("--steps-per-epoch", type=int, default=None,
+                   help="cap steps per epoch (smoke runs)")
+    p.add_argument("--num-examples", type=int, default=2048,
+                   help="synthetic dataset size")
+    p.add_argument("--checkpoint-dir", default=None)
+    p.add_argument("--resume", action="store_true")
+    p.add_argument("--eval", action="store_true", help="run eval after each epoch")
+    p.add_argument("--profile-dir", default=None,
+                   help="write a jax.profiler trace for epoch 0 here")
+    p.add_argument("--coordinator", default=None,
+                   help="host:port for multi-process rendezvous")
+    p.add_argument("--num-processes", type=int, default=None)
+    p.add_argument("--process-id", type=int, default=None)
+    return p.parse_args(argv)
+
+
+def select_device(args) -> None:
+    """Select the backend (the north-star --device flag) before first use.
+
+    Uses ``jax.config`` rather than env vars so it also works where the
+    interpreter pre-imports jax (env-var platform selection is captured at
+    import time).  Must run before any computation initializes a backend.
+    """
+    import jax
+
+    if args.fake_devices:
+        if args.device not in ("auto", "cpu"):
+            raise SystemExit("--fake-devices requires --device cpu")
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", args.fake_devices)
+    elif args.device == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    elif args.device in ("tpu", "cuda"):
+        # Prefer the named platform, fall back to whatever the env's TPU
+        # plugin registered under (e.g. 'axon' here), then cpu.
+        plats = os.environ.get("JAX_PLATFORMS", args.device)
+        jax.config.update("jax_platforms", plats)
+    # auto: leave the environment's selection in place.
+
+
+def setup(args):
+    """init_process_group + mesh (analog of ref dpp.py:20-21)."""
+    import distributeddataparallel_tpu as ddp
+
+    ddp.init_process_group(
+        None if args.device == "auto" else args.device,
+        coordinator_address=args.coordinator,
+        num_processes=args.num_processes,
+        process_id=args.process_id,
+    )
+    return ddp.make_mesh(("data",))
+
+
+def build_model(args, num_classes: int = 10):
+    from distributeddataparallel_tpu import models
+
+    if args.model == "mlp":
+        return models.TinyMLP(num_classes=num_classes)
+    if args.model == "cnn":
+        return models.SimpleCNN(num_classes=num_classes)
+    if args.model == "resnet18":
+        from distributeddataparallel_tpu.models.resnet import ResNet18
+        return ResNet18(num_classes=num_classes, stem="cifar")
+    if args.model == "resnet50":
+        from distributeddataparallel_tpu.models.resnet import ResNet50
+        return ResNet50(num_classes=num_classes)
+    raise NotImplementedError(
+        f"--model {args.model}: use lm.py-style configs via training.trainer"
+    )
+
+
+def build_dataset(args, train=True):
+    from distributeddataparallel_tpu import data
+
+    if args.dataset == "synthetic":
+        return data.SyntheticClassification(
+            num_examples=args.num_examples, seed=args.seed if train else args.seed + 1
+        )
+    return data.load_cifar10(args.data_root, train=train)
+
+
+def train(args) -> float:
+    """Per-job trainer (analog of ref dpp.py:27-57). Returns final loss."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    import distributeddataparallel_tpu as ddp
+    from distributeddataparallel_tpu.data import DataLoader
+    from distributeddataparallel_tpu.ops import accuracy, cross_entropy_loss
+    from distributeddataparallel_tpu.training.train_step import make_eval_step
+    from distributeddataparallel_tpu.utils import log0
+
+    mesh = setup(args)
+    n_replicas = mesh.shape["data"]
+    log0(
+        "world: %d process(es), %d device(s), %d-way DP, global batch %d",
+        ddp.get_world_size(), ddp.global_device_count(), n_replicas,
+        args.batch_size * n_replicas,
+    )
+
+    dataset = build_dataset(args, train=True)
+    loader = DataLoader(
+        dataset, per_replica_batch=args.batch_size, mesh=mesh,
+        shuffle=True, seed=args.seed,
+    )
+
+    model = build_model(args)
+    rng = jax.random.PRNGKey(args.seed)            # ref dpp.py:29 analog
+    sample = jnp.zeros((1,) + dataset.images.shape[1:], jnp.float32)
+    variables = model.init(rng, sample)
+    params = variables["params"]
+    # Non-param collections (BatchNorm running stats for ResNets) become
+    # framework-managed model state — the torch "buffers" DDP broadcasts.
+    model_state = {k: v for k, v in variables.items() if k != "params"}
+    has_ms = bool(model_state)
+
+    tx = optax.sgd(args.lr, momentum=args.momentum or None)  # ref dpp.py:41
+    state = ddp.TrainState.create(
+        apply_fn=model.apply, params=params, tx=tx, model_state=model_state
+    )
+    state = ddp.broadcast_params(state, mesh)       # DDP ctor broadcast analog
+
+    if has_ms:
+        def loss_fn(params, ms, batch, rng):
+            logits, new_vars = model.apply(
+                {"params": params, **ms}, batch["image"], train=True,
+                mutable=list(ms.keys()),
+            )
+            loss = cross_entropy_loss(logits, batch["label"])  # ref dpp.py:40
+            aux = {"accuracy": accuracy(logits, batch["label"])}
+            return loss, (aux, new_vars)
+    else:
+        def loss_fn(params, batch, rng):
+            logits = model.apply({"params": params}, batch["image"])
+            loss = cross_entropy_loss(logits, batch["label"])  # ref dpp.py:40
+            return loss, {"accuracy": accuracy(logits, batch["label"])}
+
+    step_fn = ddp.make_train_step(
+        loss_fn, mesh=mesh, accum_steps=args.accum_steps,
+        bucket_bytes=int(args.bucket_mb * 1024 * 1024) if args.bucket_mb else None,
+        with_model_state=has_ms,
+    )
+
+    ckpt = None
+    start_epoch = 0
+    if args.checkpoint_dir:
+        from distributeddataparallel_tpu.training.checkpoint import Checkpointer
+        ckpt = Checkpointer(args.checkpoint_dir)
+        if args.resume:
+            state, start_epoch = ckpt.restore_latest(state)
+
+    eval_step = None
+    if args.eval:
+        if has_ms:
+            def metric_fn(params, ms, batch):
+                logits = model.apply(
+                    {"params": params, **ms}, batch["image"], train=False
+                )
+                return {
+                    "loss": cross_entropy_loss(logits, batch["label"]),
+                    "accuracy": accuracy(logits, batch["label"]),
+                }
+        else:
+            def metric_fn(params, batch):
+                logits = model.apply({"params": params}, batch["image"])
+                return {
+                    "loss": cross_entropy_loss(logits, batch["label"]),
+                    "accuracy": accuracy(logits, batch["label"]),
+                }
+        eval_step = make_eval_step(metric_fn, mesh=mesh, with_model_state=has_ms)
+        eval_loader = DataLoader(
+            build_dataset(args, train=False), per_replica_batch=args.batch_size,
+            mesh=mesh, shuffle=False, seed=args.seed,
+        )
+
+    if len(loader) == 0:
+        raise SystemExit(
+            f"no training steps: dataset gives {loader.steps_per_epoch} "
+            f"batches per replica (dataset too small for "
+            f"--batch-size {args.batch_size} × {n_replicas} replicas)"
+        )
+    last_loss = float("nan")
+    step_rng = jax.random.PRNGKey(args.seed + 1)
+    for epoch in range(start_epoch, args.epochs):        # ref dpp.py:44
+        if args.profile_dir and epoch == start_epoch:
+            jax.profiler.start_trace(args.profile_dir)
+        loader.set_epoch(epoch)                          # ref dpp.py:46
+        for batch_idx, batch in enumerate(loader):       # ref dpp.py:47
+            if args.steps_per_epoch and batch_idx >= args.steps_per_epoch:
+                break
+            step_rng, sub = jax.random.split(step_rng)
+            state, metrics = step_fn(state, batch, sub)
+            if batch_idx % args.log_every == 0:          # ref dpp.py:54-55
+                last_loss = float(metrics["loss"])
+                log0("Epoch %d, Batch %d, Loss: %.4f", epoch, batch_idx, last_loss)
+        if args.profile_dir and epoch == start_epoch:
+            jax.block_until_ready(state.params)
+            jax.profiler.stop_trace()
+        last_loss = float(metrics["loss"])
+        if eval_step is not None:
+            if has_ms:
+                evals = [
+                    eval_step(state.params, state.model_state, b)
+                    for b in eval_loader
+                ]
+            else:
+                evals = [eval_step(state.params, b) for b in eval_loader]
+            if evals:
+                mean = {
+                    k: float(sum(float(e[k]) for e in evals) / len(evals))
+                    for k in evals[0]
+                }
+                log0("Epoch %d eval: %s", epoch, mean)
+        if ckpt is not None:
+            ckpt.save(state, epoch)
+
+    if ckpt is not None:
+        ckpt.wait()
+    ddp.destroy_process_group()                          # ref dpp.py:57
+    return last_loss
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    select_device(args)
+    train(args)
+
+
+if __name__ == "__main__":
+    main()

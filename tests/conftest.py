@@ -1,0 +1,24 @@
+"""Test harness: force 8 fake CPU devices before JAX backends initialize.
+
+This is the JAX-native analog of torch's fake process group (SURVEY.md §4):
+every DP test — psum correctness, sampler semantics, grad-accum boundaries,
+the DDP equivalence invariant — runs on an 8-device CPU mesh in one process,
+no cluster needed.
+
+Note: this environment pre-imports jax via sitecustomize (TPU plugin), so
+env-var selection (JAX_PLATFORMS/XLA_FLAGS) is captured before pytest runs;
+``jax.config.update`` still works because no backend is initialized yet.
+"""
+
+import jax
+import pytest
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 fake CPU devices, got {len(devs)}"
+    return devs

@@ -1,0 +1,77 @@
+"""End-to-end: the dpp.py entrypoint trains on 8 fake devices and the loss
+goes down (BASELINE config 1 acceptance: 'runs end-to-end; loss decreases')."""
+
+import sys
+
+sys.path.insert(0, "/root/repo")
+
+import dpp  # noqa: E402
+
+
+def _run(extra):
+    args = dpp.parse_args(
+        [
+            "--device", "cpu",
+            "--dataset", "synthetic",
+            "--num-examples", "512",
+            "--batch-size", "8",
+            "--log-every", "1000",
+        ]
+        + extra
+    )
+    return dpp.train(args)
+
+
+def test_toy_mlp_loss_decreases(devices):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    import distributeddataparallel_tpu as ddp
+    from distributeddataparallel_tpu.data import DataLoader, SyntheticClassification
+    from distributeddataparallel_tpu.models import TinyMLP
+    from distributeddataparallel_tpu.ops import cross_entropy_loss
+
+    mesh = ddp.make_mesh(("data",))
+    ds = SyntheticClassification(num_examples=512, shape=(8, 8, 1), seed=0)
+    loader = DataLoader(ds, per_replica_batch=8, mesh=mesh, seed=0)
+    model = TinyMLP(features=(64,))
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8, 8, 1)))["params"]
+    state = ddp.TrainState.create(
+        apply_fn=model.apply, params=params, tx=optax.sgd(0.05)
+    )
+    state = ddp.broadcast_params(state, mesh)
+
+    def loss_fn(p, b, r):
+        return cross_entropy_loss(model.apply({"params": p}, b["image"]), b["label"]), {}
+
+    step = ddp.make_train_step(loss_fn, mesh=mesh)
+    first = last = None
+    for epoch in range(3):
+        loader.set_epoch(epoch)
+        for batch in loader:
+            state, m = step(state, batch, jax.random.PRNGKey(epoch))
+            if first is None:
+                first = float(m["loss"])
+            last = float(m["loss"])
+    assert last < first * 0.7, (first, last)
+
+
+def test_entrypoint_cnn_synthetic(devices):
+    loss = _run(["--model", "cnn", "--epochs", "3", "--lr", "0.1"])
+    assert loss == loss  # not NaN
+    assert loss < 2.3  # below random-chance CE for 10 classes
+
+
+def test_entrypoint_accum(devices):
+    loss = _run(
+        ["--model", "mlp", "--epochs", "1", "--accum-steps", "2",
+         "--batch-size", "16"]
+    )
+    assert loss == loss
+
+
+def test_entrypoint_bucketed(devices):
+    loss = _run(["--model", "mlp", "--epochs", "1", "--bucket-mb", "0.01"])
+    assert loss == loss

@@ -1,0 +1,53 @@
+"""Runtime tests: process-group lifecycle, mesh construction, launcher."""
+
+import jax
+import numpy as np
+import pytest
+
+from distributeddataparallel_tpu.runtime import distributed as dist
+from distributeddataparallel_tpu.runtime.launcher import spawn
+
+
+def test_init_destroy_lifecycle():
+    assert not dist.is_initialized()
+    dist.init_process_group("cpu")
+    assert dist.is_initialized()
+    with pytest.raises(RuntimeError):
+        dist.init_process_group("cpu")
+    assert dist.get_rank() == 0
+    assert dist.get_world_size() == 1
+    assert dist.local_device_count() == 8
+    assert dist.global_device_count() == 8
+    dist.destroy_process_group()
+    assert not dist.is_initialized()
+    # re-init after destroy works
+    dist.init_process_group("cpu")
+    dist.destroy_process_group()
+
+
+def test_make_mesh_default(devices):
+    mesh = dist.make_mesh(("data",))
+    assert mesh.axis_names == ("data",)
+    assert mesh.shape["data"] == 8
+
+
+def test_make_mesh_2d(devices):
+    mesh = dist.make_mesh(("data", "model"), shape=(4, 2))
+    assert mesh.shape == {"data": 4, "model": 2}
+    with pytest.raises(ValueError):
+        dist.make_mesh(("data", "model"), shape=(3, 2))
+
+
+def test_spawn_single_inprocess():
+    out = []
+    spawn(lambda i, x: out.append((i, x)), args=(42,), nprocs=1)
+    assert out == [(0, 42)]
+
+
+def test_spawn_validates():
+    with pytest.raises(ValueError):
+        spawn(lambda i: None, nprocs=0)
+
+
+def test_barrier_single_process(devices):
+    dist.barrier()  # must not deadlock or raise in single-process mode

@@ -1,0 +1,117 @@
+"""DistributedSampler semantics tests (SURVEY.md §4 'unit').
+
+The contract (SURVEY.md §2b): pad to ceil(N/W)*W by repeating indices,
+stride indices[rank::W], reseed shuffle with seed+epoch.  Where behavior is
+deterministic (shuffle=False) we check *exact* equality against torch's
+DistributedSampler — the reference's actual dependency — using the baked-in
+CPU torch.
+"""
+
+import numpy as np
+import pytest
+
+from distributeddataparallel_tpu.parallel.sampler import (
+    DistributedSampler,
+    shard_indices_for_hosts,
+)
+
+
+def test_partition_exact_cover_no_shuffle():
+    N, W = 103, 8  # non-divisible on purpose
+    shards = [
+        list(DistributedSampler(N, num_replicas=W, rank=r, shuffle=False))
+        for r in range(W)
+    ]
+    lens = {len(s) for s in shards}
+    assert lens == {13}  # ceil(103/8)
+    flat = sorted(i for s in shards for i in s)
+    # covers all of range(N); padding repeats head indices
+    assert set(flat) == set(range(N))
+    assert len(flat) == 13 * 8
+
+
+@pytest.mark.parametrize("N,W", [(100, 4), (103, 8), (7, 8), (64, 8)])
+def test_matches_torch_no_shuffle(N, W):
+    torch = pytest.importorskip("torch")
+    from torch.utils.data import DistributedSampler as TorchSampler
+
+    class _DS(torch.utils.data.Dataset):
+        def __len__(self):
+            return N
+
+        def __getitem__(self, i):
+            return i
+
+    for rank in range(W):
+        ours = list(DistributedSampler(N, num_replicas=W, rank=rank, shuffle=False))
+        theirs = list(TorchSampler(_DS(), num_replicas=W, rank=rank, shuffle=False))
+        assert ours == theirs, f"rank {rank}: {ours} != {theirs}"
+
+
+@pytest.mark.parametrize("N,W", [(100, 4), (103, 8)])
+def test_matches_torch_drop_last(N, W):
+    torch = pytest.importorskip("torch")
+    from torch.utils.data import DistributedSampler as TorchSampler
+
+    class _DS(torch.utils.data.Dataset):
+        def __len__(self):
+            return N
+
+        def __getitem__(self, i):
+            return i
+
+    for rank in range(W):
+        ours = list(
+            DistributedSampler(N, num_replicas=W, rank=rank, shuffle=False, drop_last=True)
+        )
+        theirs = list(
+            TorchSampler(_DS(), num_replicas=W, rank=rank, shuffle=False, drop_last=True)
+        )
+        assert ours == theirs
+
+
+def test_shuffle_is_epoch_deterministic_partition():
+    N, W = 1000, 8
+    samplers = [DistributedSampler(N, num_replicas=W, rank=r, seed=42) for r in range(W)]
+    for epoch in (0, 1, 5):
+        for s in samplers:
+            s.set_epoch(epoch)
+        shards = [s.local_indices() for s in samplers]
+        # all shards equal length; union covers the dataset
+        assert all(len(sh) == 125 for sh in shards)
+        assert set(np.concatenate(shards).tolist()) == set(range(N))
+        # same epoch twice -> identical
+        again = [s.local_indices() for s in samplers]
+        for a, b in zip(shards, again):
+            np.testing.assert_array_equal(a, b)
+    # different epochs -> different order
+    samplers[0].set_epoch(0)
+    e0 = samplers[0].local_indices()
+    samplers[0].set_epoch(1)
+    e1 = samplers[0].local_indices()
+    assert not np.array_equal(e0, e1)
+
+
+def test_host_sharding_matches_per_replica_sampler():
+    N, hosts, per_host = 256, 2, 4
+    W = hosts * per_host
+    for h in range(hosts):
+        rows = shard_indices_for_hosts(
+            N, num_hosts=hosts, host_id=h, replicas_per_host=per_host,
+            epoch=3, seed=7,
+        )
+        for r in range(per_host):
+            s = DistributedSampler(N, num_replicas=W, rank=h * per_host + r, seed=7)
+            s.set_epoch(3)
+            np.testing.assert_array_equal(rows[r], s.local_indices())
+
+
+def test_small_dataset_wraps():
+    # dataset smaller than world size: every rank still gets 1 sample
+    shards = [
+        list(DistributedSampler(3, num_replicas=8, rank=r, shuffle=False))
+        for r in range(8)
+    ]
+    assert all(len(s) == 1 for s in shards)
+    # wrap order matches torch: [0,1,2] padded to [0,1,2,0,1,2,0,1]
+    assert [s[0] for s in shards] == [0, 1, 2, 0, 1, 2, 0, 1]

@@ -1,0 +1,163 @@
+"""The defining DDP invariant (SURVEY.md §4 'equivalence'): N-device DP with
+per-replica batch B must produce the SAME loss curve as single-device
+training with batch N×B — because averaged per-replica grads over equal
+shards equal the full-batch gradient.  Plus grad-accumulation boundary
+semantics (no_sync analog) and bucketed-psum equivalence at step level."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import PartitionSpec as P
+
+from distributeddataparallel_tpu.models.simple_cnn import TinyMLP
+from distributeddataparallel_tpu.ops.losses import cross_entropy_loss
+from distributeddataparallel_tpu.parallel.data_parallel import broadcast_params
+from distributeddataparallel_tpu.runtime.distributed import make_mesh
+from distributeddataparallel_tpu.training.state import TrainState
+from distributeddataparallel_tpu.training.train_step import make_train_step
+
+
+def _setup(lr=0.1, seed=0):
+    model = TinyMLP(features=(32,), num_classes=10)
+    params = model.init(jax.random.PRNGKey(seed), jnp.zeros((1, 8, 8, 1)))["params"]
+
+    def loss_fn(params, batch, rng):
+        logits = model.apply({"params": params}, batch["image"])
+        return cross_entropy_loss(logits, batch["label"]), {}
+
+    tx = optax.sgd(lr)
+    state = TrainState.create(apply_fn=model.apply, params=params, tx=tx)
+    return model, state, loss_fn
+
+
+def _fake_batches(num_steps, global_batch, seed=0):
+    rng = np.random.default_rng(seed)
+    # Class-conditional means so the task is learnable (loss can decrease).
+    protos = rng.normal(size=(10, 8, 8, 1)).astype(np.float32)
+    out = []
+    for _ in range(num_steps):
+        labels = rng.integers(0, 10, size=(global_batch,)).astype(np.int32)
+        images = protos[labels] + 0.5 * rng.normal(
+            size=(global_batch, 8, 8, 1)
+        ).astype(np.float32)
+        out.append({"image": images.astype(np.float32), "label": labels})
+    return out
+
+
+def _single_device_curve(state, loss_fn, batches):
+    """Reference curve: plain jit on one device, full global batch."""
+
+    @jax.jit
+    def step(state, batch):
+        (loss, _), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            state.params, batch, jax.random.PRNGKey(0)
+        )
+        return state.apply_gradients(grads), loss
+
+    losses = []
+    for b in batches:
+        state, loss = step(state, b)
+        losses.append(float(loss))
+    return losses, state
+
+
+def test_dp_equals_single_device(devices):
+    mesh = make_mesh(("data",))
+    n = mesh.shape["data"]
+    _, state, loss_fn = _setup()
+    batches = _fake_batches(10, global_batch=8 * n)
+
+    ref_losses, _ = _single_device_curve(state, loss_fn, batches)
+
+    dp_state = broadcast_params(state, mesh)
+    step_fn = make_train_step(loss_fn, mesh=mesh, donate=False)
+    from distributeddataparallel_tpu.data.loader import shard_batch
+
+    dp_losses = []
+    rng = jax.random.PRNGKey(0)
+    for b in batches:
+        dp_state, metrics = step_fn(dp_state, shard_batch(b, mesh), rng)
+        dp_losses.append(float(metrics["loss"]))
+
+    np.testing.assert_allclose(dp_losses, ref_losses, rtol=2e-4, atol=1e-5)
+    # loss actually decreased (training happened)
+    assert dp_losses[-1] < dp_losses[0]
+
+
+def test_grad_accum_matches_single_step(devices):
+    """accum_steps=4 over batch 4B == one step over batch 4B (same global
+    batch, sync only on the boundary — DDP no_sync semantics)."""
+    mesh = make_mesh(("data",))
+    n = mesh.shape["data"]
+    _, state, loss_fn = _setup()
+    batches = _fake_batches(6, global_batch=16 * n, seed=3)
+
+    from distributeddataparallel_tpu.data.loader import shard_batch
+
+    s1 = broadcast_params(state, mesh)
+    step1 = make_train_step(loss_fn, mesh=mesh, donate=False)
+    s4 = broadcast_params(state, mesh)
+    step4 = make_train_step(loss_fn, mesh=mesh, accum_steps=4, donate=False)
+
+    rng = jax.random.PRNGKey(0)
+    l1s, l4s = [], []
+    for b in batches:
+        sb = shard_batch(b, mesh)
+        s1, m1 = step1(s1, sb, rng)
+        s4, m4 = step4(s4, sb, rng)
+        l1s.append(float(m1["loss"]))
+        l4s.append(float(m4["loss"]))
+    np.testing.assert_allclose(l4s, l1s, rtol=2e-4, atol=1e-5)
+    p1 = jax.tree.leaves(s1.params)
+    p4 = jax.tree.leaves(s4.params)
+    for a, b in zip(p1, p4):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-5)
+
+
+def test_bucketed_step_matches_plain(devices):
+    mesh = make_mesh(("data",))
+    n = mesh.shape["data"]
+    _, state, loss_fn = _setup()
+    batches = _fake_batches(5, global_batch=8 * n, seed=7)
+    from distributeddataparallel_tpu.data.loader import shard_batch
+
+    sp = broadcast_params(state, mesh)
+    sb_ = broadcast_params(state, mesh)
+    plain = make_train_step(loss_fn, mesh=mesh, donate=False)
+    bucketed = make_train_step(loss_fn, mesh=mesh, bucket_bytes=4096, donate=False)
+    rng = jax.random.PRNGKey(1)
+    for b in batches:
+        x = shard_batch(b, mesh)
+        sp, mp = plain(sp, x, rng)
+        sb_, mb = bucketed(sb_, x, rng)
+        np.testing.assert_allclose(
+            float(mb["loss"]), float(mp["loss"]), rtol=1e-4
+        )
+
+
+def test_metrics_are_replicated_and_aux_flows(devices):
+    mesh = make_mesh(("data",))
+    model = TinyMLP(features=(16,), num_classes=10)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8, 8, 1)))["params"]
+
+    def loss_fn(params, batch, rng):
+        logits = model.apply({"params": params}, batch["image"])
+        from distributeddataparallel_tpu.ops.losses import accuracy
+
+        return cross_entropy_loss(logits, batch["label"]), {
+            "accuracy": accuracy(logits, batch["label"])
+        }
+
+    state = TrainState.create(
+        apply_fn=model.apply, params=params, tx=optax.sgd(0.1)
+    )
+    state = broadcast_params(state, mesh)
+    step = make_train_step(loss_fn, mesh=mesh, donate=False)
+    from distributeddataparallel_tpu.data.loader import shard_batch
+
+    b = _fake_batches(1, global_batch=8 * mesh.shape["data"])[0]
+    state, metrics = step(state, shard_batch(b, mesh), jax.random.PRNGKey(0))
+    assert set(metrics) == {"loss", "accuracy"}
+    assert metrics["loss"].sharding.is_fully_replicated
+    assert 0.0 <= float(metrics["accuracy"]) <= 1.0
