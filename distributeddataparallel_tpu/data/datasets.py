@@ -38,6 +38,10 @@ class ArrayDataset:
     def __getitem__(self, idx):
         return self.images[idx], self.labels[idx]
 
+    def arrays(self) -> dict:
+        """Columnar view for fast fancy-indexed batching (see data.loader)."""
+        return {"image": self.images, "label": self.labels}
+
 
 def normalize_images(images_u8: np.ndarray) -> np.ndarray:
     """uint8 HWC → float32 in [-1, 1]: ToTensor + Normalize((0.5,), (0.5,))
@@ -81,27 +85,37 @@ def _cifar_batch_files(root: str) -> list[str] | None:
         return [os.path.join(d, n) for n in names]
     tgz = os.path.join(root, "cifar-10-python.tar.gz")
     if os.path.exists(tgz):
-        # Extract once per host under a lock (fixes the ref's §2d.2 race).
-        lock = tgz + ".lock"
-        fd = None
-        try:
-            while True:
-                try:
-                    fd = os.open(lock, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
-                    break
-                except FileExistsError:
-                    import time
+        # Concurrent-safe extraction (fixes the ref's §2d.2 race) with no
+        # lock to leak or spin on: each process extracts into its own temp
+        # dir, then atomically renames the payload into place.  Losers of
+        # the rename see a complete dir — partially-written batch files are
+        # never visible under the final path.
+        import shutil
+        import tempfile
 
-                    time.sleep(0.1)
-                    if all(os.path.exists(os.path.join(d, n)) for n in names):
-                        return [os.path.join(d, n) for n in names]
-            if not all(os.path.exists(os.path.join(d, n)) for n in names):
-                with tarfile.open(tgz) as tf:
-                    tf.extractall(root)
+        tmp = tempfile.mkdtemp(dir=root, prefix=".cifar-extract-")
+        try:
+            with tarfile.open(tgz) as tf:
+                tf.extractall(tmp)
+            src = os.path.join(tmp, "cifar-10-batches-py")
+            try:
+                os.rename(src, d)
+            except OSError:
+                # d already exists: either a complete copy (a concurrent
+                # process won the rename) or a stale partial from an
+                # interrupted earlier run.  Repair the latter: move it
+                # aside and retry with our known-complete copy.
+                if not all(os.path.exists(os.path.join(d, n)) for n in names):
+                    broken = tempfile.mkdtemp(dir=root, prefix=".cifar-broken-")
+                    try:
+                        os.rename(d, os.path.join(broken, "partial"))
+                        os.rename(src, d)
+                    except OSError:
+                        pass  # lost a repair race; re-check below
+                    finally:
+                        shutil.rmtree(broken, ignore_errors=True)
         finally:
-            if fd is not None:
-                os.close(fd)
-                os.unlink(lock)
+            shutil.rmtree(tmp, ignore_errors=True)
         if all(os.path.exists(os.path.join(d, n)) for n in names):
             return [os.path.join(d, n) for n in names]
     return None

@@ -115,6 +115,25 @@ class DataLoader:
     def __len__(self) -> int:
         return self.steps_per_epoch
 
+    def _gather(self, idx: np.ndarray) -> Pytree:
+        """Materialize rows `idx` as a dict-of-arrays batch.
+
+        Fast path: datasets exposing ``arrays() -> dict[str, np.ndarray]``
+        (one fancy-index per column).  Fallback: the generic
+        ``__getitem__`` contract — items may be dicts (stacked per key) or
+        (image, label) tuples (the torch-Dataset-style pair, ref dpp.py:35).
+        """
+        arrays = getattr(self.dataset, "arrays", None)
+        if callable(arrays):
+            return {k: v[idx] for k, v in arrays().items()}
+        items = [self.dataset[int(i)] for i in idx]
+        if isinstance(items[0], dict):
+            return {k: np.stack([it[k] for it in items]) for k in items[0]}
+        return {
+            "image": np.stack([it[0] for it in items]),
+            "label": np.asarray([it[1] for it in items]),
+        }
+
     def _host_batches(self) -> Iterator[Pytree]:
         shards = [s.local_indices() for s in self._samplers]
         B = self.per_replica_batch
@@ -123,10 +142,7 @@ class DataLoader:
             for shard in shards:
                 idx = shard[step * B : (step + 1) * B]
                 rows.append(idx)
-            idx = np.concatenate(rows)
-            images = self.dataset.images[idx]
-            labels = self.dataset.labels[idx]
-            yield {"image": images, "label": labels}
+            yield self._gather(np.concatenate(rows))
 
     def __iter__(self) -> Iterator[Pytree]:
         it = self._host_batches()

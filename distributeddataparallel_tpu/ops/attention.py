@@ -1,0 +1,162 @@
+"""Attention ops: causal multi-head / grouped-query attention + RoPE.
+
+The reference has no attention model at all (image classifier only,
+ref dpp.py:11-18); these ops exist for the BASELINE LM configs (GPT-2 124M,
+Llama-3 8B — configs 4-5) and for the long-context path
+(``parallel.context_parallel`` ring attention reuses the same blockwise
+math).
+
+TPU-first design notes:
+
+- All matmuls are batched ``einsum``s that XLA tiles onto the MXU; softmax
+  and scaling fuse into the surrounding HLO.
+- Logits are computed in float32 even under bf16 activations (softmax
+  stability on the VPU), then cast back for the value matmul.
+- The causal mask is built with ``iota`` comparisons — no materialized
+  (S, S) boolean from Python, so the same code works under any jit/scan.
+- ``attention()`` dispatches between this XLA reference implementation and
+  the Pallas flash kernel (``ops.pallas_attention``) via ``impl=``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30  # softmax-safe -inf that survives bf16 casts
+
+
+def rope_frequencies(
+    head_dim: int, max_len: int, *, theta: float = 10000.0
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Precompute RoPE cos/sin tables of shape (max_len, head_dim // 2)."""
+    inv_freq = 1.0 / (
+        theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
+    )
+    t = jnp.arange(max_len, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv_freq)  # (max_len, head_dim/2)
+    return jnp.cos(freqs), jnp.sin(freqs)
+
+
+def apply_rope(
+    x: jnp.ndarray,
+    cos: jnp.ndarray,
+    sin: jnp.ndarray,
+    *,
+    positions: jnp.ndarray | None = None,
+) -> jnp.ndarray:
+    """Rotate query/key halves by position-dependent angles.
+
+    x: (B, S, H, D); cos/sin: (max_len, D/2); positions: (S,) or (B, S)
+    int positions into the tables (defaults to arange(S) — pass explicit
+    positions for sequence-parallel shards, where the local chunk starts at
+    a nonzero offset).
+    """
+    B, S, H, D = x.shape
+    if positions is None:
+        positions = jnp.arange(S)
+    c = cos[positions]  # (..., S, D/2)
+    s = sin[positions]
+    if c.ndim == 2:  # (S, D/2) -> broadcast over batch
+        c = c[None]
+        s = s[None]
+    c = c[:, :, None, :]  # (B|1, S, 1, D/2)
+    s = s[:, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    rotated = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+    return rotated.astype(x.dtype)
+
+
+def repeat_kv(x: jnp.ndarray, n_rep: int) -> jnp.ndarray:
+    """Expand KV heads for grouped-query attention: (B,S,Hkv,D) -> (B,S,Hkv*n,D)."""
+    if n_rep == 1:
+        return x
+    B, S, H, D = x.shape
+    return jnp.broadcast_to(
+        x[:, :, :, None, :], (B, S, H, n_rep, D)
+    ).reshape(B, S, H * n_rep, D)
+
+
+def causal_mask_bias(
+    q_len: int,
+    kv_len: int,
+    *,
+    q_offset: jnp.ndarray | int = 0,
+    kv_offset: jnp.ndarray | int = 0,
+    dtype=jnp.float32,
+) -> jnp.ndarray:
+    """(q_len, kv_len) additive bias: 0 where kv_pos <= q_pos, NEG_INF above.
+
+    Offsets give the *global* position of each chunk's first element, which
+    is what ring attention needs to mask cross-chunk blocks correctly.
+    """
+    q_pos = q_offset + jnp.arange(q_len)[:, None]
+    kv_pos = kv_offset + jnp.arange(kv_len)[None, :]
+    return jnp.where(kv_pos <= q_pos, 0.0, NEG_INF).astype(dtype)
+
+
+def dot_product_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    causal: bool = True,
+    bias: jnp.ndarray | None = None,
+) -> jnp.ndarray:
+    """XLA reference attention. q: (B,Sq,H,D); k/v: (B,Skv,H,D) -> (B,Sq,H,D).
+
+    Softmax in float32; matmuls in the input dtype (bf16 on TPU hits the
+    MXU; the f32 softmax runs on the VPU and fuses with the scale/mask).
+    """
+    *_, Sq, H, D = q.shape
+    Skv = k.shape[1]
+    scale = 1.0 / jnp.sqrt(jnp.asarray(D, jnp.float32))
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        # Sq != Skv (decode / chunked queries): queries are the LAST Sq
+        # positions of the kv sequence, so a 1-token query sees everything.
+        logits = logits + causal_mask_bias(Sq, Skv, q_offset=Skv - Sq)[None, None]
+    if bias is not None:
+        logits = logits + bias.astype(jnp.float32)
+    weights = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", weights, v)
+
+
+def attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    causal: bool = True,
+    impl: str = "auto",
+) -> jnp.ndarray:
+    """Dispatch: 'xla' reference, 'pallas' flash kernel, or 'auto'.
+
+    'auto' uses the Pallas flash kernel on TPU when shapes are block-aligned
+    and falls back to the XLA implementation elsewhere (CPU tests, odd
+    shapes).
+    """
+    if impl not in ("auto", "xla", "pallas"):
+        raise ValueError(f"unknown attention impl {impl!r}")
+    if impl in ("auto", "pallas"):
+        from distributeddataparallel_tpu.ops import pallas_attention
+
+        if pallas_attention.supported(q, k, v):
+            try:
+                return pallas_attention.flash_attention(q, k, v, causal=causal)
+            except Exception:
+                if impl == "pallas":
+                    raise
+                import logging
+
+                logging.getLogger("ddp_tpu").warning(
+                    "pallas flash attention failed for q=%s kv=%s; falling "
+                    "back to the O(S^2) XLA path (perf/memory hit)",
+                    q.shape, k.shape, exc_info=True,
+                )
+        elif impl == "pallas":
+            raise ValueError(
+                f"pallas flash attention unsupported for shapes "
+                f"q={q.shape} k={k.shape} on {jax.default_backend()}"
+            )
+    return dot_product_attention(q, k, v, causal=causal)

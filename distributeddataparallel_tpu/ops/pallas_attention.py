@@ -1,0 +1,211 @@
+"""Pallas TPU flash attention — blockwise causal attention kernel.
+
+The reference reaches its attention-free compute through cuDNN kernels
+(ref dpp.py:14 via torchvision); this is the framework's own TPU kernel for
+the LM configs (BASELINE 4-5), written against the Pallas TPU guide
+(/opt/skills/guides/pallas_guide.md):
+
+- Grid (batch*heads, q_blocks, kv_blocks), kv innermost; q/k/v tiles are
+  DMA'd HBM→VMEM by BlockSpec, matmuls hit the MXU with
+  ``preferred_element_type=float32``.
+- Online softmax: VMEM scratch carries the running max ``m``, normalizer
+  ``l``, and f32 accumulator across kv blocks, so the (S, S) score matrix
+  is never materialized — O(S) memory instead of O(S²).
+- Causal blocks strictly above the diagonal are skipped with ``pl.when``
+  (predicated off — no MXU work, no DMA dependency stalls).
+- Backward: ``custom_vjp`` saving (q, k, v, out, lse); gradients use the
+  standard flash-attention identities with the saved log-sum-exp.  The
+  backward materializes per-(batch,head) probability tiles in XLA (exact,
+  O(S²) there) — the blockwise backward kernel is the known next step;
+  forward is where flash wins first on TPU (VMEM fit for long S).
+
+CPU tests run the same kernel under ``interpret=True``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from distributeddataparallel_tpu.ops.attention import NEG_INF, causal_mask_bias
+
+
+def _pick_block(s: int, preferred: tuple[int, ...] = (512, 256, 128)) -> int | None:
+    for b in preferred:
+        if s % b == 0 and s >= b:
+            return b
+    return None
+
+
+def supported(q, k, v) -> bool:
+    """True when the flash kernel can run natively on this backend/shapes."""
+    if jax.default_backend() != "tpu":
+        return False
+    B, Sq, H, D = q.shape
+    Skv = k.shape[1]
+    if k.shape[2] != H or v.shape != k.shape:
+        return False  # GQA callers must repeat_kv first
+    return (
+        _pick_block(Sq) is not None
+        and _pick_block(Skv) is not None
+        and D % 8 == 0
+        and D <= 256
+    )
+
+
+def _flash_kernel(
+    q_ref, k_ref, v_ref,  # (1, BQ, D), (1, BK, D), (1, BK, D)
+    o_ref,                # (1, BQ, D)
+    lse_ref,              # (1, 8, BQ) — lse broadcast over 8 sublanes to
+                          # satisfy the TPU (8, 128) block-tiling minimum
+    m_ref, l_ref, acc_ref,  # VMEM scratch: (BQ, 128), (BQ, 128), (BQ, D)
+    *, causal: bool, block_q: int, block_k: int, scale: float, q_offset: int,
+):
+    i = pl.program_id(1)  # q block index
+    j = pl.program_id(2)  # kv block index
+    nj = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # Causal: block is live unless it sits strictly above the diagonal.
+    # q_offset aligns query rows to the END of the kv sequence (Sq != Skv).
+    q_last = q_offset + i * block_q + block_q - 1
+    k_first = j * block_k
+    live = (not causal) or (k_first <= q_last)
+
+    @pl.when(live)
+    def _body():
+        q = q_ref[0]  # (BQ, D)
+        k = k_ref[0]  # (BK, D)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # (BQ, BK)
+        if causal:
+            q_pos = q_offset + i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            k_pos = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(k_pos <= q_pos, s, NEG_INF)
+
+        m_prev = m_ref[:, 0]                      # (BQ,)
+        m_cur = jnp.max(s, axis=1)                # (BQ,)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new[:, None])           # (BQ, BK)
+        correction = jnp.exp(m_prev - m_new)      # (BQ,)
+        l_new = correction * l_ref[:, 0] + jnp.sum(p, axis=1)
+        acc_ref[:] = acc_ref[:] * correction[:, None] + jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_ref[:] = jnp.broadcast_to(m_new[:, None], m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new[:, None], l_ref.shape)
+
+    @pl.when(j == nj - 1)
+    def _finish():
+        l = l_ref[:, 0]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_ref[:] / l_safe[:, None]).astype(o_ref.dtype)
+        lse = m_ref[:, 0] + jnp.log(l_safe)  # (BQ,)
+        lse_ref[0] = jnp.broadcast_to(lse[None, :], lse_ref.shape[1:])
+
+
+def _flash_fwd_impl(q, k, v, *, causal: bool, interpret: bool):
+    B, Sq, H, D = q.shape
+    Skv = k.shape[1]
+    block_q = _pick_block(Sq)
+    block_k = _pick_block(Skv)
+    if block_q is None or block_k is None:
+        raise ValueError(f"seq lens ({Sq}, {Skv}) not divisible by 128")
+    scale = 1.0 / (D ** 0.5)
+
+    # (B, S, H, D) -> (B*H, S, D): one grid row per (batch, head).
+    qf = q.transpose(0, 2, 1, 3).reshape(B * H, Sq, D)
+    kf = k.transpose(0, 2, 1, 3).reshape(B * H, Skv, D)
+    vf = v.transpose(0, 2, 1, 3).reshape(B * H, Skv, D)
+
+    grid = (B * H, Sq // block_q, Skv // block_k)
+    kernel = functools.partial(
+        _flash_kernel,
+        causal=causal, block_q=block_q, block_k=block_k, scale=scale,
+        q_offset=Skv - Sq,
+    )
+    from jax.experimental.pallas import tpu as pltpu
+
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, 8, block_q), lambda b, i, j: (b, 0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, Sq, D), q.dtype),
+            jax.ShapeDtypeStruct((B * H, 8, Sq), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, D), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf)
+    out = out.reshape(B, H, Sq, D).transpose(0, 2, 1, 3)
+    return out, lse[:, 0, :]  # lse flat (B*H, Sq) for the backward
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def flash_attention(q, k, v, causal: bool = True, interpret: bool = False):
+    """Flash attention: q,k,v (B,S,H,D) -> (B,S,H,D), causal by default."""
+    out, _ = _flash_fwd_impl(q, k, v, causal=causal, interpret=interpret)
+    return out
+
+
+def _fwd(q, k, v, causal, interpret):
+    out, lse = _flash_fwd_impl(q, k, v, causal=causal, interpret=interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _bwd(causal, interpret, res, do):
+    q, k, v, out, lse = res
+    B, Sq, H, D = q.shape
+    Skv = k.shape[1]
+    scale = 1.0 / (D ** 0.5)
+    # Exact gradients from saved lse (flash-attention identities):
+    #   p   = exp(s - lse);  dv = pᵀ do
+    #   dp  = do vᵀ;         ds = p * (dp - rowsum(do * out))
+    #   dq  = ds k * scale;  dk = dsᵀ q * scale
+    qf = q.astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    dof = do.astype(jnp.float32)
+    s = jnp.einsum("bqhd,bkhd->bhqk", qf, kf) * scale
+    if causal:
+        # Same decode-offset convention as the forward kernel, via the one
+        # shared mask helper.
+        s = s + causal_mask_bias(Sq, Skv, q_offset=Skv - Sq)[None, None]
+    p = jnp.exp(s - lse.reshape(B, H, Sq)[..., None])
+    dv = jnp.einsum("bhqk,bqhd->bkhd", p, dof)
+    dp = jnp.einsum("bqhd,bkhd->bhqk", dof, vf)
+    delta = jnp.sum(dof * out.astype(jnp.float32), axis=-1)  # (B, Sq, H)
+    ds = p * (dp - delta.transpose(0, 2, 1)[..., None])
+    dq = jnp.einsum("bhqk,bkhd->bqhd", ds, kf) * scale
+    dk = jnp.einsum("bhqk,bqhd->bkhd", ds, qf) * scale
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+flash_attention.defvjp(_fwd, _bwd)

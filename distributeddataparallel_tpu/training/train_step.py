@@ -117,19 +117,23 @@ def make_train_step(
                 acc_grads = jax.tree.map(jnp.add, acc_grads, g)
                 return (acc_grads, acc_loss + l, jax.tree.map(jnp.add, acc_aux, a), ms), None
 
-            # Seed the scan carry with the first microbatch's grads/aux (so
-            # the aux tree structure is known without a separate probe).
+            # Zero-initialized carry with structure from eval_shape (no
+            # second trace of the model: the fwd+bwd is compiled once, in
+            # the scan body).
             first_mb = jax.tree.map(lambda x: x[0], micro)
-            l0, a0, ms0, g0 = _micro(
-                state.params, state.model_state, first_mb,
-                jax.random.fold_in(rng, 0),
+            l_s, a_s, _, g_s = jax.eval_shape(
+                _micro, state.params, state.model_state, first_mb, rng
             )
-            rest = jax.tree.map(lambda x: x[1:], micro)
+            zeros = lambda t: jax.tree.map(
+                lambda s: jnp.zeros(s.shape, s.dtype), t
+            )
             rngs = jax.vmap(lambda i: jax.random.fold_in(rng, i))(
-                jnp.arange(1, accum_steps)
+                jnp.arange(accum_steps)
             )
             (grads, loss, aux, new_ms), _ = lax.scan(
-                body, (g0, l0, a0, ms0), (rest, rngs)
+                body,
+                (zeros(g_s), zeros(l_s), zeros(a_s), state.model_state),
+                (micro, rngs),
             )
             inv = 1.0 / accum_steps
             grads = jax.tree.map(lambda g: g * inv, grads)

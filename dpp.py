@@ -220,9 +220,12 @@ def train(args) -> float:
                     "accuracy": accuracy(logits, batch["label"]),
                 }
         eval_step = make_eval_step(metric_fn, mesh=mesh, with_model_state=has_ms)
+        # drop_last=False: evaluation must cover the tail of the eval set
+        # (sampler padding keeps per-replica counts equal, so the one
+        # ragged final batch still shards evenly — worth the extra compile).
         eval_loader = DataLoader(
             build_dataset(args, train=False), per_replica_batch=args.batch_size,
-            mesh=mesh, shuffle=False, seed=args.seed,
+            mesh=mesh, shuffle=False, seed=args.seed, drop_last=False,
         )
 
     if len(loader) == 0:
@@ -250,17 +253,21 @@ def train(args) -> float:
             jax.profiler.stop_trace()
         last_loss = float(metrics["loss"])
         if eval_step is not None:
-            if has_ms:
-                evals = [
+            evals = []
+            for b in eval_loader:
+                m = (
                     eval_step(state.params, state.model_state, b)
-                    for b in eval_loader
-                ]
-            else:
-                evals = [eval_step(state.params, b) for b in eval_loader]
+                    if has_ms
+                    else eval_step(state.params, b)
+                )
+                # Weight by global row count: the ragged final batch
+                # (drop_last=False) must not over-weight its samples.
+                evals.append((m, jax.tree.leaves(b)[0].shape[0]))
             if evals:
+                total = sum(n for _, n in evals)
                 mean = {
-                    k: float(sum(float(e[k]) for e in evals) / len(evals))
-                    for k in evals[0]
+                    k: float(sum(float(e[k]) * n for e, n in evals) / total)
+                    for k in evals[0][0]
                 }
                 log0("Epoch %d eval: %s", epoch, mean)
         if ckpt is not None:

@@ -1,0 +1,155 @@
+"""Attention op tests: RoPE properties, causal masking, GQA expansion, and
+the Pallas flash kernel vs the XLA reference (interpret mode on CPU)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributeddataparallel_tpu.ops.attention import (
+    apply_rope,
+    causal_mask_bias,
+    dot_product_attention,
+    repeat_kv,
+    rope_frequencies,
+)
+from distributeddataparallel_tpu.ops import pallas_attention
+
+
+def _qkv(key, B=2, S=16, H=4, D=8, Hkv=None, dtype=jnp.float32):
+    kq, kk, kv = jax.random.split(key, 3)
+    Hkv = Hkv or H
+    q = jax.random.normal(kq, (B, S, H, D), dtype)
+    k = jax.random.normal(kk, (B, S, Hkv, D), dtype)
+    v = jax.random.normal(kv, (B, S, Hkv, D), dtype)
+    return q, k, v
+
+
+def test_causal_masking_blocks_future():
+    """Perturbing a future token must not change earlier outputs."""
+    q, k, v = _qkv(jax.random.PRNGKey(0))
+    out = dot_product_attention(q, k, v, causal=True)
+    k2 = k.at[:, -1].add(100.0)
+    v2 = v.at[:, -1].add(100.0)
+    out2 = dot_product_attention(q, k2, v2, causal=True)
+    np.testing.assert_allclose(out[:, :-1], out2[:, :-1], atol=1e-5)
+    assert not np.allclose(out[:, -1], out2[:, -1])
+
+
+def test_attention_matches_manual_softmax():
+    q, k, v = _qkv(jax.random.PRNGKey(1), B=1, S=6, H=2, D=4)
+    out = dot_product_attention(q, k, v, causal=False)
+    logits = np.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(4)
+    w = jax.nn.softmax(jnp.asarray(logits), axis=-1)
+    expected = np.einsum("bhqk,bkhd->bqhd", w, v)
+    np.testing.assert_allclose(out, expected, atol=1e-5)
+
+
+def test_causal_mask_bias_offsets():
+    # Chunk at global q offset 4 attending to kv chunk at offset 0: all visible.
+    bias = causal_mask_bias(4, 4, q_offset=4, kv_offset=0)
+    assert (bias == 0).all()
+    # kv chunk strictly in the future: all masked.
+    bias = causal_mask_bias(4, 4, q_offset=0, kv_offset=4)
+    assert (bias < -1e29).all()
+    # Diagonal chunk: lower triangle visible.
+    bias = causal_mask_bias(4, 4, q_offset=0, kv_offset=0)
+    expected = np.where(np.tril(np.ones((4, 4))), 0, -1e30).astype(np.float32)
+    assert (np.asarray(bias) == expected).all()
+
+
+def test_rope_preserves_norm_and_relative_phase():
+    cos, sin = rope_frequencies(8, 32)
+    x = jax.random.normal(jax.random.PRNGKey(2), (1, 16, 2, 8))
+    rx = apply_rope(x, cos, sin)
+    np.testing.assert_allclose(
+        np.linalg.norm(np.asarray(x), axis=-1),
+        np.linalg.norm(np.asarray(rx), axis=-1),
+        rtol=1e-5,
+    )
+    # Rotation at position 0 is the identity.
+    np.testing.assert_allclose(rx[:, 0], x[:, 0], atol=1e-6)
+
+
+def test_rope_relative_position_invariance():
+    """q·k after RoPE depends only on relative distance."""
+    cos, sin = rope_frequencies(8, 64)
+    q = jax.random.normal(jax.random.PRNGKey(3), (1, 1, 1, 8))
+    k = jax.random.normal(jax.random.PRNGKey(4), (1, 1, 1, 8))
+
+    def dot_at(pq, pk):
+        rq = apply_rope(q, cos, sin, positions=jnp.array([pq]))
+        rk = apply_rope(k, cos, sin, positions=jnp.array([pk]))
+        return float(jnp.sum(rq * rk))
+
+    assert dot_at(5, 3) == pytest.approx(dot_at(12, 10), rel=1e-5)
+    assert dot_at(5, 3) != pytest.approx(dot_at(5, 4), rel=1e-3)
+
+
+def test_rope_explicit_positions_match_offset_slice():
+    """RoPE on a shard with explicit positions == slice of full-seq RoPE
+    (the property sequence-parallel shards rely on)."""
+    cos, sin = rope_frequencies(8, 64)
+    x = jax.random.normal(jax.random.PRNGKey(5), (1, 16, 2, 8))
+    full = apply_rope(x, cos, sin)
+    shard = apply_rope(x[:, 8:], cos, sin, positions=jnp.arange(8, 16))
+    np.testing.assert_allclose(full[:, 8:], shard, atol=1e-6)
+
+
+def test_repeat_kv_gqa():
+    x = jax.random.normal(jax.random.PRNGKey(6), (2, 4, 2, 8))
+    r = repeat_kv(x, 3)
+    assert r.shape == (2, 4, 6, 8)
+    np.testing.assert_allclose(r[:, :, 0], x[:, :, 0])
+    np.testing.assert_allclose(r[:, :, 2], x[:, :, 0])
+    np.testing.assert_allclose(r[:, :, 3], x[:, :, 1])
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_attention_matches_reference(causal):
+    q, k, v = _qkv(jax.random.PRNGKey(7), B=2, S=256, H=2, D=16)
+    ref = dot_product_attention(q, k, v, causal=causal)
+    out = pallas_attention.flash_attention(q, k, v, causal, True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_flash_attention_grads_match_reference():
+    q, k, v = _qkv(jax.random.PRNGKey(8), B=1, S=128, H=2, D=16)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(pallas_attention.flash_attention(q, k, v, True, True) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(dot_product_attention(q, k, v, causal=True) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+
+def test_causal_decode_shapes_see_full_context():
+    """Sq != Skv: queries align to the END of the kv sequence, so a 1-token
+    query attends over the whole cache (not just position 0)."""
+    q, k, v = _qkv(jax.random.PRNGKey(9), B=1, S=8, H=2, D=4)
+    full = dot_product_attention(q, k, v, causal=True)
+    last = dot_product_attention(q[:, -1:], k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(last), np.asarray(full[:, -1:]), atol=1e-5)
+
+
+def test_flash_causal_decode_shapes():
+    q, k, v = _qkv(jax.random.PRNGKey(10), B=1, S=256, H=2, D=16)
+    full = pallas_attention.flash_attention(q, k, v, True, True)
+    half = pallas_attention.flash_attention(q[:, 128:], k, v, True, True)
+    np.testing.assert_allclose(
+        np.asarray(half), np.asarray(full[:, 128:]), atol=2e-5
+    )
+
+
+def test_flash_supported_gating():
+    q = jnp.zeros((1, 256, 2, 16))
+    # CPU backend in tests → native kernel not supported (interpret only).
+    assert not pallas_attention.supported(q, q, q)
+    assert pallas_attention._pick_block(256) == 256
+    assert pallas_attention._pick_block(384) == 128
+    assert pallas_attention._pick_block(100) is None
