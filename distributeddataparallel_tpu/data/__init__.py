@@ -1,6 +1,7 @@
 from distributeddataparallel_tpu.data.datasets import (  # noqa: F401
     ArrayDataset,
     SyntheticClassification,
+    SyntheticLM,
     load_cifar10,
 )
 from distributeddataparallel_tpu.data.loader import DataLoader, shard_batch  # noqa: F401

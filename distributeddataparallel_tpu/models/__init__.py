@@ -6,3 +6,10 @@ from distributeddataparallel_tpu.models.resnet import (  # noqa: F401
     ResNet50,
     ResNet101,
 )
+from distributeddataparallel_tpu.models.transformer import (  # noqa: F401
+    TransformerConfig,
+    TransformerLM,
+    gpt2_124m,
+    llama3_8b,
+    tiny_lm,
+)

@@ -1,0 +1,279 @@
+"""Decoder-only transformer LM family: one stack, GPT-2 and Llama configs.
+
+The reference's model layer is a torchvision ResNet (ref dpp.py:11-18);
+the LM models here exist for BASELINE configs 4 (GPT-2 124M pure DP) and
+5 (Llama-3 8B, grad accumulation + overlapped all-reduce).  One
+``TransformerLM`` covers both families through ``TransformerConfig``:
+
+==============  =====================  =========================
+feature         GPT-2                  Llama-3
+==============  =====================  =========================
+norm            LayerNorm (pre-LN)     RMSNorm
+positional      learned embeddings     RoPE (theta 500000)
+MLP             GELU, 4×d              SwiGLU, 3 mats
+attention       MHA                    GQA (8 kv heads)
+embeddings      tied in/out            untied
+==============  =====================  =========================
+
+TPU-first choices:
+
+- bf16 activations/matmuls (MXU), f32 norms/softmax/logits (VPU);
+  params stay f32 (optimizer math), cast per-use.
+- ``scan_layers``: homogeneous blocks run under ``flax.linen.scan`` — one
+  layer trace instead of L, an order-of-magnitude compile-time cut for the
+  32-layer 8B config.
+- ``remat``: per-block ``nn.remat`` (checkpoint) trades recompute for HBM,
+  required to fit 8B pure-DP per chip (SURVEY.md §7 hard-part 3).
+- attention dispatches through ``ops.attention.attention`` (Pallas flash
+  kernel on TPU when shapes allow, XLA reference otherwise).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from distributeddataparallel_tpu.ops.attention import (
+    apply_rope,
+    attention,
+    repeat_kv,
+    rope_frequencies,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int
+    num_layers: int
+    num_heads: int
+    d_model: int
+    d_ff: int
+    max_seq_len: int
+    num_kv_heads: int | None = None  # None -> MHA (= num_heads)
+    head_dim: int | None = None      # None -> d_model // num_heads
+    norm: str = "layernorm"          # "layernorm" | "rmsnorm"
+    activation: str = "gelu"         # "gelu" | "swiglu"
+    positional: str = "learned"      # "learned" | "rope"
+    rope_theta: float = 10000.0
+    tie_embeddings: bool = True
+    dtype: Any = jnp.float32         # activation/matmul dtype
+    remat: bool = False
+    scan_layers: bool = False
+    attn_impl: str = "auto"          # "auto" | "xla" | "pallas"
+    dropout_rate: float = 0.0        # residual-branch dropout (GPT-2 style)
+    use_bias: bool = True            # proj biases: GPT-2 yes, Llama no
+
+    @property
+    def kv_heads(self) -> int:
+        return self.num_kv_heads or self.num_heads
+
+    @property
+    def dims_per_head(self) -> int:
+        return self.head_dim or self.d_model // self.num_heads
+
+
+# --- Named configs (sizes per the public GPT-2 / Llama-3 papers) ---------
+
+def gpt2_124m(**overrides) -> TransformerConfig:
+    """GPT-2 small: 12L/12H/768d, 4×d GELU MLP, 50257 vocab, tied embs."""
+    base = dict(
+        vocab_size=50257, num_layers=12, num_heads=12, d_model=768,
+        d_ff=3072, max_seq_len=1024, norm="layernorm", activation="gelu",
+        positional="learned", tie_embeddings=True,
+    )
+    base.update(overrides)
+    return TransformerConfig(**base)
+
+
+def llama3_8b(**overrides) -> TransformerConfig:
+    """Llama-3 8B: 32L/32H(8kv)/4096d, 14336 SwiGLU, 128256 vocab, RoPE."""
+    base = dict(
+        vocab_size=128256, num_layers=32, num_heads=32, num_kv_heads=8,
+        d_model=4096, d_ff=14336, max_seq_len=8192, norm="rmsnorm",
+        activation="swiglu", positional="rope", rope_theta=500000.0,
+        tie_embeddings=False, dtype=jnp.bfloat16, remat=True,
+        scan_layers=True, use_bias=False,
+    )
+    base.update(overrides)
+    return TransformerConfig(**base)
+
+
+def tiny_lm(**overrides) -> TransformerConfig:
+    """Test-sized config (fast CPU init/compile)."""
+    base = dict(
+        vocab_size=256, num_layers=2, num_heads=2, d_model=32, d_ff=64,
+        max_seq_len=128, norm="rmsnorm", activation="swiglu",
+        positional="rope", tie_embeddings=True,
+    )
+    base.update(overrides)
+    return TransformerConfig(**base)
+
+
+class RMSNorm(nn.Module):
+    """Llama-style RMS normalization; stats in f32, scale param f32."""
+
+    epsilon: float = 1e-5
+
+    @nn.compact
+    def __call__(self, x):
+        dtype = x.dtype
+        x = x.astype(jnp.float32)
+        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],))
+        x = x * jax.lax.rsqrt(jnp.mean(x * x, axis=-1, keepdims=True) + self.epsilon)
+        return (x * scale).astype(dtype)
+
+
+def _make_norm(cfg: TransformerConfig, name: str):
+    if cfg.norm == "rmsnorm":
+        return RMSNorm(name=name)
+    # LayerNorm math in f32 regardless of activation dtype.
+    return nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32, name=name)
+
+
+class Attention(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, *, positions=None, deterministic=True):
+        cfg = self.cfg
+        B, S, _ = x.shape
+        H, Hkv, D = cfg.num_heads, cfg.kv_heads, cfg.dims_per_head
+        dense = lambda feats, name: nn.DenseGeneral(
+            feats, axis=-1, dtype=cfg.dtype, name=name, use_bias=cfg.use_bias,
+            kernel_init=nn.initializers.normal(0.02),
+        )
+        q = dense((H, D), "q_proj")(x)
+        k = dense((Hkv, D), "k_proj")(x)
+        v = dense((Hkv, D), "v_proj")(x)
+        if cfg.positional == "rope":
+            cos, sin = rope_frequencies(D, cfg.max_seq_len, theta=cfg.rope_theta)
+            q = apply_rope(q, cos, sin, positions=positions)
+            k = apply_rope(k, cos, sin, positions=positions)
+        k = repeat_kv(k, H // Hkv)
+        v = repeat_kv(v, H // Hkv)
+        out = attention(q, k, v, causal=True, impl=cfg.attn_impl)
+        out = nn.DenseGeneral(
+            cfg.d_model, axis=(-2, -1), dtype=cfg.dtype, name="o_proj",
+            use_bias=cfg.use_bias,
+            kernel_init=nn.initializers.normal(0.02 / (2 * cfg.num_layers) ** 0.5),
+        )(out)
+        return out
+
+
+class MLP(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        dense = lambda feats, name: nn.Dense(
+            feats, dtype=cfg.dtype, name=name, use_bias=cfg.use_bias,
+            kernel_init=nn.initializers.normal(0.02),
+        )
+        if cfg.activation == "swiglu":
+            gate = dense(cfg.d_ff, "gate_proj")(x)
+            up = dense(cfg.d_ff, "up_proj")(x)
+            h = nn.silu(gate) * up
+        elif cfg.activation == "gelu":
+            h = nn.gelu(dense(cfg.d_ff, "up_proj")(x), approximate=True)
+        else:
+            raise ValueError(f"unknown activation {cfg.activation!r}")
+        return dense(cfg.d_model, "down_proj")(h)
+
+
+class DecoderBlock(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, positions=None, deterministic=True):
+        cfg = self.cfg
+        drop = nn.Dropout(cfg.dropout_rate, deterministic=deterministic)
+        y = _make_norm(cfg, "attn_norm")(x)
+        x = x + drop(
+            Attention(cfg, name="attn")(
+                y, positions=positions, deterministic=deterministic
+            )
+        )
+        y = _make_norm(cfg, "mlp_norm")(x)
+        x = x + drop(MLP(cfg, name="mlp")(y))
+        return x
+
+
+class _ScanBlock(nn.Module):
+    """DecoderBlock adapted to linen.scan's (carry, *broadcast) shape."""
+
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, positions, deterministic):
+        x = DecoderBlock(self.cfg, name="block")(x, positions, deterministic)
+        return x, None
+
+
+class TransformerLM(nn.Module):
+    """Decoder-only LM: tokens (B, S) int32 -> logits (B, S, vocab) f32."""
+
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, tokens, *, positions=None, deterministic=True):
+        cfg = self.cfg
+        B, S = tokens.shape
+        if S > cfg.max_seq_len:
+            raise ValueError(f"seq len {S} > max_seq_len {cfg.max_seq_len}")
+        embed = nn.Embed(
+            cfg.vocab_size, cfg.d_model, name="token_embed",
+            embedding_init=nn.initializers.normal(0.02),
+            param_dtype=jnp.float32,
+        )
+        x = embed(tokens).astype(cfg.dtype)
+        if cfg.positional == "learned":
+            pos = positions if positions is not None else jnp.arange(S)
+            pos_embed = self.param(
+                "pos_embed", nn.initializers.normal(0.02),
+                (cfg.max_seq_len, cfg.d_model), jnp.float32,
+            )
+            x = x + pos_embed[pos].astype(cfg.dtype)
+        x = nn.Dropout(cfg.dropout_rate, deterministic=deterministic)(x)
+
+        if cfg.scan_layers:
+            # One traced layer instead of L (compile time); under scan,
+            # remat wraps the scan body (prevent_cse must be False there).
+            scan_block = (
+                nn.remat(_ScanBlock, prevent_cse=False, static_argnums=(3,))
+                if cfg.remat
+                else _ScanBlock
+            )
+            x, _ = nn.scan(
+                scan_block,
+                variable_axes={"params": 0},
+                split_rngs={"params": True, "dropout": True},
+                in_axes=(nn.broadcast, nn.broadcast),
+                length=cfg.num_layers,
+                metadata_params={nn.PARTITION_NAME: "layers"},
+            )(cfg, name="layers")(x, positions, deterministic)
+        else:
+            block_cls = (
+                nn.remat(DecoderBlock, static_argnums=(3,))
+                if cfg.remat
+                else DecoderBlock
+            )
+            for i in range(cfg.num_layers):
+                x = block_cls(cfg, name=f"layer_{i}")(
+                    x, positions, deterministic
+                )
+
+        x = _make_norm(cfg, "final_norm")(x)
+        # Logits in f32 (loss precision; analog of the ResNet head rule).
+        if cfg.tie_embeddings:
+            logits = x.astype(jnp.float32) @ embed.embedding.T.astype(jnp.float32)
+        else:
+            logits = nn.Dense(
+                cfg.vocab_size, dtype=jnp.float32, use_bias=False,
+                kernel_init=nn.initializers.normal(0.02), name="lm_head",
+            )(x.astype(jnp.float32))
+        return logits

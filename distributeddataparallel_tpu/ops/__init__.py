@@ -1,4 +1,11 @@
 from distributeddataparallel_tpu.ops.losses import (  # noqa: F401
     cross_entropy_loss,
     accuracy,
+    lm_cross_entropy,
+)
+from distributeddataparallel_tpu.ops.attention import (  # noqa: F401
+    attention,
+    dot_product_attention,
+    apply_rope,
+    rope_frequencies,
 )

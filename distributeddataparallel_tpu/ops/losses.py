@@ -19,3 +19,21 @@ def cross_entropy_loss(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
 
 def accuracy(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
     return (jnp.argmax(logits, axis=-1) == labels).mean()
+
+
+def lm_cross_entropy(
+    logits: jnp.ndarray,
+    targets: jnp.ndarray,
+    mask: jnp.ndarray | None = None,
+) -> jnp.ndarray:
+    """Next-token CE for LMs: logits (B, S, V), targets (B, S) int.
+
+    ``mask`` (B, S) in {0,1} excludes padding positions; mean is over
+    unmasked tokens so per-batch loss is comparable across packing.
+    """
+    logits = logits.astype(jnp.float32)
+    ce = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
+    if mask is None:
+        return ce.mean()
+    mask = mask.astype(jnp.float32)
+    return (ce * mask).sum() / jnp.maximum(mask.sum(), 1.0)

@@ -34,8 +34,18 @@ def parse_args(argv=None):
     p.add_argument("--model", default="cnn",
                    choices=["mlp", "cnn", "resnet18", "resnet50", "gpt2", "llama"],
                    help="model family (resnet18 matches the reference)")
-    p.add_argument("--dataset", default="synthetic",
-                   choices=["synthetic", "cifar10"])
+    p.add_argument("--dataset", default=None,
+                   choices=["synthetic", "cifar10", "synthetic-lm"],
+                   help="default: synthetic-lm for --model gpt2/llama, "
+                        "synthetic otherwise")
+    p.add_argument("--seq-len", type=int, default=128,
+                   help="LM sequence length")
+    p.add_argument("--vocab-size", type=int, default=256,
+                   help="LM vocab size (synthetic data; real data overrides)")
+    p.add_argument("--layers", type=int, default=None,
+                   help="override the model family's layer count")
+    p.add_argument("--d-model", type=int, default=None,
+                   help="override the model family's width")
     p.add_argument("--data-root", default="data")
     p.add_argument("--epochs", type=int, default=5)          # ref dpp.py:27
     p.add_argument("--batch-size", type=int, default=32,     # ref dpp.py:35
@@ -102,7 +112,26 @@ def setup(args):
     return ddp.make_mesh(("data",))
 
 
-def build_model(args, num_classes: int = 10):
+def is_lm(args) -> bool:
+    return args.model in ("gpt2", "llama")
+
+
+def validate_args(args) -> None:
+    if args.dataset is None:
+        args.dataset = "synthetic-lm" if is_lm(args) else "synthetic"
+    if is_lm(args) and args.dataset in ("cifar10", "synthetic"):
+        raise SystemExit(
+            f"--model {args.model} is a language model; it trains on "
+            f"--dataset synthetic-lm (got {args.dataset!r})"
+        )
+    if not is_lm(args) and args.dataset == "synthetic-lm":
+        raise SystemExit(
+            f"--dataset synthetic-lm requires an LM model "
+            f"(--model gpt2|llama), got --model {args.model}"
+        )
+
+
+def build_model(args, num_classes: int = 10, vocab_size: int | None = None):
     from distributeddataparallel_tpu import models
 
     if args.model == "mlp":
@@ -115,14 +144,32 @@ def build_model(args, num_classes: int = 10):
     if args.model == "resnet50":
         from distributeddataparallel_tpu.models.resnet import ResNet50
         return ResNet50(num_classes=num_classes)
-    raise NotImplementedError(
-        f"--model {args.model}: use lm.py-style configs via training.trainer"
-    )
+    if is_lm(args):
+        from distributeddataparallel_tpu.models import transformer as tfm
+
+        family = tfm.gpt2_124m if args.model == "gpt2" else tfm.llama3_8b
+        overrides = dict(
+            vocab_size=vocab_size or args.vocab_size,
+            max_seq_len=args.seq_len,
+        )
+        if args.layers:
+            overrides["num_layers"] = args.layers
+        if args.d_model:
+            overrides["d_model"] = args.d_model
+            overrides["d_ff"] = 4 * args.d_model
+        return tfm.TransformerLM(family(**overrides))
+    raise NotImplementedError(f"--model {args.model}")
 
 
 def build_dataset(args, train=True):
     from distributeddataparallel_tpu import data
 
+    if is_lm(args) or args.dataset == "synthetic-lm":
+        return data.SyntheticLM(
+            num_examples=args.num_examples, seq_len=args.seq_len,
+            vocab_size=args.vocab_size,
+            seed=args.seed if train else args.seed + 1,
+        )
     if args.dataset == "synthetic":
         return data.SyntheticClassification(
             num_examples=args.num_examples, seed=args.seed if train else args.seed + 1
@@ -156,9 +203,15 @@ def train(args) -> float:
         shuffle=True, seed=args.seed,
     )
 
-    model = build_model(args)
+    lm = is_lm(args)
+    model = build_model(
+        args, vocab_size=getattr(dataset, "vocab_size", None)
+    )
     rng = jax.random.PRNGKey(args.seed)            # ref dpp.py:29 analog
-    sample = jnp.zeros((1,) + dataset.images.shape[1:], jnp.float32)
+    if lm:
+        sample = jnp.zeros((1, args.seq_len), jnp.int32)
+    else:
+        sample = jnp.zeros((1,) + dataset.images.shape[1:], jnp.float32)
     variables = model.init(rng, sample)
     params = variables["params"]
     # Non-param collections (BatchNorm running stats for ResNets) become
@@ -172,7 +225,15 @@ def train(args) -> float:
     )
     state = ddp.broadcast_params(state, mesh)       # DDP ctor broadcast analog
 
-    if has_ms:
+    if lm:
+        from distributeddataparallel_tpu.ops import lm_cross_entropy
+
+        def loss_fn(params, batch, rng):
+            toks = batch["tokens"]
+            logits = model.apply({"params": params}, toks[:, :-1])
+            loss = lm_cross_entropy(logits, toks[:, 1:])
+            return loss, {"accuracy": accuracy(logits, toks[:, 1:])}
+    elif has_ms:
         def loss_fn(params, ms, batch, rng):
             logits, new_vars = model.apply(
                 {"params": params, **ms}, batch["image"], train=True,
@@ -203,7 +264,17 @@ def train(args) -> float:
 
     eval_step = None
     if args.eval:
-        if has_ms:
+        if lm:
+            from distributeddataparallel_tpu.ops import lm_cross_entropy
+
+            def metric_fn(params, batch):
+                toks = batch["tokens"]
+                logits = model.apply({"params": params}, toks[:, :-1])
+                return {
+                    "loss": lm_cross_entropy(logits, toks[:, 1:]),
+                    "accuracy": accuracy(logits, toks[:, 1:]),
+                }
+        elif has_ms:
             def metric_fn(params, ms, batch):
                 logits = model.apply(
                     {"params": params, **ms}, batch["image"], train=False
@@ -281,6 +352,7 @@ def train(args) -> float:
 
 def main(argv=None):
     args = parse_args(argv)
+    validate_args(args)
     select_device(args)
     train(args)
 
