@@ -43,5 +43,6 @@ from distributeddataparallel_tpu.parallel.data_parallel import (  # noqa: F401
     all_reduce_gradients,
     broadcast_params,
 )
+from distributeddataparallel_tpu.parallel.zero import zero_state  # noqa: F401
 from distributeddataparallel_tpu.training.state import TrainState  # noqa: F401
 from distributeddataparallel_tpu.training.train_step import make_train_step  # noqa: F401

@@ -138,7 +138,7 @@ class Attention(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, x, *, positions=None, deterministic=True):
+    def __call__(self, x, *, positions=None, rope=None, deterministic=True):
         cfg = self.cfg
         B, S, _ = x.shape
         H, Hkv, D = cfg.num_heads, cfg.kv_heads, cfg.dims_per_head
@@ -150,7 +150,11 @@ class Attention(nn.Module):
         k = dense((Hkv, D), "k_proj")(x)
         v = dense((Hkv, D), "v_proj")(x)
         if cfg.positional == "rope":
-            cos, sin = rope_frequencies(D, cfg.max_seq_len, theta=cfg.rope_theta)
+            # Tables are computed once in TransformerLM and passed down so
+            # they sit outside the scanned/remat'd block body.
+            cos, sin = rope if rope is not None else rope_frequencies(
+                D, cfg.max_seq_len, theta=cfg.rope_theta
+            )
             q = apply_rope(q, cos, sin, positions=positions)
             k = apply_rope(k, cos, sin, positions=positions)
         k = repeat_kv(k, H // Hkv)
@@ -189,13 +193,13 @@ class DecoderBlock(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, x, positions=None, deterministic=True):
+    def __call__(self, x, positions=None, rope=None, deterministic=True):
         cfg = self.cfg
         drop = nn.Dropout(cfg.dropout_rate, deterministic=deterministic)
         y = _make_norm(cfg, "attn_norm")(x)
         x = x + drop(
             Attention(cfg, name="attn")(
-                y, positions=positions, deterministic=deterministic
+                y, positions=positions, rope=rope, deterministic=deterministic
             )
         )
         y = _make_norm(cfg, "mlp_norm")(x)
@@ -209,8 +213,10 @@ class _ScanBlock(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, x, positions, deterministic):
-        x = DecoderBlock(self.cfg, name="block")(x, positions, deterministic)
+    def __call__(self, x, positions, rope, deterministic):
+        x = DecoderBlock(self.cfg, name="block")(
+            x, positions, rope, deterministic
+        )
         return x, None
 
 
@@ -240,11 +246,16 @@ class TransformerLM(nn.Module):
             x = x + pos_embed[pos].astype(cfg.dtype)
         x = nn.Dropout(cfg.dropout_rate, deterministic=deterministic)(x)
 
+        rope = None
+        if cfg.positional == "rope":
+            rope = rope_frequencies(
+                cfg.dims_per_head, cfg.max_seq_len, theta=cfg.rope_theta
+            )
         if cfg.scan_layers:
             # One traced layer instead of L (compile time); under scan,
             # remat wraps the scan body (prevent_cse must be False there).
             scan_block = (
-                nn.remat(_ScanBlock, prevent_cse=False, static_argnums=(3,))
+                nn.remat(_ScanBlock, prevent_cse=False, static_argnums=(4,))
                 if cfg.remat
                 else _ScanBlock
             )
@@ -252,19 +263,19 @@ class TransformerLM(nn.Module):
                 scan_block,
                 variable_axes={"params": 0},
                 split_rngs={"params": True, "dropout": True},
-                in_axes=(nn.broadcast, nn.broadcast),
+                in_axes=(nn.broadcast, nn.broadcast, nn.broadcast),
                 length=cfg.num_layers,
                 metadata_params={nn.PARTITION_NAME: "layers"},
-            )(cfg, name="layers")(x, positions, deterministic)
+            )(cfg, name="layers")(x, positions, rope, deterministic)
         else:
             block_cls = (
-                nn.remat(DecoderBlock, static_argnums=(3,))
+                nn.remat(DecoderBlock, static_argnums=(4,))
                 if cfg.remat
                 else DecoderBlock
             )
             for i in range(cfg.num_layers):
                 x = block_cls(cfg, name=f"layer_{i}")(
-                    x, positions, deterministic
+                    x, positions, rope, deterministic
                 )
 
         x = _make_norm(cfg, "final_norm")(x)

@@ -52,6 +52,7 @@ def make_train_step(
     bucket_bytes: int | None = None,
     donate: bool = True,
     with_model_state: bool = False,
+    zero: bool = False,
 ):
     """Build the jit'd DP train step.
 
@@ -69,7 +70,15 @@ def make_train_step(
     — for models with non-gradient state such as BatchNorm running stats.
     New model state is pmean'd across replicas each step, the SPMD
     equivalent of DDP keeping module buffers consistent across ranks.
+
+    With ``zero=True``, optimizer state is ZeRO-1-sharded across the data
+    axis (see ``parallel.zero``): grads reduce_scatter instead of
+    all-reduce, the update runs on each replica's 1/N shard, updated
+    params all_gather back.  ``state`` must come from ``zero_state``.
+    Mutually exclusive with ``bucket_bytes``.
     """
+    if zero and bucket_bytes is not None:
+        raise ValueError("zero=True does its own reduction; drop bucket_bytes")
     if accum_steps < 1:
         raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
 
@@ -140,11 +149,23 @@ def make_train_step(
             loss = loss * inv
             aux = jax.tree.map(lambda a: a * inv, aux)
 
-        # THE DDP moment: average grads across the data axis.
-        grads = all_reduce_gradients(
-            grads, axis_name, op="mean", bucket_bytes=bucket_bytes
-        )
-        new_state = state.apply_gradients(grads)
+        if zero:
+            # ZeRO-1: reduce_scatter + sharded update + all_gather.
+            from distributeddataparallel_tpu.parallel.zero import zero_update
+
+            new_params, new_opt_state = zero_update(
+                grads, state, axis_name, mesh.shape[axis_name]
+            )
+            new_state = state.replace(
+                step=state.step + 1, params=new_params,
+                opt_state=new_opt_state,
+            )
+        else:
+            # THE DDP moment: average grads across the data axis.
+            grads = all_reduce_gradients(
+                grads, axis_name, op="mean", bucket_bytes=bucket_bytes
+            )
+            new_state = state.apply_gradients(grads)
         if with_model_state:
             # Keep buffers replicated (SyncBN-flavored: average the stats).
             new_ms = jax.tree.map(lambda s: lax.pmean(s, axis_name), new_ms)
@@ -166,16 +187,39 @@ def make_train_step(
     # point — grads stay per-replica until all_reduce_gradients — which is
     # also what makes the bucketed/overlap variants possible.
     data_axes = (axis_name,)
-    sharded = jax.shard_map(
-        _replica_step,
-        mesh=mesh,
-        in_specs=(P(), P(*data_axes), P()),
-        out_specs=(P(), P()),
-        check_vma=False,
-    )
-
     jit_kwargs = {"donate_argnums": (0,)} if donate else {}
-    step = jax.jit(sharded, **jit_kwargs)
+
+    if not zero:
+        sharded = jax.shard_map(
+            _replica_step,
+            mesh=mesh,
+            in_specs=(P(), P(*data_axes), P()),
+            out_specs=(P(), P()),
+            check_vma=False,
+        )
+        return jax.jit(sharded, **jit_kwargs)
+
+    # ZeRO: the state's opt leaves are sharded along the data axis, so the
+    # per-leaf spec tree depends on the state structure — build on first
+    # call (jit caches thereafter).
+    from distributeddataparallel_tpu.parallel.zero import state_specs
+
+    compiled = None
+
+    def step(state: TrainState, batch: Pytree, rng: jax.Array):
+        nonlocal compiled
+        if compiled is None:
+            specs = state_specs(state, axis_name)
+            sharded = jax.shard_map(
+                _replica_step,
+                mesh=mesh,
+                in_specs=(specs, P(*data_axes), P()),
+                out_specs=(specs, P()),
+                check_vma=False,
+            )
+            compiled = jax.jit(sharded, **jit_kwargs)
+        return compiled(state, batch, rng)
+
     return step
 
 

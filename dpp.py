@@ -55,6 +55,9 @@ def parse_args(argv=None):
     p.add_argument("--seed", type=int, default=0)            # ref dpp.py:29
     p.add_argument("--accum-steps", type=int, default=1,
                    help="gradient accumulation (DDP no_sync analog)")
+    p.add_argument("--zero", action="store_true",
+                   help="ZeRO-1 optimizer-state sharding across the data "
+                        "axis (reduce_scatter + sharded update + all_gather)")
     p.add_argument("--bucket-mb", type=float, default=None,
                    help="explicit DDP-style gradient bucket size in MiB "
                         "(default: let XLA schedule the all-reduce)")
@@ -72,7 +75,12 @@ def parse_args(argv=None):
                    help="host:port for multi-process rendezvous")
     p.add_argument("--num-processes", type=int, default=None)
     p.add_argument("--process-id", type=int, default=None)
-    return p.parse_args(argv)
+    args = p.parse_args(argv)
+    # Resolve the dataset default here so direct train(parse_args([...]))
+    # callers (tests, notebooks) get the same behavior as main().
+    if args.dataset is None:
+        args.dataset = "synthetic-lm" if is_lm(args) else "synthetic"
+    return args
 
 
 def select_device(args) -> None:
@@ -117,8 +125,6 @@ def is_lm(args) -> bool:
 
 
 def validate_args(args) -> None:
-    if args.dataset is None:
-        args.dataset = "synthetic-lm" if is_lm(args) else "synthetic"
     if is_lm(args) and args.dataset in ("cifar10", "synthetic"):
         raise SystemExit(
             f"--model {args.model} is a language model; it trains on "
@@ -155,8 +161,17 @@ def build_model(args, num_classes: int = 10, vocab_size: int | None = None):
         if args.layers:
             overrides["num_layers"] = args.layers
         if args.d_model:
-            overrides["d_model"] = args.d_model
-            overrides["d_ff"] = 4 * args.d_model
+            # Scale heads with width (head_dim 16, even for RoPE) instead of
+            # keeping the family's head count, which would give tiny or odd
+            # head dims at small widths.
+            if args.d_model % 16:
+                raise SystemExit("--d-model must be a multiple of 16")
+            heads = max(1, args.d_model // 16)
+            overrides.update(
+                d_model=args.d_model, d_ff=4 * args.d_model, num_heads=heads
+            )
+            if args.model == "llama":
+                overrides["num_kv_heads"] = max(1, heads // 4)
         return tfm.TransformerLM(family(**overrides))
     raise NotImplementedError(f"--model {args.model}")
 
@@ -220,10 +235,18 @@ def train(args) -> float:
     has_ms = bool(model_state)
 
     tx = optax.sgd(args.lr, momentum=args.momentum or None)  # ref dpp.py:41
-    state = ddp.TrainState.create(
-        apply_fn=model.apply, params=params, tx=tx, model_state=model_state
-    )
-    state = ddp.broadcast_params(state, mesh)       # DDP ctor broadcast analog
+    if args.zero:
+        params = ddp.broadcast_params(params, mesh)
+        model_state = ddp.broadcast_params(model_state, mesh)
+        state = ddp.zero_state(
+            apply_fn=model.apply, params=params, tx=tx, mesh=mesh,
+            model_state=model_state,
+        )
+    else:
+        state = ddp.TrainState.create(
+            apply_fn=model.apply, params=params, tx=tx, model_state=model_state
+        )
+        state = ddp.broadcast_params(state, mesh)   # DDP ctor broadcast analog
 
     if lm:
         from distributeddataparallel_tpu.ops import lm_cross_entropy
@@ -251,7 +274,7 @@ def train(args) -> float:
     step_fn = ddp.make_train_step(
         loss_fn, mesh=mesh, accum_steps=args.accum_steps,
         bucket_bytes=int(args.bucket_mb * 1024 * 1024) if args.bucket_mb else None,
-        with_model_state=has_ms,
+        with_model_state=has_ms, zero=args.zero,
     )
 
     ckpt = None
