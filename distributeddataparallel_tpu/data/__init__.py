@@ -4,4 +4,8 @@ from distributeddataparallel_tpu.data.datasets import (  # noqa: F401
     SyntheticLM,
     load_cifar10,
 )
-from distributeddataparallel_tpu.data.loader import DataLoader, shard_batch  # noqa: F401
+from distributeddataparallel_tpu.data.loader import (  # noqa: F401
+    DataLoader,
+    shard_batch,
+    shard_lm_batch,
+)

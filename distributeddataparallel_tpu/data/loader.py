@@ -28,14 +28,9 @@ from distributeddataparallel_tpu.parallel.sampler import DistributedSampler
 Pytree = Any
 
 
-def shard_batch(batch: Pytree, mesh: Mesh, axis_name: str = "data") -> Pytree:
-    """Place a host batch on the mesh, sharded along the data axis.
-
-    The analog of ``data.to(rank)`` (ref dpp.py:48), except one call covers
-    every local device and, multi-host, assembles the global array from
-    process-local rows.
-    """
-    sharding = NamedSharding(mesh, P(axis_name))
+def _place(batch: Pytree, sharding: NamedSharding) -> Pytree:
+    """Put a host batch on device under `sharding` — single sharded
+    device_put on one host, per-process global-array assembly multi-host."""
     if jax.process_count() > 1:
         return jax.tree.map(
             lambda x: jax.make_array_from_process_local_data(
@@ -44,6 +39,34 @@ def shard_batch(batch: Pytree, mesh: Mesh, axis_name: str = "data") -> Pytree:
             batch,
         )
     return jax.device_put(batch, sharding)
+
+
+def shard_batch(batch: Pytree, mesh: Mesh, axis_name: str = "data") -> Pytree:
+    """Place a host batch on the mesh, sharded along the data axis.
+
+    The analog of ``data.to(rank)`` (ref dpp.py:48), except one call covers
+    every local device and, multi-host, assembles the global array from
+    process-local rows.
+    """
+    return _place(batch, NamedSharding(mesh, P(axis_name)))
+
+
+def shard_lm_batch(
+    tokens,
+    mesh: Mesh,
+    data_axis: str = "data",
+    seq_axis: str = "seq",
+) -> Pytree:
+    """Split (B, S+1) host tokens into next-token pairs and shard them
+    batch-dim → data axis, seq-dim → seq axis (context parallelism).
+
+    The input/target shift must happen on the host BEFORE sequence
+    sharding: position i's target is token i+1, which for the last token
+    of a shard lives in the next shard.
+    """
+    tokens = np.asarray(tokens)
+    batch = {"inputs": tokens[:, :-1], "targets": tokens[:, 1:]}
+    return _place(batch, NamedSharding(mesh, P(data_axis, seq_axis)))
 
 
 class DataLoader:
@@ -72,7 +95,11 @@ class DataLoader:
         drop_last: bool = True,
         device_feed: bool = True,
         prefetch: int = 1,
+        place_fn=None,
     ):
+        """``place_fn(host_batch) -> device_batch`` overrides the default
+        data-axis ``shard_batch`` placement (e.g. ``shard_lm_batch`` for
+        context parallelism) while keeping the prefetch pipeline."""
         self.dataset = dataset
         self.per_replica_batch = per_replica_batch
         self.mesh = mesh
@@ -87,6 +114,9 @@ class DataLoader:
         self.drop_last = drop_last
         self.device_feed = device_feed
         self.prefetch = prefetch
+        self._place_fn = place_fn or (
+            lambda b: shard_batch(b, self.mesh, self.axis_name)
+        )
         self._epoch = 0
 
         self._samplers = [
@@ -153,7 +183,7 @@ class DataLoader:
         # host gather overlaps device compute (DataLoader-workers analog).
         queue: collections.deque = collections.deque()
         for host_batch in it:
-            queue.append(shard_batch(host_batch, self.mesh, self.axis_name))
+            queue.append(self._place_fn(host_batch))
             if len(queue) > self.prefetch:
                 yield queue.popleft()
         while queue:

@@ -66,6 +66,11 @@ class TransformerConfig:
     attn_impl: str = "auto"          # "auto" | "xla" | "pallas"
     dropout_rate: float = 0.0        # residual-branch dropout (GPT-2 style)
     use_bias: bool = True            # proj biases: GPT-2 yes, Llama no
+    # Context parallelism: name of the mesh axis the sequence dimension is
+    # sharded over.  When set, the model must run inside shard_map with
+    # that axis bound; attention becomes ring attention over the axis and
+    # positions default to each shard's global offsets.
+    cp_axis: str | None = None
 
     @property
     def kv_heads(self) -> int:
@@ -159,7 +164,14 @@ class Attention(nn.Module):
             k = apply_rope(k, cos, sin, positions=positions)
         k = repeat_kv(k, H // Hkv)
         v = repeat_kv(v, H // Hkv)
-        out = attention(q, k, v, causal=True, impl=cfg.attn_impl)
+        if cfg.cp_axis is not None:
+            from distributeddataparallel_tpu.parallel.context_parallel import (
+                ring_attention,
+            )
+
+            out = ring_attention(q, k, v, axis_name=cfg.cp_axis, causal=True)
+        else:
+            out = attention(q, k, v, causal=True, impl=cfg.attn_impl)
         out = nn.DenseGeneral(
             cfg.d_model, axis=(-2, -1), dtype=cfg.dtype, name="o_proj",
             use_bias=cfg.use_bias,
@@ -231,6 +243,13 @@ class TransformerLM(nn.Module):
         B, S = tokens.shape
         if S > cfg.max_seq_len:
             raise ValueError(f"seq len {S} > max_seq_len {cfg.max_seq_len}")
+        if cfg.cp_axis is not None and positions is None:
+            from distributeddataparallel_tpu.parallel.context_parallel import (
+                cp_positions,
+            )
+
+            # Sequence-sharded run: this shard's global token offsets.
+            positions = cp_positions(S, cfg.cp_axis)
         embed = nn.Embed(
             cfg.vocab_size, cfg.d_model, name="token_embed",
             embedding_init=nn.initializers.normal(0.02),

@@ -5,3 +5,10 @@ from distributeddataparallel_tpu.parallel.data_parallel import (  # noqa: F401
     broadcast_params,
     bucket_gradients,
 )
+from distributeddataparallel_tpu.parallel.context_parallel import (  # noqa: F401
+    cp_positions,
+    make_cp_eval_step,
+    make_cp_train_step,
+    ring_attention,
+)
+from distributeddataparallel_tpu.parallel.zero import zero_state  # noqa: F401

@@ -1,0 +1,199 @@
+"""Context parallelism: ring attention over a sequence mesh axis.
+
+The reference has no attention model and no sequence dimension at all
+(SURVEY.md §5 "Long-context"); this module is the framework's long-context
+scaling path, built the TPU way:
+
+- The sequence dimension is sharded across a ``seq`` mesh axis: each
+  device holds a (B, S/N, H, D) slice of q, k, v.
+- **Ring attention** (Liu et al., arXiv 2310.01889 pattern): kv chunks
+  rotate around the ring with ``lax.ppermute`` over ICI while each device
+  accumulates blockwise attention of its local queries against the
+  visiting chunk using the same online-softmax update as the flash kernel
+  (``ops.pallas_attention``) — the full (S, S) score matrix never exists,
+  and per-device memory stays O(S/N).
+- XLA overlaps the ppermute transfer of chunk s+1 with the attention
+  compute of chunk s (the latency-hiding scheduler sees independent
+  DMA/compute chains), which is the property that makes the ring scale.
+- Causal masking uses *global* offsets derived from ``lax.axis_index``,
+  so cross-chunk blocks mask correctly; fully-masked visiting chunks
+  still traverse the ring (uniform schedule) but their contribution is
+  exactly zero.
+
+``ring_attention`` is a collective op: it must run inside ``shard_map``
+with ``axis_name`` bound.  ``make_cp_train_step`` wires it (together with
+data parallelism on a second axis) into a compiled LM training step where
+activations are sequence-sharded end to end — embeddings, norms, and MLPs
+are per-token and need no communication; attention is the one collective.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from distributeddataparallel_tpu.ops.attention import NEG_INF, causal_mask_bias
+
+Pytree = Any
+
+
+def ring_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    axis_name: str,
+    causal: bool = True,
+) -> jnp.ndarray:
+    """Blockwise ring attention over the ``axis_name`` mesh axis.
+
+    q, k, v: local shards (B, S_local, H, D); the global sequence is the
+    concatenation of shards in axis order.  Returns the local (B, S_local,
+    H, D) output shard — numerically identical (up to fp accumulation
+    order) to slicing full attention over the gathered sequence.
+    """
+    n = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    B, S, H, D = q.shape
+    scale = 1.0 / jnp.sqrt(jnp.asarray(D, jnp.float32))
+    q_off = idx * S
+
+    qf = q.astype(jnp.float32)
+
+    def accumulate(stats, kc, vc, src):
+        """Online-softmax update of (m, l, acc) with the visiting chunk
+        whose global ring position is `src`."""
+        m, l, acc = stats
+        logits = (
+            jnp.einsum("bqhd,bkhd->bhqk", qf, kc.astype(jnp.float32)) * scale
+        )
+        if causal:
+            logits = logits + causal_mask_bias(
+                S, S, q_offset=q_off, kv_offset=src * S
+            )[None, None]
+        m_cur = jnp.max(logits, axis=-1)          # (B, H, S)
+        m_new = jnp.maximum(m, m_cur)
+        p = jnp.exp(logits - m_new[..., None])    # (B, H, S, S)
+        corr = jnp.exp(m - m_new)
+        l_new = corr * l + jnp.sum(p, axis=-1)
+        acc_new = acc * corr[..., None] + jnp.einsum(
+            "bhqk,bkhd->bhqd", p, vc.astype(jnp.float32)
+        )
+        return m_new, l_new, acc_new
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(carry, s):
+        kc, vc, stats = carry
+        # Rotate first (s >= 1): n-1 hops total, no dead final rotation.
+        kc = lax.ppermute(kc, axis_name, perm)
+        vc = lax.ppermute(vc, axis_name, perm)
+        # After s hops this device holds the chunk from position idx - s.
+        stats = accumulate(stats, kc, vc, (idx - s) % n)
+        return (kc, vc, stats), None
+
+    m0 = jnp.full((B, H, S), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, H, S), jnp.float32)
+    acc0 = jnp.zeros((B, H, S, D), jnp.float32)
+    stats = accumulate((m0, l0, acc0), k, v, idx)  # own chunk, hop 0
+    (_, _, (m, l, acc)), _ = lax.scan(
+        step, (k, v, stats), jnp.arange(1, n)
+    )
+    # Rows with no visible kv (can't happen for causal self-attention, but
+    # guard against l == 0 for safety).
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    out = (acc / l_safe[..., None]).transpose(0, 2, 1, 3)  # (B, S, H, D)
+    return out.astype(q.dtype)
+
+
+def cp_positions(seq_len_local: int, axis_name: str) -> jnp.ndarray:
+    """Global token positions of this device's sequence shard (for RoPE /
+    learned positional lookups inside shard_map)."""
+    return lax.axis_index(axis_name) * seq_len_local + jnp.arange(
+        seq_len_local
+    )
+
+
+def make_cp_train_step(
+    loss_fn: Callable,
+    *,
+    mesh: Mesh,
+    data_axis: str = "data",
+    seq_axis: str = "seq",
+    donate: bool = True,
+):
+    """Compiled train step with DP × CP sharding.
+
+    ``loss_fn(params, batch, rng) -> (loss, aux)`` runs per mesh position
+    on a batch whose leaves are sharded (batch-dim → ``data_axis``,
+    seq-dim → ``seq_axis``); inside it the model must use collective
+    attention (``TransformerConfig.cp_axis = seq_axis``) so the sharded
+    sequence attends globally.  The per-position mean loss is weighted
+    uniformly per token, so gradients are pmean'd over BOTH axes —
+    equivalent to global-batch DP on the full sequence.
+
+    Batches come pre-split by the host into {"inputs", "targets"} (the
+    next-token shift crosses shard boundaries, so it must happen before
+    sharding — see ``data.loader.shard_lm_batch``).
+    """
+    from distributeddataparallel_tpu.training.state import TrainState
+
+    both = (data_axis, seq_axis)
+
+    def _step(state: TrainState, batch: Pytree, rng: jax.Array):
+        rng = jax.random.fold_in(rng, lax.axis_index(data_axis))
+        rng = jax.random.fold_in(rng, lax.axis_index(seq_axis))
+
+        def local_loss(params):
+            return loss_fn(params, batch, rng)
+
+        (loss, aux), grads = jax.value_and_grad(local_loss, has_aux=True)(
+            state.params
+        )
+        for ax in both:
+            grads = jax.tree.map(lambda g: lax.pmean(g, ax), grads)
+        new_state = state.apply_gradients(grads)
+        metrics = {"loss": lax.pmean(lax.pmean(loss, both[0]), both[1])}
+        for k, v in aux.items():
+            metrics[k] = lax.pmean(lax.pmean(v, both[0]), both[1])
+        return new_state, metrics
+
+    sharded = jax.shard_map(
+        _step,
+        mesh=mesh,
+        in_specs=(P(), P(data_axis, seq_axis), P()),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+    jit_kwargs = {"donate_argnums": (0,)} if donate else {}
+    return jax.jit(sharded, **jit_kwargs)
+
+
+def make_cp_eval_step(
+    metric_fn: Callable,
+    *,
+    mesh: Mesh,
+    data_axis: str = "data",
+    seq_axis: str = "seq",
+):
+    """Jit'd DP×CP eval: ``metric_fn(params, batch) -> dict`` per position,
+    pmean'd over both axes."""
+
+    def _eval(params: Pytree, batch: Pytree):
+        metrics = metric_fn(params, batch)
+        return jax.tree.map(
+            lambda m: lax.pmean(lax.pmean(m, data_axis), seq_axis), metrics
+        )
+
+    sharded = jax.shard_map(
+        _eval,
+        mesh=mesh,
+        in_specs=(P(), P(data_axis, seq_axis)),
+        out_specs=P(),
+        check_vma=False,
+    )
+    return jax.jit(sharded)

@@ -55,6 +55,9 @@ def parse_args(argv=None):
     p.add_argument("--seed", type=int, default=0)            # ref dpp.py:29
     p.add_argument("--accum-steps", type=int, default=1,
                    help="gradient accumulation (DDP no_sync analog)")
+    p.add_argument("--cp", type=int, default=1,
+                   help="context-parallel degree: shard the sequence over "
+                        "a 'seq' mesh axis with ring attention (LM only)")
     p.add_argument("--zero", action="store_true",
                    help="ZeRO-1 optimizer-state sharding across the data "
                         "axis (reduce_scatter + sharded update + all_gather)")
@@ -117,6 +120,11 @@ def setup(args):
         num_processes=args.num_processes,
         process_id=args.process_id,
     )
+    if args.cp > 1:
+        n = ddp.global_device_count()
+        if n % args.cp:
+            raise SystemExit(f"--cp {args.cp} does not divide {n} devices")
+        return ddp.make_mesh(("data", "seq"), shape=(n // args.cp, args.cp))
     return ddp.make_mesh(("data",))
 
 
@@ -135,6 +143,17 @@ def validate_args(args) -> None:
             f"--dataset synthetic-lm requires an LM model "
             f"(--model gpt2|llama), got --model {args.model}"
         )
+    if args.cp > 1:
+        if not is_lm(args):
+            raise SystemExit("--cp requires an LM model (--model gpt2|llama)")
+        if args.zero:
+            raise SystemExit("--cp with --zero is not supported yet")
+        if args.accum_steps > 1 or args.bucket_mb:
+            raise SystemExit(
+                "--cp composes with plain DP only (no --accum-steps/--bucket-mb)"
+            )
+        if args.seq_len % args.cp:
+            raise SystemExit("--seq-len must be divisible by --cp")
 
 
 def build_model(args, num_classes: int = 10, vocab_size: int | None = None):
@@ -158,6 +177,8 @@ def build_model(args, num_classes: int = 10, vocab_size: int | None = None):
             vocab_size=vocab_size or args.vocab_size,
             max_seq_len=args.seq_len,
         )
+        if args.cp > 1:
+            overrides["cp_axis"] = "seq"
         if args.layers:
             overrides["num_layers"] = args.layers
         if args.d_model:
@@ -171,7 +192,13 @@ def build_model(args, num_classes: int = 10, vocab_size: int | None = None):
                 d_model=args.d_model, d_ff=4 * args.d_model, num_heads=heads
             )
             if args.model == "llama":
-                overrides["num_kv_heads"] = max(1, heads // 4)
+                # Largest kv count <= heads/4 that divides heads (GQA
+                # requires num_heads % num_kv_heads == 0).
+                kv = max(
+                    (d for d in range(1, heads // 4 + 1) if heads % d == 0),
+                    default=1,
+                )
+                overrides["num_kv_heads"] = kv
         return tfm.TransformerLM(family(**overrides))
     raise NotImplementedError(f"--model {args.model}")
 
@@ -212,10 +239,19 @@ def train(args) -> float:
         args.batch_size * n_replicas,
     )
 
+    cp = args.cp > 1
+    if cp:
+        from distributeddataparallel_tpu.data import shard_lm_batch
+
+        # CP: host-side input/target shift + DP×CP placement, inside the
+        # loader's prefetch pipeline.
+        place_fn = lambda b: shard_lm_batch(b["tokens"], mesh)
+    else:
+        place_fn = None
     dataset = build_dataset(args, train=True)
     loader = DataLoader(
         dataset, per_replica_batch=args.batch_size, mesh=mesh,
-        shuffle=True, seed=args.seed,
+        shuffle=True, seed=args.seed, place_fn=place_fn,
     )
 
     lm = is_lm(args)
@@ -225,9 +261,22 @@ def train(args) -> float:
     rng = jax.random.PRNGKey(args.seed)            # ref dpp.py:29 analog
     if lm:
         sample = jnp.zeros((1, args.seq_len), jnp.int32)
+        init_model = model
+        if cp:
+            # Init outside shard_map with a non-CP twin config: ring
+            # attention and cp_positions need the seq axis bound, but the
+            # param structure is identical either way.
+            import dataclasses
+
+            from distributeddataparallel_tpu.models import TransformerLM
+
+            init_model = TransformerLM(
+                dataclasses.replace(model.cfg, cp_axis=None)
+            )
+        variables = init_model.init(rng, sample)
     else:
         sample = jnp.zeros((1,) + dataset.images.shape[1:], jnp.float32)
-    variables = model.init(rng, sample)
+        variables = model.init(rng, sample)
     params = variables["params"]
     # Non-param collections (BatchNorm running stats for ResNets) become
     # framework-managed model state — the torch "buffers" DDP broadcasts.
@@ -248,7 +297,14 @@ def train(args) -> float:
         )
         state = ddp.broadcast_params(state, mesh)   # DDP ctor broadcast analog
 
-    if lm:
+    if cp:
+        from distributeddataparallel_tpu.ops import lm_cross_entropy
+
+        def loss_fn(params, batch, rng):
+            logits = model.apply({"params": params}, batch["inputs"])
+            loss = lm_cross_entropy(logits, batch["targets"])
+            return loss, {"accuracy": accuracy(logits, batch["targets"])}
+    elif lm:
         from distributeddataparallel_tpu.ops import lm_cross_entropy
 
         def loss_fn(params, batch, rng):
@@ -271,11 +327,16 @@ def train(args) -> float:
             loss = cross_entropy_loss(logits, batch["label"])  # ref dpp.py:40
             return loss, {"accuracy": accuracy(logits, batch["label"])}
 
-    step_fn = ddp.make_train_step(
-        loss_fn, mesh=mesh, accum_steps=args.accum_steps,
-        bucket_bytes=int(args.bucket_mb * 1024 * 1024) if args.bucket_mb else None,
-        with_model_state=has_ms, zero=args.zero,
-    )
+    if cp:
+        from distributeddataparallel_tpu.parallel import make_cp_train_step
+
+        step_fn = make_cp_train_step(loss_fn, mesh=mesh)
+    else:
+        step_fn = ddp.make_train_step(
+            loss_fn, mesh=mesh, accum_steps=args.accum_steps,
+            bucket_bytes=int(args.bucket_mb * 1024 * 1024) if args.bucket_mb else None,
+            with_model_state=has_ms, zero=args.zero,
+        )
 
     ckpt = None
     start_epoch = 0
@@ -286,7 +347,23 @@ def train(args) -> float:
             state, start_epoch = ckpt.restore_latest(state)
 
     eval_step = None
-    if args.eval:
+    if args.eval and cp:
+        from distributeddataparallel_tpu.ops import lm_cross_entropy
+        from distributeddataparallel_tpu.parallel import make_cp_eval_step
+
+        def metric_fn(params, batch):
+            logits = model.apply({"params": params}, batch["inputs"])
+            return {
+                "loss": lm_cross_entropy(logits, batch["targets"]),
+                "accuracy": accuracy(logits, batch["targets"]),
+            }
+        eval_step = make_cp_eval_step(metric_fn, mesh=mesh)
+        eval_loader = DataLoader(
+            build_dataset(args, train=False), per_replica_batch=args.batch_size,
+            mesh=mesh, shuffle=False, seed=args.seed, drop_last=False,
+            place_fn=place_fn,
+        )
+    elif args.eval:
         if lm:
             from distributeddataparallel_tpu.ops import lm_cross_entropy
 
@@ -351,7 +428,7 @@ def train(args) -> float:
             for b in eval_loader:
                 m = (
                     eval_step(state.params, state.model_state, b)
-                    if has_ms
+                    if has_ms and not cp
                     else eval_step(state.params, b)
                 )
                 # Weight by global row count: the ragged final batch

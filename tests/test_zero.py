@@ -15,7 +15,7 @@ from distributeddataparallel_tpu.ops import cross_entropy_loss
 from distributeddataparallel_tpu.parallel import zero
 
 
-def _setup(tx, devices):
+def _setup(devices):
     mesh = ddp.make_mesh(("data",))
     model = TinyMLP(num_classes=10)
     params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)))[
@@ -54,7 +54,7 @@ def test_zero_matches_replicated_dp(tx_fn, devices):
 
     N-way ZeRO params after k steps == replicated-DP params after k steps.
     """
-    mesh, model, params, loss_fn, batches = _setup(tx_fn, devices)
+    mesh, model, params, loss_fn, batches = _setup(devices)
 
     state_dp = ddp.TrainState.create(
         apply_fn=model.apply, params=params, tx=tx_fn()
@@ -105,7 +105,7 @@ def test_zero_opt_state_is_sharded(devices):
 
 
 def test_zero_with_grad_accumulation(devices):
-    mesh, model, params, loss_fn, batches = _setup(None, devices)
+    mesh, model, params, loss_fn, batches = _setup(devices)
     params = ddp.broadcast_params(params, mesh)
     state = ddp.zero_state(
         apply_fn=model.apply, params=params, tx=optax.sgd(0.1), mesh=mesh
