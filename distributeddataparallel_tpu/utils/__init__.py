@@ -1,1 +1,6 @@
 from distributeddataparallel_tpu.utils.logging import log0, get_logger  # noqa: F401
+from distributeddataparallel_tpu.utils.metrics import (  # noqa: F401
+    StepTimer,
+    allreduce_bandwidth,
+    profile_trace,
+)

@@ -1,0 +1,173 @@
+"""Observability: throughput counters, profiler hooks, all-reduce BW probe.
+
+The reference's entire observability surface is a rank-0 loss print every
+100 batches (ref dpp.py:54-55).  This module provides the BASELINE-metric
+instrumentation on top of that: img/s/chip and tokens/s/chip counters, a
+``jax.profiler`` trace context (XProf/TensorBoard-compatible), and a
+gradient all-reduce bandwidth-utilization probe — the north-star metric's
+denominator (BASELINE.md "grad all-reduce BW util").
+
+Design rule carried over from the reference critique (SURVEY.md §2d.6):
+keep measurement off the hot path.  ``StepTimer`` only forces a device
+sync at window boundaries; per-step it just stamps the host clock.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+
+import jax
+
+
+class StepTimer:
+    """Windowed throughput meter: items/s and items/s/chip.
+
+    ``tick(items)`` per step; every ``window`` steps it blocks on the
+    given array (or skips the sync if none) and emits a reading.  The
+    first window after construction includes compile time and is marked
+    ``warmup=True`` — report it separately or drop it.
+    """
+
+    def __init__(self, window: int = 50, n_chips: int | None = None):
+        self.window = window
+        self.n_chips = n_chips or len(jax.devices())
+        self._t0 = time.perf_counter()
+        self._items = 0
+        self._steps = 0
+        self._windows = 0
+
+    def reset(self) -> None:
+        """Restart the current window — call after off-path work (eval,
+        checkpoint save) so its wall time doesn't pollute the reading."""
+        self._t0 = time.perf_counter()
+        self._items = 0
+        self._steps = 0
+
+    def tick(self, items: int, sync: object = None) -> dict | None:
+        """Record one step of `items` processed; returns a reading dict at
+        window boundaries, else None."""
+        self._items += items
+        self._steps += 1
+        if self._steps < self.window:
+            return None
+        if sync is not None:
+            jax.block_until_ready(sync)
+        t1 = time.perf_counter()
+        dt = t1 - self._t0
+        reading = {
+            "items_per_s": self._items / dt,
+            "items_per_s_per_chip": self._items / dt / self.n_chips,
+            "steps_per_s": self._steps / dt,
+            "window_s": dt,
+            "warmup": self._windows == 0,
+        }
+        self._t0 = t1
+        self._items = 0
+        self._steps = 0
+        self._windows += 1
+        return reading
+
+
+@contextlib.contextmanager
+def profile_trace(log_dir: str | None, *, sync: object = None):
+    """jax.profiler trace scope (XProf/TensorBoard).  No-op if dir is None.
+
+    ``sync`` is blocked on before stopping so the trace covers the async
+    device work launched inside the scope; pass a zero-arg callable to
+    resolve it at exit (e.g. ``lambda: state`` when the loop rebinds it).
+    """
+    if not log_dir:
+        yield
+        return
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        target = sync() if callable(sync) else sync
+        if target is not None:
+            jax.block_until_ready(target)
+        jax.profiler.stop_trace()
+
+
+# Peak bidirectional ICI bandwidth per chip, bytes/s.  Used as the
+# utilization denominator; override per platform.  Public figures:
+# v5e 2x(4x100GB/s links)/2 ≈ 186 GB/s usable per chip for all-reduce
+# rings; v5p ≈ 3x of that.  These are denominators for a *relative*
+# utilization number, not absolute truth — record which one was used.
+ICI_PEAK_BYTES_PER_S = {
+    "tpu v5 lite": 186e9,
+    "tpu v5e": 186e9,
+    "tpu v5p": 540e9,
+    "tpu v4": 270e9,
+    "cpu": 50e9,  # loopback ballpark so the probe stays meaningful in CI
+}
+
+
+def _peak_for(device) -> float | None:
+    """Known ICI peak for the device kind, or None (unknown hardware —
+    better no utilization number than one against a wrong denominator)."""
+    kind = getattr(device, "device_kind", "cpu").lower()
+    for key, bw in ICI_PEAK_BYTES_PER_S.items():
+        if key in kind:
+            return bw
+    return None
+
+
+def allreduce_bandwidth(
+    mesh=None,
+    *,
+    size_mb: float = 64.0,
+    iters: int = 10,
+    axis_name: str = "data",
+) -> dict:
+    """Measure gradient all-reduce bandwidth over the mesh's data axis.
+
+    Times a jit'd ``lax.pmean`` of a ``size_mb`` float32 buffer (the shape
+    of DDP's bucket all-reduce) and reports **bus bandwidth** in the NCCL
+    convention — ``busbw = 2*(N-1)/N * bytes / t`` — which is the number
+    comparable against link peaks, plus utilization against the
+    platform's ICI peak (None/0 on unknown hardware).  With one device
+    the collective is a no-op and utilization reads 0 — the probe is only
+    meaningful on a multi-chip axis.
+    """
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from distributeddataparallel_tpu.runtime.distributed import make_mesh
+
+    if mesh is None:
+        mesh = make_mesh((axis_name,))
+    n = mesh.shape[axis_name]
+    nbytes = int(size_mb * 1e6)
+    x = jnp.ones((nbytes // 4,), jnp.float32)
+
+    fn = jax.jit(
+        jax.shard_map(
+            lambda x: lax.pmean(x, axis_name),
+            mesh=mesh,
+            in_specs=P(),
+            out_specs=P(),
+            check_vma=False,
+        )
+    )
+    out = fn(x)
+    jax.block_until_ready(out)  # compile + warm
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(x)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / iters
+
+    bus_bytes = 2 * (n - 1) / max(n, 1) * nbytes
+    bw = bus_bytes / dt
+    peak = _peak_for(mesh.devices.flat[0])
+    return {
+        "devices": n,
+        "payload_mb": size_mb,
+        "time_s": dt,
+        "bus_bw_gb_s": bw / 1e9,
+        "peak_gb_s": peak / 1e9 if peak else None,
+        "utilization": bw / peak if (peak and n > 1) else 0.0,
+    }

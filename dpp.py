@@ -74,6 +74,9 @@ def parse_args(argv=None):
     p.add_argument("--eval", action="store_true", help="run eval after each epoch")
     p.add_argument("--profile-dir", default=None,
                    help="write a jax.profiler trace for epoch 0 here")
+    p.add_argument("--bw-probe", action="store_true",
+                   help="measure grad all-reduce bandwidth utilization "
+                        "over the data axis before training")
     p.add_argument("--coordinator", default=None,
                    help="host:port for multi-process rendezvous")
     p.add_argument("--num-processes", type=int, default=None)
@@ -229,7 +232,12 @@ def train(args) -> float:
     from distributeddataparallel_tpu.data import DataLoader
     from distributeddataparallel_tpu.ops import accuracy, cross_entropy_loss
     from distributeddataparallel_tpu.training.train_step import make_eval_step
-    from distributeddataparallel_tpu.utils import log0
+    from distributeddataparallel_tpu.utils import (
+        StepTimer,
+        allreduce_bandwidth,
+        log0,
+        profile_trace,
+    )
 
     mesh = setup(args)
     n_replicas = mesh.shape["data"]
@@ -405,23 +413,48 @@ def train(args) -> float:
             f"batches per replica (dataset too small for "
             f"--batch-size {args.batch_size} × {n_replicas} replicas)"
         )
+    if args.bw_probe:
+        probe = allreduce_bandwidth(mesh)
+        log0(
+            "all-reduce probe: %d dev, %.0f MB -> %.1f GB/s bus BW, "
+            "%.1f%% of %s GB/s ICI peak",
+            probe["devices"], probe["payload_mb"], probe["bus_bw_gb_s"],
+            100 * probe["utilization"],
+            f"{probe['peak_gb_s']:.0f}" if probe["peak_gb_s"] else "unknown",
+        )
+
+    # Throughput accounting: tokens/step for LMs, images/step otherwise
+    # (the BASELINE tokens/s/chip and img/s/chip metrics).
+    if lm:
+        items_per_step, unit = args.batch_size * n_replicas * args.seq_len, "tok"
+    else:
+        items_per_step, unit = args.batch_size * n_replicas, "img"
+    timer = StepTimer(window=max(20, args.log_every))
+
     last_loss = float("nan")
     step_rng = jax.random.PRNGKey(args.seed + 1)
     for epoch in range(start_epoch, args.epochs):        # ref dpp.py:44
-        if args.profile_dir and epoch == start_epoch:
-            jax.profiler.start_trace(args.profile_dir)
-        loader.set_epoch(epoch)                          # ref dpp.py:46
-        for batch_idx, batch in enumerate(loader):       # ref dpp.py:47
-            if args.steps_per_epoch and batch_idx >= args.steps_per_epoch:
-                break
-            step_rng, sub = jax.random.split(step_rng)
-            state, metrics = step_fn(state, batch, sub)
-            if batch_idx % args.log_every == 0:          # ref dpp.py:54-55
-                last_loss = float(metrics["loss"])
-                log0("Epoch %d, Batch %d, Loss: %.4f", epoch, batch_idx, last_loss)
-        if args.profile_dir and epoch == start_epoch:
-            jax.block_until_ready(state.params)
-            jax.profiler.stop_trace()
+        with profile_trace(
+            args.profile_dir if epoch == start_epoch else None,
+            sync=lambda: state.params,  # resolves to the latest state at exit
+        ):
+            loader.set_epoch(epoch)                      # ref dpp.py:46
+            for batch_idx, batch in enumerate(loader):   # ref dpp.py:47
+                if args.steps_per_epoch and batch_idx >= args.steps_per_epoch:
+                    break
+                step_rng, sub = jax.random.split(step_rng)
+                state, metrics = step_fn(state, batch, sub)
+                reading = timer.tick(items_per_step, sync=state.step)
+                if reading and not reading["warmup"]:
+                    log0(
+                        "throughput: %.0f %s/s (%.1f %s/s/chip)",
+                        reading["items_per_s"], unit,
+                        reading["items_per_s_per_chip"], unit,
+                    )
+                if batch_idx % args.log_every == 0:      # ref dpp.py:54-55
+                    last_loss = float(metrics["loss"])
+                    log0("Epoch %d, Batch %d, Loss: %.4f",
+                         epoch, batch_idx, last_loss)
         last_loss = float(metrics["loss"])
         if eval_step is not None:
             evals = []
@@ -443,6 +476,9 @@ def train(args) -> float:
                 log0("Epoch %d eval: %s", epoch, mean)
         if ckpt is not None:
             ckpt.save(state, epoch)
+        if eval_step is not None or ckpt is not None:
+            # Don't let eval/checkpoint wall time pollute throughput.
+            timer.reset()
 
     if ckpt is not None:
         ckpt.wait()

@@ -1,0 +1,43 @@
+"""Observability tests: StepTimer windows, BW probe sanity, trace no-op."""
+
+import jax
+import jax.numpy as jnp
+
+import distributeddataparallel_tpu as ddp
+from distributeddataparallel_tpu.utils import (
+    StepTimer,
+    allreduce_bandwidth,
+    profile_trace,
+)
+
+
+def test_step_timer_windows():
+    t = StepTimer(window=3, n_chips=4)
+    assert t.tick(8) is None
+    assert t.tick(8) is None
+    r = t.tick(8)
+    assert r is not None and r["warmup"]
+    assert r["items_per_s"] > 0
+    assert abs(r["items_per_s_per_chip"] - r["items_per_s"] / 4) < 1e-6
+    for _ in range(2):
+        assert t.tick(8) is None
+    r2 = t.tick(8)
+    assert r2 is not None and not r2["warmup"]
+
+
+def test_allreduce_bandwidth_probe(devices):
+    mesh = ddp.make_mesh(("data",))
+    r = allreduce_bandwidth(mesh, size_mb=1.0, iters=2)
+    assert r["devices"] == 8
+    assert r["bus_bw_gb_s"] > 0
+    assert 0 <= r["utilization"]
+    assert r["payload_mb"] == 1.0
+
+
+def test_profile_trace_noop(tmp_path):
+    with profile_trace(None):
+        pass  # no-op path must not start the profiler
+    x = jnp.ones((8,))
+    with profile_trace(str(tmp_path / "trace"), sync=x):
+        jax.block_until_ready(x * 2)
+    assert any((tmp_path / "trace").rglob("*")), "trace not written"
