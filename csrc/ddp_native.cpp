@@ -1,0 +1,121 @@
+// Native data-pipeline kernels for distributeddataparallel_tpu.
+//
+// The reference reaches native code through torch's C++ DataLoader workers
+// and the DDP Reducer (SURVEY.md §2b); this library is the TPU framework's
+// own native layer for the host-side hot loops:
+//
+//   - gather_rows_f32 / gather_norm_u8: the per-batch fancy-index copy
+//     (and the fused uint8 -> normalized float32 transform of ref
+//     dpp.py:32's ToTensor+Normalize), multithreaded with the GIL
+//     released (called via ctypes from data.loader).
+//   - chw_to_hwc_f32: layout conversion for CHW-stored datasets (CIFAR
+//     pickle payloads) into the NHWC layout TPUs want.
+//   - plan_buckets: the DDP Reducer's 25 MiB reverse-order bucket
+//     assignment (parallel.data_parallel.bucket_gradients planning).
+//
+// Build: csrc/Makefile -> libddp_native.so, loaded lazily by
+// distributeddataparallel_tpu/native/__init__.py (pure-Python fallbacks
+// keep every feature working without the toolchain).
+
+#include <cstdint>
+#include <cstring>
+#include <algorithm>
+#include <thread>
+#include <vector>
+
+namespace {
+
+// Run fn(begin, end) over [0, n) split across up to max_threads threads.
+template <typename Fn>
+void parallel_for(int64_t n, int max_threads, Fn fn) {
+  int hw = static_cast<int>(std::thread::hardware_concurrency());
+  int threads = std::max(1, std::min(max_threads, hw));
+  if (threads == 1 || n < 2) {
+    fn(static_cast<int64_t>(0), n);
+    return;
+  }
+  std::vector<std::thread> pool;
+  int64_t chunk = (n + threads - 1) / threads;
+  for (int t = 0; t < threads; ++t) {
+    int64_t begin = t * chunk;
+    int64_t end = std::min(n, begin + chunk);
+    if (begin >= end) break;
+    pool.emplace_back([=] { fn(begin, end); });
+  }
+  for (auto& th : pool) th.join();
+}
+
+}  // namespace
+
+extern "C" {
+
+// out[i, :] = src[idx[i], :]; rows are row_elems float32 each.
+void ddp_gather_rows_f32(const float* src, const int64_t* idx, int64_t n_idx,
+                         int64_t row_elems, float* out, int max_threads) {
+  parallel_for(n_idx, max_threads, [=](int64_t b, int64_t e) {
+    for (int64_t i = b; i < e; ++i) {
+      std::memcpy(out + i * row_elems, src + idx[i] * row_elems,
+                  sizeof(float) * static_cast<size_t>(row_elems));
+    }
+  });
+}
+
+// out[i, :] = (src[idx[i], :] / 255 - shift) / scale  (u8 -> f32 fused with
+// the reference's ToTensor + Normalize transform, ref dpp.py:32).
+void ddp_gather_norm_u8(const uint8_t* src, const int64_t* idx, int64_t n_idx,
+                        int64_t row_elems, float shift, float scale,
+                        float* out, int max_threads) {
+  const float inv255 = 1.0f / 255.0f;
+  const float inv_scale = 1.0f / scale;
+  parallel_for(n_idx, max_threads, [=](int64_t b, int64_t e) {
+    for (int64_t i = b; i < e; ++i) {
+      const uint8_t* s = src + idx[i] * row_elems;
+      float* o = out + i * row_elems;
+      for (int64_t j = 0; j < row_elems; ++j) {
+        o[j] = (static_cast<float>(s[j]) * inv255 - shift) * inv_scale;
+      }
+    }
+  });
+}
+
+// (N, C, H, W) f32 -> (N, H, W, C): the NHWC layout XLA wants on TPU.
+void ddp_chw_to_hwc_f32(const float* src, int64_t n, int64_t c, int64_t h,
+                        int64_t w, float* out, int max_threads) {
+  parallel_for(n, max_threads, [=](int64_t b, int64_t e) {
+    for (int64_t i = b; i < e; ++i) {
+      const float* img = src + i * c * h * w;
+      float* o = out + i * h * w * c;
+      for (int64_t ch = 0; ch < c; ++ch) {
+        const float* plane = img + ch * h * w;
+        for (int64_t p = 0; p < h * w; ++p) {
+          o[p * c + ch] = plane[p];
+        }
+      }
+    }
+  });
+}
+
+// DDP Reducer bucket planning: walk leaves in REVERSE order (last-produced
+// grads first), start a new bucket when adding a leaf would exceed
+// bucket_bytes (a leaf larger than bucket_bytes gets its own bucket).
+// out_bucket[i] = bucket id of leaf i (ids ordered by reduction order).
+// Returns the number of buckets.
+int64_t ddp_plan_buckets(const int64_t* leaf_bytes, int64_t n_leaves,
+                         int64_t bucket_bytes, int64_t* out_bucket) {
+  int64_t bucket = 0;
+  int64_t used = 0;
+  bool open = false;
+  for (int64_t k = n_leaves - 1; k >= 0; --k) {
+    int64_t b = leaf_bytes[k];
+    if (open && used + b > bucket_bytes) {
+      ++bucket;
+      used = 0;
+    }
+    out_bucket[k] = bucket;
+    used += b;
+    open = true;
+  }
+  return open ? bucket + 1 : 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,180 @@
+"""ctypes bindings for the native (C++) host-side kernels.
+
+The reference's data path runs through torch's native DataLoader/ATen
+copies; this package is the TPU framework's equivalent native layer
+(csrc/ddp_native.cpp): multithreaded batch gather, fused uint8→normalized
+float32 transform, CHW→HWC layout conversion, and DDP-style gradient
+bucket planning.
+
+The library is compiled on first use with the repo's Makefile (g++).
+Everything here degrades gracefully: ``available()`` is False when the
+toolchain or .so is missing and callers fall back to NumPy — features
+never depend on native code, only speed does.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_CSRC = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "csrc",
+)
+_SO = os.path.join(_CSRC, "libddp_native.so")
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_tried = False
+
+#: default worker threads for the gather kernels
+DEFAULT_THREADS = min(8, os.cpu_count() or 1)
+
+
+def _build() -> bool:
+    src = os.path.join(_CSRC, "ddp_native.cpp")
+    if not os.path.exists(src):
+        return False
+    if os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(src):
+        return True
+    try:
+        subprocess.run(
+            ["make", "-C", _CSRC],
+            check=True, capture_output=True, timeout=120,
+        )
+        return os.path.exists(_SO)
+    except Exception:
+        return False
+
+
+def _load() -> ctypes.CDLL | None:
+    global _lib, _tried
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        if not _build():
+            return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError:
+            return None
+        i64 = ctypes.c_int64
+        lib.ddp_gather_rows_f32.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, i64, i64, ctypes.c_void_p,
+            ctypes.c_int,
+        ]
+        lib.ddp_gather_rows_f32.restype = None
+        lib.ddp_gather_norm_u8.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, i64, i64, ctypes.c_float,
+            ctypes.c_float, ctypes.c_void_p, ctypes.c_int,
+        ]
+        lib.ddp_gather_norm_u8.restype = None
+        lib.ddp_chw_to_hwc_f32.argtypes = [
+            ctypes.c_void_p, i64, i64, i64, i64, ctypes.c_void_p, ctypes.c_int,
+        ]
+        lib.ddp_chw_to_hwc_f32.restype = None
+        lib.ddp_plan_buckets.argtypes = [
+            ctypes.c_void_p, i64, i64, ctypes.c_void_p,
+        ]
+        lib.ddp_plan_buckets.restype = i64
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def gather_rows(src: np.ndarray, idx: np.ndarray) -> np.ndarray:
+    """out[i] = src[idx[i]] — native multithreaded when possible.
+
+    Fast path requires C-contiguous float32 src; anything else falls back
+    to NumPy fancy indexing (identical result).
+    """
+    lib = _load()
+    if (
+        lib is None
+        or src.dtype != np.float32
+        or not src.flags.c_contiguous
+        or src.ndim < 2
+    ):
+        return src[idx]
+    idx = np.ascontiguousarray(idx, dtype=np.int64)
+    out = np.empty((len(idx),) + src.shape[1:], np.float32)
+    row = int(np.prod(src.shape[1:]))
+    lib.ddp_gather_rows_f32(
+        src.ctypes.data, idx.ctypes.data, len(idx), row, out.ctypes.data,
+        DEFAULT_THREADS,
+    )
+    return out
+
+
+def gather_normalize_u8(
+    src: np.ndarray, idx: np.ndarray, *, shift: float = 0.5, scale: float = 0.5
+) -> np.ndarray:
+    """out[i] = (src[idx[i]]/255 - shift)/scale — the reference's
+    ToTensor+Normalize (ref dpp.py:32) fused into the batch gather."""
+    lib = _load()
+    if lib is None or src.dtype != np.uint8 or not src.flags.c_contiguous:
+        return ((src[idx].astype(np.float32) / 255.0) - shift) / scale
+    idx = np.ascontiguousarray(idx, dtype=np.int64)
+    out = np.empty((len(idx),) + src.shape[1:], np.float32)
+    row = int(np.prod(src.shape[1:]))
+    lib.ddp_gather_norm_u8(
+        src.ctypes.data, idx.ctypes.data, len(idx), row,
+        ctypes.c_float(shift), ctypes.c_float(scale), out.ctypes.data,
+        DEFAULT_THREADS,
+    )
+    return out
+
+
+def chw_to_hwc(src: np.ndarray) -> np.ndarray:
+    """(N, C, H, W) float32 -> (N, H, W, C)."""
+    lib = _load()
+    if lib is None or src.dtype != np.float32 or not src.flags.c_contiguous:
+        return np.ascontiguousarray(src.transpose(0, 2, 3, 1))
+    n, c, h, w = src.shape
+    out = np.empty((n, h, w, c), np.float32)
+    lib.ddp_chw_to_hwc_f32(
+        src.ctypes.data, n, c, h, w, out.ctypes.data, DEFAULT_THREADS
+    )
+    return out
+
+
+def plan_buckets(leaf_bytes, bucket_bytes: int) -> list[list[int]]:
+    """DDP Reducer bucket assignment: reverse-order grouping of leaves into
+    ~bucket_bytes buckets.  Returns bucket -> [leaf indices] in reduction
+    order.  Pure-Python fallback matches the native planner exactly."""
+    leaf_bytes = list(leaf_bytes)
+    n = len(leaf_bytes)
+    if n == 0:
+        return []
+    lib = _load()
+    if lib is not None:
+        arr = np.asarray(leaf_bytes, np.int64)
+        out = np.empty(n, np.int64)
+        n_buckets = lib.ddp_plan_buckets(
+            arr.ctypes.data, n, int(bucket_bytes), out.ctypes.data
+        )
+        buckets: list[list[int]] = [[] for _ in range(int(n_buckets))]
+        for k in range(n - 1, -1, -1):  # reduction order: reverse leaves
+            buckets[int(out[k])].append(k)
+        return buckets
+    buckets = []
+    cur: list[int] = []
+    used = 0
+    for k in range(n - 1, -1, -1):
+        b = leaf_bytes[k]
+        if cur and used + b > bucket_bytes:
+            buckets.append(cur)
+            cur, used = [], 0
+        cur.append(k)
+        used += b
+    if cur:
+        buckets.append(cur)
+    return buckets

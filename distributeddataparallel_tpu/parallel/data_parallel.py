@@ -78,21 +78,14 @@ def bucket_gradients(
     XLA scheduler the same freedom to overlap early buckets with remaining
     backward work.
     """
-    leaves, treedef = jax.tree.flatten(grads)
-    order = list(range(len(leaves)))[::-1]
+    from distributeddataparallel_tpu import native
 
-    buckets: list[list[int]] = []
-    cur: list[int] = []
-    cur_bytes = 0
-    for i in order:
-        nbytes = leaves[i].size * leaves[i].dtype.itemsize
-        if cur and cur_bytes + nbytes > bucket_bytes:
-            buckets.append(cur)
-            cur, cur_bytes = [], 0
-        cur.append(i)
-        cur_bytes += nbytes
-    if cur:
-        buckets.append(cur)
+    leaves, treedef = jax.tree.flatten(grads)
+    # Reverse-order ~bucket_bytes grouping, planned by the native layer
+    # (the role DDP gives its C++ Reducer); runs at trace time.
+    buckets = native.plan_buckets(
+        [l.size * l.dtype.itemsize for l in leaves], bucket_bytes
+    )
 
     reduced: list[Any] = [None] * len(leaves)
     for bucket in buckets:

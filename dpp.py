@@ -55,6 +55,8 @@ def parse_args(argv=None):
     p.add_argument("--seed", type=int, default=0)            # ref dpp.py:29
     p.add_argument("--accum-steps", type=int, default=1,
                    help="gradient accumulation (DDP no_sync analog)")
+    p.add_argument("--workers", type=int, default=0,
+                   help="background input-pipeline threads (0 = inline)")
     p.add_argument("--cp", type=int, default=1,
                    help="context-parallel degree: shard the sequence over "
                         "a 'seq' mesh axis with ring attention (LM only)")
@@ -219,7 +221,13 @@ def build_dataset(args, train=True):
         return data.SyntheticClassification(
             num_examples=args.num_examples, seed=args.seed if train else args.seed + 1
         )
-    return data.load_cifar10(args.data_root, train=train)
+    from distributeddataparallel_tpu import native
+
+    # u8 storage + fused native normalize-on-gather when the native lib
+    # is available (identical numerics, less RAM, faster input path).
+    return data.load_cifar10(
+        args.data_root, train=train, keep_u8=native.available()
+    )
 
 
 def train(args) -> float:
@@ -260,6 +268,7 @@ def train(args) -> float:
     loader = DataLoader(
         dataset, per_replica_batch=args.batch_size, mesh=mesh,
         shuffle=True, seed=args.seed, place_fn=place_fn,
+        workers=args.workers,
     )
 
     lm = is_lm(args)
