@@ -70,18 +70,25 @@ def main() -> None:
     batch = shard_batch(batch, mesh)
     key = jax.random.PRNGKey(1)
 
-    # compile + warmup
-    state, _ = step(state, batch, key)
-    jax.block_until_ready(state.params)
-    for _ in range(3):
-        state, _ = step(state, batch, key)
-    jax.block_until_ready(state.params)
+    # compile + warmup.  Fence by reading VALUES computed from the updated
+    # params: that forces the whole step chain including the final
+    # optimizer update.  (block_until_ready on donated params is NOT a
+    # reliable fence on this runtime — donation aliasing can report the
+    # buffer ready early, which once inflated this number ~35x; the last
+    # step's loss alone would still exclude that step's backward/update.)
+    def fence(state):
+        leaf = jax.tree.leaves(state.params)[0]
+        return float(jnp.sum(leaf.astype(jnp.float32)))
+
+    for _ in range(4):
+        state, metrics = step(state, batch, key)
+    fence(state)
 
     iters = 20
     t0 = time.perf_counter()
     for _ in range(iters):
         state, metrics = step(state, batch, key)
-    jax.block_until_ready(state.params)
+    assert fence(state) == fence(state), "NaN params in benchmark"
     dt = time.perf_counter() - t0
 
     img_s = iters * B / dt

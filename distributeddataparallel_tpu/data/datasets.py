@@ -33,14 +33,19 @@ class ArrayDataset:
     so consumers never observe raw uint8 values.
     """
 
-    #: when True, images are stored uint8 and normalized on access
-    normalize_u8: bool = False
-
-    def __init__(self, images: np.ndarray, labels: np.ndarray):
+    def __init__(
+        self,
+        images: np.ndarray,
+        labels: np.ndarray,
+        *,
+        normalize_u8: bool = False,
+    ):
         if len(images) != len(labels):
             raise ValueError("images/labels length mismatch")
         self.images = images
         self.labels = labels
+        #: when True, images are stored uint8 and normalized on access
+        self.normalize_u8 = normalize_u8
 
     def __len__(self) -> int:
         return len(self.images)
@@ -236,9 +241,9 @@ def load_cifar10(
     images = np.concatenate(imgs)
     labels = np.concatenate(labels)
     if keep_u8:
-        ds = ArrayDataset(np.ascontiguousarray(images), labels)
-        ds.normalize_u8 = normalize
-        return ds
+        return ArrayDataset(
+            np.ascontiguousarray(images), labels, normalize_u8=normalize
+        )
     if normalize:
         images = normalize_images(images)
     return ArrayDataset(images, labels)

@@ -1,0 +1,275 @@
+"""Pretrained-weight interchange: torch-free storage + torch converters.
+
+The reference's model layer is ``models.resnet18(pretrained=True)``
+(ref dpp.py:14) — weights arrive through torchvision's torch-pickle hub
+format.  This module gives the TPU framework the same capability without
+a torch dependency on the load path:
+
+- ``save_params`` / ``load_params``: flat safetensors files (portable,
+  zero-copy, no pickle) keyed by ``/``-joined pytree paths.
+- ``convert_gpt2_hf``: HuggingFace GPT-2 checkpoint tensors → this
+  framework's ``TransformerLM`` param tree (verified logit-level against
+  the HF torch implementation in tests/test_io.py).
+- ``convert_resnet_torch``: torchvision ResNet ``state_dict`` →
+  ``models.resnet.ResNet`` params + batch stats (and ``export_resnet_torch``,
+  its inverse, used for round-trip testing and for handing weights back
+  to torch users).
+
+torch itself is only needed to *read* .pth files (``load_torch_state_dict``);
+all converters operate on plain NumPy arrays.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+import jax
+import numpy as np
+
+Pytree = Any
+SEP = "/"
+
+
+# --------------------------- flat safetensors ---------------------------
+
+def flatten_tree(tree: Pytree) -> dict[str, np.ndarray]:
+    flat = jax.tree_util.tree_flatten_with_path(tree)[0]
+    out = {}
+    for path, leaf in flat:
+        key = SEP.join(
+            str(getattr(p, "key", getattr(p, "idx", p))) for p in path
+        )
+        out[key] = np.asarray(leaf)
+    return out
+
+
+def unflatten_into(
+    like: Pytree, flat: Mapping[str, np.ndarray], *, strict: bool = True
+) -> Pytree:
+    """Rebuild `like`'s structure from flat keys; shapes must match.
+
+    ``strict`` (default) also rejects checkpoint keys that `like` does not
+    consume — a superset checkpoint (different num_layers, wrong model)
+    must fail loudly, not half-restore.
+    """
+    paths, treedef = jax.tree_util.tree_flatten_with_path(like)
+    leaves = []
+    used = set()
+    for path, leaf in paths:
+        key = SEP.join(
+            str(getattr(p, "key", getattr(p, "idx", p))) for p in path
+        )
+        if key not in flat:
+            raise KeyError(f"missing weight {key!r}")
+        used.add(key)
+        arr = np.asarray(flat[key])
+        if arr.shape != leaf.shape:
+            raise ValueError(
+                f"{key}: shape {arr.shape} != expected {leaf.shape}"
+            )
+        leaves.append(arr.astype(leaf.dtype))
+    if strict:
+        extra = set(flat) - used
+        if extra:
+            raise ValueError(
+                f"checkpoint has {len(extra)} unconsumed keys, e.g. "
+                f"{sorted(extra)[:5]} (pass strict=False to ignore)"
+            )
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def save_params(params: Pytree, path: str) -> None:
+    from safetensors.numpy import save_file
+
+    save_file(flatten_tree(params), path)
+
+
+def load_params(path: str, like: Pytree | None = None) -> Pytree:
+    """Load a safetensors file; with ``like``, restore into its structure
+    (shape-checked), else return the flat dict."""
+    from safetensors.numpy import load_file
+
+    flat = load_file(path)
+    if like is None:
+        return flat
+    return unflatten_into(like, flat)
+
+
+def load_torch_state_dict(path: str) -> dict[str, np.ndarray]:
+    """Read a torch .pth/.pt state_dict into NumPy (CPU, no grad)."""
+    import torch
+
+    sd = torch.load(path, map_location="cpu", weights_only=True)
+    if hasattr(sd, "state_dict"):
+        sd = sd.state_dict()
+    return {k: v.detach().numpy() for k, v in sd.items()}
+
+
+# ----------------------------- GPT-2 (HF) --------------------------------
+
+def convert_gpt2_hf(
+    sd: Mapping[str, np.ndarray], cfg
+) -> Pytree:
+    """HF GPT-2 tensors -> TransformerLM params (cfg from ``gpt2_124m``).
+
+    HF layout notes: Conv1D stores (in, out) so kernels need no
+    transpose for x @ W; c_attn packs q,k,v along the output dim;
+    lm_head is tied to wte (cfg.tie_embeddings must be True).
+    """
+    H, D, d = cfg.num_heads, cfg.dims_per_head, cfg.d_model
+
+    def g(key):
+        for k in (key, f"transformer.{key}"):
+            if k in sd:
+                return np.asarray(sd[k])
+        raise KeyError(key)
+
+    params: dict[str, Any] = {
+        "token_embed": {"embedding": g("wte.weight")},
+        "pos_embed": g("wpe.weight")[: cfg.max_seq_len],
+        "final_norm": {"scale": g("ln_f.weight"), "bias": g("ln_f.bias")},
+    }
+    for i in range(cfg.num_layers):
+        p = f"h.{i}."
+        qkv_w = g(p + "attn.c_attn.weight")  # (d, 3d)
+        qkv_b = g(p + "attn.c_attn.bias")    # (3d,)
+        qw, kw, vw = np.split(qkv_w, 3, axis=1)
+        qb, kb, vb = np.split(qkv_b, 3)
+        params[f"layer_{i}"] = {
+            "attn_norm": {
+                "scale": g(p + "ln_1.weight"), "bias": g(p + "ln_1.bias")
+            },
+            "attn": {
+                "q_proj": {"kernel": qw.reshape(d, H, D),
+                           "bias": qb.reshape(H, D)},
+                "k_proj": {"kernel": kw.reshape(d, H, D),
+                           "bias": kb.reshape(H, D)},
+                "v_proj": {"kernel": vw.reshape(d, H, D),
+                           "bias": vb.reshape(H, D)},
+                "o_proj": {
+                    "kernel": g(p + "attn.c_proj.weight").reshape(H, D, d),
+                    "bias": g(p + "attn.c_proj.bias"),
+                },
+            },
+            "mlp_norm": {
+                "scale": g(p + "ln_2.weight"), "bias": g(p + "ln_2.bias")
+            },
+            "mlp": {
+                "up_proj": {"kernel": g(p + "mlp.c_fc.weight"),
+                            "bias": g(p + "mlp.c_fc.bias")},
+                "down_proj": {"kernel": g(p + "mlp.c_proj.weight"),
+                              "bias": g(p + "mlp.c_proj.bias")},
+            },
+        }
+    return params
+
+
+# --------------------------- ResNet (torch) ------------------------------
+
+def convert_resnet_torch(
+    sd: Mapping[str, np.ndarray],
+    like_variables: Pytree,
+    stage_sizes,
+    *,
+    bottleneck: bool,
+) -> Pytree:
+    """torchvision ResNet state_dict -> {'params', 'batch_stats'} matching
+    ``models.resnet.ResNet`` variables (`like_variables` from model.init).
+
+    Conv kernels transpose OIHW -> HWIO; BN γ/β -> scale/bias and
+    running stats -> batch_stats.
+    """
+    sd = {k: np.asarray(v) for k, v in sd.items()}
+
+    def conv(k):
+        return sd[k].transpose(2, 3, 1, 0)  # OIHW -> HWIO
+
+    def bn(prefix):
+        return (
+            {"scale": sd[prefix + "weight"], "bias": sd[prefix + "bias"]},
+            {"mean": sd[prefix + "running_mean"],
+             "var": sd[prefix + "running_var"]},
+        )
+
+    params: dict[str, Any] = {}
+    stats: dict[str, Any] = {}
+    params["conv_init"] = {"kernel": conv("conv1.weight")}
+    params["bn_init"], stats["bn_init"] = bn("bn1.")
+
+    n_convs = 3 if bottleneck else 2
+    block_cls = "BottleneckBlock" if bottleneck else "BasicBlock"
+    flat_idx = 0
+    for stage, n_blocks in enumerate(stage_sizes):
+        for j in range(n_blocks):
+            tp = f"layer{stage + 1}.{j}."
+            name = f"{block_cls}_{flat_idx}"
+            flat_idx += 1
+            bp: dict[str, Any] = {}
+            bs: dict[str, Any] = {}
+            for c in range(n_convs):
+                bp[f"Conv_{c}"] = {"kernel": conv(tp + f"conv{c + 1}.weight")}
+                bp[f"BatchNorm_{c}"], bs[f"BatchNorm_{c}"] = bn(
+                    tp + f"bn{c + 1}."
+                )
+            if tp + "downsample.0.weight" in sd:
+                bp["conv_proj"] = {"kernel": conv(tp + "downsample.0.weight")}
+                bp["norm_proj"], bs["norm_proj"] = bn(tp + "downsample.1.")
+            params[name] = bp
+            stats[name] = bs
+    params["Dense_0"] = {
+        "kernel": sd["fc.weight"].T, "bias": sd["fc.bias"]
+    }
+
+    want = flatten_tree(like_variables)
+    got = flatten_tree({"params": params, "batch_stats": stats})
+    missing = set(want) - set(got)
+    extra = set(got) - set(want)
+    if missing or extra:
+        raise ValueError(
+            f"resnet conversion mismatch: missing={sorted(missing)[:5]} "
+            f"extra={sorted(extra)[:5]}"
+        )
+    return unflatten_into(like_variables, got)
+
+
+def export_resnet_torch(
+    variables: Pytree, stage_sizes, *, bottleneck: bool
+) -> dict[str, np.ndarray]:
+    """Inverse of ``convert_resnet_torch``: flax variables -> torchvision
+    state_dict layout (HWIO -> OIHW, scale/bias -> weight/bias)."""
+    params = variables["params"]
+    stats = variables["batch_stats"]
+    sd: dict[str, np.ndarray] = {}
+
+    def put_conv(key, kern):
+        sd[key] = np.asarray(kern).transpose(3, 2, 0, 1)
+
+    def put_bn(prefix, p, s):
+        sd[prefix + "weight"] = np.asarray(p["scale"])
+        sd[prefix + "bias"] = np.asarray(p["bias"])
+        sd[prefix + "running_mean"] = np.asarray(s["mean"])
+        sd[prefix + "running_var"] = np.asarray(s["var"])
+
+    put_conv("conv1.weight", params["conv_init"]["kernel"])
+    put_bn("bn1.", params["bn_init"], stats["bn_init"])
+    n_convs = 3 if bottleneck else 2
+    block_cls = "BottleneckBlock" if bottleneck else "BasicBlock"
+    flat_idx = 0
+    for stage, n_blocks in enumerate(stage_sizes):
+        for j in range(n_blocks):
+            tp = f"layer{stage + 1}.{j}."
+            name = f"{block_cls}_{flat_idx}"
+            flat_idx += 1
+            for c in range(n_convs):
+                put_conv(tp + f"conv{c + 1}.weight",
+                         params[name][f"Conv_{c}"]["kernel"])
+                put_bn(tp + f"bn{c + 1}.", params[name][f"BatchNorm_{c}"],
+                       stats[name][f"BatchNorm_{c}"])
+            if "conv_proj" in params[name]:
+                put_conv(tp + "downsample.0.weight",
+                         params[name]["conv_proj"]["kernel"])
+                put_bn(tp + "downsample.1.", params[name]["norm_proj"],
+                       stats[name]["norm_proj"])
+    sd["fc.weight"] = np.asarray(params["Dense_0"]["kernel"]).T
+    sd["fc.bias"] = np.asarray(params["Dense_0"]["bias"])
+    return sd

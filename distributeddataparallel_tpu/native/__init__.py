@@ -41,14 +41,25 @@ def _build() -> bool:
         return False
     if os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(src):
         return True
+    # Build to a private temp name, then atomically rename into place —
+    # concurrent builders can't see a half-written .so, and an interrupted
+    # link never shadows the real artifact (same pattern as the CIFAR
+    # extraction in data.datasets).
+    tmp_name = f".libddp_native.{os.getpid()}.so.tmp"
+    tmp_path = os.path.join(_CSRC, tmp_name)
     try:
         subprocess.run(
-            ["make", "-C", _CSRC],
+            ["make", "-C", _CSRC, f"SO={tmp_name}"],
             check=True, capture_output=True, timeout=120,
         )
-        return os.path.exists(_SO)
+        os.replace(tmp_path, _SO)
+        return True
     except Exception:
-        return False
+        try:
+            os.unlink(tmp_path)
+        except OSError:
+            pass
+        return os.path.exists(_SO)
 
 
 def _load() -> ctypes.CDLL | None:
@@ -97,14 +108,17 @@ def gather_rows(src: np.ndarray, idx: np.ndarray) -> np.ndarray:
     to NumPy fancy indexing (identical result).
     """
     lib = _load()
+    idx = np.ascontiguousarray(idx, dtype=np.int64)
     if (
         lib is None
         or src.dtype != np.float32
         or not src.flags.c_contiguous
         or src.ndim < 2
+        # The C kernel does raw pointer math: negative/OOB indices (which
+        # NumPy would wrap or reject) must take the NumPy path.
+        or (len(idx) and (idx.min() < 0 or idx.max() >= len(src)))
     ):
         return src[idx]
-    idx = np.ascontiguousarray(idx, dtype=np.int64)
     out = np.empty((len(idx),) + src.shape[1:], np.float32)
     row = int(np.prod(src.shape[1:]))
     lib.ddp_gather_rows_f32(
@@ -120,9 +134,14 @@ def gather_normalize_u8(
     """out[i] = (src[idx[i]]/255 - shift)/scale — the reference's
     ToTensor+Normalize (ref dpp.py:32) fused into the batch gather."""
     lib = _load()
-    if lib is None or src.dtype != np.uint8 or not src.flags.c_contiguous:
-        return ((src[idx].astype(np.float32) / 255.0) - shift) / scale
     idx = np.ascontiguousarray(idx, dtype=np.int64)
+    if (
+        lib is None
+        or src.dtype != np.uint8
+        or not src.flags.c_contiguous
+        or (len(idx) and (idx.min() < 0 or idx.max() >= len(src)))
+    ):
+        return ((src[idx].astype(np.float32) / 255.0) - shift) / scale
     out = np.empty((len(idx),) + src.shape[1:], np.float32)
     row = int(np.prod(src.shape[1:]))
     lib.ddp_gather_norm_u8(

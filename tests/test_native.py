@@ -97,8 +97,7 @@ def test_u8_dataset_matches_f32_through_loader(devices):
     rng = np.random.default_rng(0)
     u8 = rng.integers(0, 256, size=(128, 32, 32, 3), dtype=np.uint8)
     labels = rng.integers(0, 10, size=128).astype(np.int32)
-    ds_u8 = ArrayDataset(u8, labels)
-    ds_u8.normalize_u8 = True
+    ds_u8 = ArrayDataset(u8, labels, normalize_u8=True)
     ds_f32 = ArrayDataset(normalize_images(u8), labels)
 
     mesh = ddp.make_mesh(("data",))
@@ -139,8 +138,7 @@ def test_u8_dataset_getitem_normalized():
     from distributeddataparallel_tpu.data.datasets import ArrayDataset
 
     u8 = np.full((4, 2, 2, 3), 255, dtype=np.uint8)
-    ds = ArrayDataset(u8, np.zeros(4, np.int32))
-    ds.normalize_u8 = True
+    ds = ArrayDataset(u8, np.zeros(4, np.int32), normalize_u8=True)
     img, _ = ds[0]
     assert img.dtype == np.float32
     np.testing.assert_allclose(img, 1.0)
