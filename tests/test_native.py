@@ -13,6 +13,24 @@ def test_native_builds_and_loads():
     assert native.available(), "libddp_native.so failed to build/load"
 
 
+def test_native_builds_from_clean_tree(monkeypatch):
+    """Round-1 regression: the lazy build must work with no prebuilt .so.
+
+    `make SO=.dot.tmp` used to fall through to the `clean` rule (GNU make
+    skips dot-prefixed targets when picking a default goal), silently
+    producing nothing and disabling every native kernel forever.
+    """
+    import os
+
+    if os.path.exists(native._SO):
+        os.unlink(native._SO)
+    monkeypatch.setattr(native, "_tried", False)
+    monkeypatch.setattr(native, "_lib", None)
+    assert native._build(), "fresh build produced no .so"
+    assert os.path.exists(native._SO)
+    assert native.available(), "freshly built .so failed to load"
+
+
 def test_gather_rows_matches_numpy():
     rng = np.random.default_rng(0)
     src = rng.normal(size=(100, 7, 3)).astype(np.float32)
