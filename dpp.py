@@ -441,8 +441,12 @@ def train(args) -> float:
     timer = StepTimer(window=max(20, args.log_every))
 
     last_loss = float("nan")
-    step_rng = jax.random.PRNGKey(args.seed + 1)
+    # Per-step RNG is a pure function of (seed, epoch, batch): a --resume'd
+    # run continues the exact stochastic stream (dropout etc.) the
+    # uninterrupted run would have used, instead of replaying epoch-0 keys.
+    base_rng = jax.random.PRNGKey(args.seed + 1)
     for epoch in range(start_epoch, args.epochs):        # ref dpp.py:44
+        epoch_rng = jax.random.fold_in(base_rng, epoch)
         with profile_trace(
             args.profile_dir if epoch == start_epoch else None,
             sync=lambda: state.params,  # resolves to the latest state at exit
@@ -451,7 +455,7 @@ def train(args) -> float:
             for batch_idx, batch in enumerate(loader):   # ref dpp.py:47
                 if args.steps_per_epoch and batch_idx >= args.steps_per_epoch:
                     break
-                step_rng, sub = jax.random.split(step_rng)
+                sub = jax.random.fold_in(epoch_rng, batch_idx)
                 state, metrics = step_fn(state, batch, sub)
                 reading = timer.tick(items_per_step, sync=state.step)
                 if reading and not reading["warmup"]:
