@@ -28,15 +28,21 @@ from distributeddataparallel_tpu.parallel.sampler import DistributedSampler
 Pytree = Any
 
 
-def _place(batch: Pytree, sharding: NamedSharding) -> Pytree:
-    """Put a host batch on device under `sharding` — single sharded
-    device_put on one host, per-process global-array assembly multi-host."""
+def _place(batch: Pytree, sharding) -> Pytree:
+    """Put a host batch on device — single sharded device_put on one host,
+    per-process global-array assembly multi-host.  ``sharding`` is one
+    NamedSharding for every leaf, or a pytree of NamedShardings matching
+    ``batch`` (mixed-rank batches, e.g. a 1-D validity mask riding along
+    2-D token arrays)."""
     if jax.process_count() > 1:
+        if isinstance(sharding, NamedSharding):
+            sharding = jax.tree.map(lambda _: sharding, batch)
         return jax.tree.map(
-            lambda x: jax.make_array_from_process_local_data(
-                sharding, np.asarray(x)
+            lambda x, s: jax.make_array_from_process_local_data(
+                s, np.asarray(x)
             ),
             batch,
+            sharding,
         )
     return jax.device_put(batch, sharding)
 
@@ -56,6 +62,7 @@ def shard_lm_batch(
     mesh: Mesh,
     data_axis: str = "data",
     seq_axis: str = "seq",
+    valid=None,
 ) -> Pytree:
     """Split (B, S+1) host tokens into next-token pairs and shard them
     batch-dim → data axis, seq-dim → seq axis (context parallelism).
@@ -63,10 +70,19 @@ def shard_lm_batch(
     The input/target shift must happen on the host BEFORE sequence
     sharding: position i's target is token i+1, which for the last token
     of a shard lives in the next shard.
+
+    ``valid``: optional (B,) per-row mask (see ``DataLoader(with_mask=)``),
+    sharded along the data axis only.
     """
     tokens = np.asarray(tokens)
     batch = {"inputs": tokens[:, :-1], "targets": tokens[:, 1:]}
-    return _place(batch, NamedSharding(mesh, P(data_axis, seq_axis)))
+    sharding: Any = {
+        k: NamedSharding(mesh, P(data_axis, seq_axis)) for k in batch
+    }
+    if valid is not None:
+        batch["valid"] = np.asarray(valid, np.float32)
+        sharding["valid"] = NamedSharding(mesh, P(data_axis))
+    return _place(batch, sharding)
 
 
 class DataLoader:
@@ -97,6 +113,7 @@ class DataLoader:
         prefetch: int = 1,
         place_fn=None,
         workers: int = 0,
+        with_mask: bool = False,
     ):
         """``place_fn(host_batch) -> device_batch`` overrides the default
         data-axis ``shard_batch`` placement (e.g. ``shard_lm_batch`` for
@@ -108,6 +125,16 @@ class DataLoader:
         overlaps input prep with the training loop.  Values > 1 are
         clamped to 1 (batch order is defined by a single producer) with
         a logged warning.
+
+        ``with_mask=True`` adds a ``"valid"`` key to every batch: a (rows,)
+        float32 mask that is 0 exactly on sampler-padded duplicate rows
+        (the ``drop_last=False`` tail padding that keeps per-replica counts
+        equal).  Pad slots are a pure function of sampler geometry — local
+        position p of replica r maps to global padded-list position
+        ``r + p * num_replicas``, and slots >= dataset_len are padding —
+        independent of the shuffle, so the mask needs no index bookkeeping.
+        Evaluation uses it to compute means over unique samples only
+        (``make_eval_step(masked=True)``).
         """
         self.dataset = dataset
         self.per_replica_batch = per_replica_batch
@@ -132,6 +159,7 @@ class DataLoader:
             )
             workers = 1
         self.workers = workers
+        self.with_mask = with_mask
         self._place_fn = place_fn or (
             lambda b: shard_batch(b, self.mesh, self.axis_name)
         )
@@ -200,11 +228,19 @@ class DataLoader:
         shards = [s.local_indices() for s in self._samplers]
         B = self.per_replica_batch
         for step in range(self.steps_per_epoch):
-            rows = []
-            for shard in shards:
+            rows, masks = [], []
+            for smp, shard in zip(self._samplers, shards):
                 idx = shard[step * B : (step + 1) * B]
                 rows.append(idx)
-            yield self._gather(np.concatenate(rows))
+                if self.with_mask:
+                    p = np.arange(step * B, step * B + len(idx))
+                    masks.append(
+                        smp.rank + p * smp.num_replicas < smp.dataset_len
+                    )
+            batch = self._gather(np.concatenate(rows))
+            if self.with_mask:
+                batch["valid"] = np.concatenate(masks).astype(np.float32)
+            yield batch
 
     def __iter__(self) -> Iterator[Pytree]:
         it = self._host_batches()

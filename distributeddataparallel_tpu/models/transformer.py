@@ -241,8 +241,26 @@ class TransformerLM(nn.Module):
     def __call__(self, tokens, *, positions=None, deterministic=True):
         cfg = self.cfg
         B, S = tokens.shape
-        if S > cfg.max_seq_len:
-            raise ValueError(f"seq len {S} > max_seq_len {cfg.max_seq_len}")
+        # Under CP the model sees a local shard: the bound check must use
+        # the GLOBAL length, or out-of-range RoPE/pos_embed lookups get
+        # silently clamped by XLA's gather semantics instead of erroring.
+        # psum of a literal over a named axis is a trace-time constant
+        # (the axis size); outside shard_map the axis is unbound -> treat
+        # as unsharded (direct single-device apply / init).
+        n_seq_shards = 1
+        if cfg.cp_axis is not None:
+            try:
+                n_seq_shards = int(jax.lax.psum(1, cfg.cp_axis))
+            except NameError:
+                n_seq_shards = 1
+        if S * n_seq_shards > cfg.max_seq_len:
+            detail = (
+                f"global seq len {S * n_seq_shards} ({S} local x "
+                f"{n_seq_shards} {cfg.cp_axis!r} shards)"
+                if n_seq_shards > 1
+                else f"seq len {S}"
+            )
+            raise ValueError(f"{detail} > max_seq_len {cfg.max_seq_len}")
         if cfg.cp_axis is not None and positions is None:
             from distributeddataparallel_tpu.parallel.context_parallel import (
                 cp_positions,

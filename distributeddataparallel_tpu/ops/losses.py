@@ -11,14 +11,32 @@ import jax.numpy as jnp
 import optax
 
 
+def per_example_cross_entropy(
+    logits: jnp.ndarray, labels: jnp.ndarray
+) -> jnp.ndarray:
+    """Per-row CE: (B, C)/(B,) -> (B,); LM (B, S, V)/(B, S) -> (B,) mean
+    over positions.  Row-resolved so evaluation can mask sampler-padded
+    duplicate rows exactly (see ``make_eval_step(masked=True)``)."""
+    logits = logits.astype(jnp.float32)
+    ce = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+    return ce if ce.ndim == 1 else ce.mean(axis=tuple(range(1, ce.ndim)))
+
+
+def per_example_accuracy(
+    logits: jnp.ndarray, labels: jnp.ndarray
+) -> jnp.ndarray:
+    """Per-row accuracy; trailing (sequence) axes are averaged per row."""
+    hit = (jnp.argmax(logits, axis=-1) == labels).astype(jnp.float32)
+    return hit if hit.ndim == 1 else hit.mean(axis=tuple(range(1, hit.ndim)))
+
+
 def cross_entropy_loss(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
     """Mean softmax CE with integer labels; logits (B, C), labels (B,)."""
-    logits = logits.astype(jnp.float32)
-    return optax.softmax_cross_entropy_with_integer_labels(logits, labels).mean()
+    return per_example_cross_entropy(logits, labels).mean()
 
 
 def accuracy(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
-    return (jnp.argmax(logits, axis=-1) == labels).mean()
+    return per_example_accuracy(logits, labels).mean()
 
 
 def lm_cross_entropy(

@@ -179,20 +179,50 @@ def make_cp_eval_step(
     mesh: Mesh,
     data_axis: str = "data",
     seq_axis: str = "seq",
+    masked: bool = False,
 ):
     """Jit'd DP×CP eval: ``metric_fn(params, batch) -> dict`` per position,
-    pmean'd over both axes."""
+    pmean'd over both axes.
+
+    ``masked=True``: exact evaluation over sampler-padded batches.  The
+    batch is ``{"inputs", "targets", "valid"}`` (``shard_lm_batch`` with a
+    ``valid`` row mask); metric_fn must return PER-ROW vectors over the
+    local (rows, seq-chunk) shard.  Per-row values are first pmean'd over
+    the seq axis (chunks are equal-length, so this is the exact global
+    per-row mean), then masked-mean'd over the data axis so padded
+    duplicate rows contribute nothing.  Returns ``(metrics, count)`` like
+    ``make_eval_step(masked=True)``.
+    """
 
     def _eval(params: Pytree, batch: Pytree):
+        if masked:
+            batch = dict(batch)
+            mask = batch.pop("valid")
         metrics = metric_fn(params, batch)
+        if masked:
+            from distributeddataparallel_tpu.parallel.data_parallel import (
+                masked_tree_mean,
+            )
+
+            return masked_tree_mean(
+                metrics, mask, data_axis, seq_axis=seq_axis
+            )
         return jax.tree.map(
             lambda m: lax.pmean(lax.pmean(m, data_axis), seq_axis), metrics
         )
 
+    if masked:
+        batch_specs: Any = {
+            "inputs": P(data_axis, seq_axis),
+            "targets": P(data_axis, seq_axis),
+            "valid": P(data_axis),
+        }
+    else:
+        batch_specs = P(data_axis, seq_axis)
     sharded = jax.shard_map(
         _eval,
         mesh=mesh,
-        in_specs=(P(), P(data_axis, seq_axis)),
+        in_specs=(P(), batch_specs),
         out_specs=P(),
         check_vma=False,
     )

@@ -175,3 +175,32 @@ class DataParallel:
         from distributeddataparallel_tpu.data.loader import shard_batch
 
         return shard_batch(batch, self.mesh, self.axis_name)
+
+
+def masked_tree_mean(
+    metrics: Pytree,
+    mask: jnp.ndarray,
+    axis_name: str,
+    seq_axis: str | None = None,
+):
+    """Global masked mean of per-row metric trees: ``(means, count)``.
+
+    ``metrics`` leaves are per-row vectors on this shard; ``mask`` is the
+    matching (rows,) validity mask (0 on sampler-padded duplicate rows).
+    With ``seq_axis`` set (DP×CP), per-row values are first pmean'd over
+    the sequence axis — chunks are equal-length, so that is the exact
+    global per-row mean — before the masked reduction over ``axis_name``.
+    The single implementation keeps DP and DP×CP eval semantics from
+    drifting (used by ``make_eval_step`` / ``make_cp_eval_step``).
+    """
+    mask = mask.astype(jnp.float32)
+    den = lax.psum(jnp.sum(mask), axis_name)
+
+    def _mean(v):
+        v = v.astype(jnp.float32)
+        if seq_axis is not None:
+            v = lax.pmean(v, seq_axis)
+        num = lax.psum(jnp.sum(v * mask), axis_name)
+        return num / jnp.maximum(den, 1.0)
+
+    return jax.tree.map(_mean, metrics), den

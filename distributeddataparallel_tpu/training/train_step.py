@@ -229,6 +229,7 @@ def make_eval_step(
     mesh: Mesh,
     axis_name: str = "data",
     with_model_state: bool = False,
+    masked: bool = False,
 ):
     """Jit'd eval step: per-replica metrics pmean'd across the data axis.
 
@@ -236,13 +237,32 @@ def make_eval_step(
     ``metric_fn(params, model_state, batch)``.  The reference has no
     evaluation at all (SURVEY.md §2d.5); this is the beyond-parity minimum
     for the BASELINE configs.
+
+    ``masked=True``: exact evaluation over sampler-padded batches
+    (``DataLoader(with_mask=True)``).  The batch dict carries a per-row
+    ``"valid"`` mask (0 on padded duplicate rows); metric_fn must return
+    PER-ROW vectors (shape (local_rows,), e.g. ``per_example_cross_entropy``)
+    and the step returns ``(metrics, count)``: the global masked means and
+    the global valid-row count.  Padded rows contribute to neither, and
+    weighting each batch's means by its returned count reduces exactly to
+    the mean over unique samples — no host-side knowledge of the sampler's
+    pad geometry required.
     """
 
     def _replica_eval(params: Pytree, model_state: Pytree, batch: Pytree):
+        if masked:
+            batch = dict(batch)
+            mask = batch.pop("valid")
         if with_model_state:
             metrics = metric_fn(params, model_state, batch)
         else:
             metrics = metric_fn(params, batch)
+        if masked:
+            from distributeddataparallel_tpu.parallel.data_parallel import (
+                masked_tree_mean,
+            )
+
+            return masked_tree_mean(metrics, mask, axis_name)
         return jax.tree.map(lambda m: lax.pmean(m, axis_name), metrics)
 
     sharded = jax.shard_map(

@@ -363,33 +363,46 @@ def train(args) -> float:
         if args.resume:
             state, start_epoch = ckpt.restore_latest(state)
 
+    # Evaluation is exact over the padded tail: the loader emits a per-row
+    # "valid" mask (0 on sampler-padded duplicate rows) and the masked eval
+    # steps take per-row metrics, so padded rows contribute nothing.
     eval_step = None
     if args.eval and cp:
-        from distributeddataparallel_tpu.ops import lm_cross_entropy
+        from distributeddataparallel_tpu.data import shard_lm_batch
+        from distributeddataparallel_tpu.ops import (
+            per_example_accuracy,
+            per_example_cross_entropy,
+        )
         from distributeddataparallel_tpu.parallel import make_cp_eval_step
 
         def metric_fn(params, batch):
             logits = model.apply({"params": params}, batch["inputs"])
             return {
-                "loss": lm_cross_entropy(logits, batch["targets"]),
-                "accuracy": accuracy(logits, batch["targets"]),
+                "loss": per_example_cross_entropy(logits, batch["targets"]),
+                "accuracy": per_example_accuracy(logits, batch["targets"]),
             }
-        eval_step = make_cp_eval_step(metric_fn, mesh=mesh)
+        eval_step = make_cp_eval_step(metric_fn, mesh=mesh, masked=True)
         eval_loader = DataLoader(
             build_dataset(args, train=False), per_replica_batch=args.batch_size,
             mesh=mesh, shuffle=False, seed=args.seed, drop_last=False,
-            place_fn=place_fn,
+            with_mask=True,
+            place_fn=lambda b: shard_lm_batch(
+                b["tokens"], mesh, valid=b["valid"]
+            ),
         )
     elif args.eval:
-        if lm:
-            from distributeddataparallel_tpu.ops import lm_cross_entropy
+        from distributeddataparallel_tpu.ops import (
+            per_example_accuracy,
+            per_example_cross_entropy,
+        )
 
+        if lm:
             def metric_fn(params, batch):
                 toks = batch["tokens"]
                 logits = model.apply({"params": params}, toks[:, :-1])
                 return {
-                    "loss": lm_cross_entropy(logits, toks[:, 1:]),
-                    "accuracy": accuracy(logits, toks[:, 1:]),
+                    "loss": per_example_cross_entropy(logits, toks[:, 1:]),
+                    "accuracy": per_example_accuracy(logits, toks[:, 1:]),
                 }
         elif has_ms:
             def metric_fn(params, ms, batch):
@@ -397,23 +410,26 @@ def train(args) -> float:
                     {"params": params, **ms}, batch["image"], train=False
                 )
                 return {
-                    "loss": cross_entropy_loss(logits, batch["label"]),
-                    "accuracy": accuracy(logits, batch["label"]),
+                    "loss": per_example_cross_entropy(logits, batch["label"]),
+                    "accuracy": per_example_accuracy(logits, batch["label"]),
                 }
         else:
             def metric_fn(params, batch):
                 logits = model.apply({"params": params}, batch["image"])
                 return {
-                    "loss": cross_entropy_loss(logits, batch["label"]),
-                    "accuracy": accuracy(logits, batch["label"]),
+                    "loss": per_example_cross_entropy(logits, batch["label"]),
+                    "accuracy": per_example_accuracy(logits, batch["label"]),
                 }
-        eval_step = make_eval_step(metric_fn, mesh=mesh, with_model_state=has_ms)
+        eval_step = make_eval_step(
+            metric_fn, mesh=mesh, with_model_state=has_ms, masked=True
+        )
         # drop_last=False: evaluation must cover the tail of the eval set
         # (sampler padding keeps per-replica counts equal, so the one
         # ragged final batch still shards evenly — worth the extra compile).
         eval_loader = DataLoader(
             build_dataset(args, train=False), per_replica_batch=args.batch_size,
             mesh=mesh, shuffle=False, seed=args.seed, drop_last=False,
+            with_mask=True,
         )
 
     if len(loader) == 0:
@@ -470,16 +486,17 @@ def train(args) -> float:
                          epoch, batch_idx, last_loss)
         last_loss = float(metrics["loss"])
         if eval_step is not None:
+            # Masked eval: each step returns (masked means, valid-row
+            # count); weighting means by counts is exactly the mean over
+            # unique samples — sampler pad duplicates contribute nothing.
             evals = []
             for b in eval_loader:
-                m = (
+                m, cnt = (
                     eval_step(state.params, state.model_state, b)
                     if has_ms and not cp
                     else eval_step(state.params, b)
                 )
-                # Weight by global row count: the ragged final batch
-                # (drop_last=False) must not over-weight its samples.
-                evals.append((m, jax.tree.leaves(b)[0].shape[0]))
+                evals.append((m, float(cnt)))
             if evals:
                 total = sum(n for _, n in evals)
                 mean = {

@@ -124,3 +124,30 @@ def test_cp_train_step_matches_dp(devices):
         jax.tree.leaves(state.params), jax.tree.leaves(params_ref)
     ):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_cp_global_seq_len_guard(devices):
+    """The max_seq_len bound must be checked against the GLOBAL length
+    under CP: 16 local x 8 shards = 128 > 64 must raise instead of
+    letting XLA clamp out-of-range RoPE/pos_embed lookups silently."""
+    mesh = ddp.make_mesh(("seq",))
+    cfg_cp = tiny_lm(max_seq_len=64, cp_axis="seq")
+    model_cp = TransformerLM(cfg_cp)
+    toks = jnp.zeros((1, 64), jnp.int32)  # 8 tokens/shard: global 64, fits
+    params = TransformerLM(tiny_lm(max_seq_len=64)).init(
+        jax.random.PRNGKey(0), toks
+    )["params"]
+
+    def apply_sharded(t):
+        fn = jax.shard_map(
+            lambda p, x: model_cp.apply({"params": p}, x),
+            mesh=mesh,
+            in_specs=(P(), P(None, "seq")),
+            out_specs=P(None, "seq"),
+            check_vma=False,
+        )
+        return jax.jit(fn)(params, t)
+
+    apply_sharded(toks)  # global 64 == max_seq_len: fine
+    with pytest.raises(ValueError, match="global seq len 128"):
+        apply_sharded(jnp.zeros((1, 128), jnp.int32))  # 16/shard: global 128

@@ -85,3 +85,82 @@ def test_normalize_matches_torch_transform():
     ours = normalize_images(img_u8)
     np.testing.assert_allclose(ours, theirs, rtol=1e-6, atol=1e-6)
     assert ours.min() >= -1.0 and ours.max() <= 1.0
+
+
+def test_with_mask_marks_padded_rows(devices):
+    """The "valid" mask is 0 exactly on sampler-padded duplicate rows —
+    positional (pad slots are global positions >= N), so it holds under
+    shuffle too."""
+    mesh = make_mesh(("data",))
+    n = mesh.shape["data"]  # 8
+    ds = SyntheticClassification(num_examples=9, shape=(2, 2, 1), seed=0)
+    loader = DataLoader(
+        ds, per_replica_batch=1, mesh=mesh, shuffle=True, seed=3,
+        drop_last=False, device_feed=False, with_mask=True,
+    )
+    loader.set_epoch(1)
+    batches = list(loader)
+    assert len(batches) == 2  # ceil(9/8) per replica
+    np.testing.assert_array_equal(batches[0]["valid"], np.ones(n))
+    # Second step: only replica 0 (global position 8 < 9) holds a real row.
+    expect = np.zeros(n)
+    expect[0] = 1.0
+    np.testing.assert_array_equal(batches[1]["valid"], expect)
+    assert sum(b["valid"].sum() for b in batches) == len(ds)
+
+
+def test_masked_eval_exact_over_padded_tail(devices):
+    """End-to-end exactness (the DistributedSampler eval-padding trap):
+    9 samples on 8 replicas pad the final batch with 7 duplicates; the
+    masked eval mean must equal the plain mean over the 9 unique rows —
+    duplicates must contribute to NEITHER numerator NOR denominator."""
+    from distributeddataparallel_tpu.training.train_step import make_eval_step
+
+    mesh = make_mesh(("data",))
+    ds = SyntheticClassification(num_examples=9, shape=(2, 2, 1), seed=0)
+    # Distinct per-row "metric": the sample's own mean pixel value.
+    truth = ds.images.reshape(9, -1).mean(axis=1)
+
+    def metric_fn(params, batch):
+        return {"m": batch["image"].reshape(batch["image"].shape[0], -1).mean(axis=1)}
+
+    step = make_eval_step(metric_fn, mesh=mesh, masked=True)
+    loader = DataLoader(
+        ds, per_replica_batch=1, mesh=mesh, shuffle=False, drop_last=False,
+        with_mask=True,
+    )
+    vals = []
+    for b in loader:
+        m, cnt = step({}, b)
+        vals.append((float(m["m"]), float(cnt)))
+
+    assert sum(c for _, c in vals) == len(ds)  # counts = unique rows
+    got = sum(v * c for v, c in vals) / sum(c for _, c in vals)
+    np.testing.assert_allclose(got, truth.mean(), rtol=1e-6)
+
+
+def test_masked_cp_eval_exact(devices):
+    """DP×CP masked eval: per-row metrics pmean'd over the seq axis then
+    masked-mean'd over data must equal the host-side mean over unique rows."""
+    from distributeddataparallel_tpu.data.loader import shard_lm_batch
+    from distributeddataparallel_tpu.parallel import make_cp_eval_step
+
+    mesh = make_mesh(("data", "seq"), shape=(4, 2))
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, 100, size=(6, 9)).astype(np.int32)  # 6 rows
+    valid = np.array([1, 1, 1, 1, 1, 0], np.float32)  # row 5 is a pad dup
+
+    def metric_fn(params, batch):
+        # per-row mean target value over the LOCAL seq chunk
+        return {"m": batch["targets"].astype(np.float32).mean(axis=1)}
+
+    step = make_cp_eval_step(metric_fn, mesh=mesh, masked=True)
+    # 6 rows don't split 4-way: pad to 8 with dups (mask 0) like the sampler.
+    tokens8 = np.concatenate([tokens, tokens[:2]])
+    valid8 = np.concatenate([valid, np.zeros(2, np.float32)])
+    batch = shard_lm_batch(tokens8, mesh, valid=valid8)
+    m, cnt = step({}, batch)
+    assert float(cnt) == 5.0
+
+    want = tokens[:5, 1:].astype(np.float32).mean()  # unique real rows only
+    np.testing.assert_allclose(float(m["m"]), want, rtol=1e-6)
