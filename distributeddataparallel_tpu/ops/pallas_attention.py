@@ -14,10 +14,13 @@ the LM configs (BASELINE 4-5), written against the Pallas TPU guide
 - Causal blocks strictly above the diagonal are skipped with ``pl.when``
   (predicated off — no MXU work, no DMA dependency stalls).
 - Backward: ``custom_vjp`` saving (q, k, v, out, lse); gradients use the
-  standard flash-attention identities with the saved log-sum-exp.  The
-  backward materializes per-(batch,head) probability tiles in XLA (exact,
-  O(S²) there) — the blockwise backward kernel is the known next step;
-  forward is where flash wins first on TPU (VMEM fit for long S).
+  standard flash-attention identities with the saved log-sum-exp,
+  recomputing probability tiles BLOCKWISE in two Pallas kernels (the
+  FlashAttention-2 split): a dq kernel (kv innermost, dq accumulates in
+  VMEM scratch) and a dk/dv kernel (q innermost, dk/dv accumulate in
+  scratch).  The (S, S) probability matrix is never materialized in
+  either direction — backward peak memory is O(S) per device, which is
+  what bounds long-context training.
 
 CPU tests run the same kernel under ``interpret=True``.
 """
@@ -30,7 +33,7 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-from distributeddataparallel_tpu.ops.attention import NEG_INF, causal_mask_bias
+from distributeddataparallel_tpu.ops.attention import NEG_INF
 
 
 def _pick_block(s: int, preferred: tuple[int, ...] = (512, 256, 128)) -> int | None:
@@ -56,6 +59,28 @@ def supported(q, k, v) -> bool:
     )
 
 
+def _block_live(i, j, *, causal: bool, block_q: int, block_k: int, q_offset: int):
+    """Causal block-skip predicate shared by forward and backward kernels:
+    the (q block i, kv block j) tile is live unless it sits strictly above
+    the diagonal.  q_offset aligns query rows to the END of the kv
+    sequence (the Sq != Skv decode convention)."""
+    q_last = q_offset + i * block_q + block_q - 1
+    return (not causal) or (j * block_k <= q_last)
+
+
+def _causal_mask_scores(s, i, j, *, block_q: int, block_k: int, q_offset: int):
+    """Mask the (BQ, BK) score tile above the diagonal with NEG_INF —
+    the single in-kernel statement of the position convention (one copy,
+    so forward and backward can never drift)."""
+    q_pos = q_offset + i * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0
+    )
+    k_pos = j * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1
+    )
+    return jnp.where(k_pos <= q_pos, s, NEG_INF)
+
+
 def _flash_kernel(
     q_ref, k_ref, v_ref,  # (1, BQ, D), (1, BK, D), (1, BK, D)
     o_ref,                # (1, BQ, D)
@@ -74,13 +99,9 @@ def _flash_kernel(
         l_ref[:] = jnp.zeros_like(l_ref)
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    # Causal: block is live unless it sits strictly above the diagonal.
-    # q_offset aligns query rows to the END of the kv sequence (Sq != Skv).
-    q_last = q_offset + i * block_q + block_q - 1
-    k_first = j * block_k
-    live = (not causal) or (k_first <= q_last)
+    geom = dict(block_q=block_q, block_k=block_k, q_offset=q_offset)
 
-    @pl.when(live)
+    @pl.when(_block_live(i, j, causal=causal, **geom))
     def _body():
         q = q_ref[0]  # (BQ, D)
         k = k_ref[0]  # (BK, D)
@@ -89,13 +110,7 @@ def _flash_kernel(
             preferred_element_type=jnp.float32,
         ) * scale  # (BQ, BK)
         if causal:
-            q_pos = q_offset + i * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
-            )
-            k_pos = j * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
-            )
-            s = jnp.where(k_pos <= q_pos, s, NEG_INF)
+            s = _causal_mask_scores(s, i, j, **geom)
 
         m_prev = m_ref[:, 0]                      # (BQ,)
         m_cur = jnp.max(s, axis=1)                # (BQ,)
@@ -165,7 +180,10 @@ def _flash_fwd_impl(q, k, v, *, causal: bool, interpret: bool):
         interpret=interpret,
     )(qf, kf, vf)
     out = out.reshape(B, H, Sq, D).transpose(0, 2, 1, 3)
-    return out, lse[:, 0, :]  # lse flat (B*H, Sq) for the backward
+    # lse stays in its (B*H, 8, Sq) sublane-broadcast layout: the backward
+    # kernels consume exactly this shape, so saving it unsliced avoids a
+    # slice here and a re-broadcast (extra HBM copy) per backward pass.
+    return out, lse
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
@@ -180,32 +198,201 @@ def _fwd(q, k, v, causal, interpret):
     return out, (q, k, v, out, lse)
 
 
+def _recompute_p_ds(
+    q, k, v, do, lse, delta, *,
+    i, j, causal, block_q, block_k, scale, q_offset,
+):
+    """Shared blockwise backward math for one (q block i, kv block j) tile.
+
+    Recomputes the probability tile from the saved log-sum-exp and applies
+    the flash-attention identities:
+
+        p  = exp(s - lse)               (exact softmax row, no renorm pass)
+        dp = do vᵀ
+        ds = p * (dp - delta)           delta = rowsum(do * out), saved
+    """
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale  # (BQ, BK)
+    if causal:
+        s = _causal_mask_scores(
+            s, i, j, block_q=block_q, block_k=block_k, q_offset=q_offset
+        )
+    p = jnp.exp(s - lse[:, None])  # masked entries: exp(NEG_INF - lse) = 0
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # (BQ, BK)
+    ds = p * (dp - delta[:, None])
+    return p, ds
+
+
+def _bwd_dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,  # inputs
+    dq_ref,                                           # (1, BQ, D)
+    dq_acc,                                           # VMEM (BQ, D) f32
+    *, causal: bool, block_q: int, block_k: int, scale: float, q_offset: int,
+):
+    i = pl.program_id(1)  # q block (outer)
+    j = pl.program_id(2)  # kv block (inner: dq accumulates over it)
+    nj = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    @pl.when(_block_live(i, j, causal=causal, block_q=block_q,
+                         block_k=block_k, q_offset=q_offset))
+    def _body():
+        _, ds = _recompute_p_ds(
+            q_ref[0], k_ref[0], v_ref[0], do_ref[0],
+            lse_ref[0, 0], delta_ref[0, 0],
+            i=i, j=j, causal=causal, block_q=block_q, block_k=block_k,
+            scale=scale, q_offset=q_offset,
+        )
+        dq_acc[:] += jax.lax.dot_general(
+            ds.astype(k_ref.dtype), k_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(j == nj - 1)
+    def _finish():
+        dq_ref[0] = (dq_acc[:] * scale).astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,  # inputs
+    dk_ref, dv_ref,                                   # (1, BK, D) each
+    dk_acc, dv_acc,                                   # VMEM (BK, D) f32
+    *, causal: bool, block_q: int, block_k: int, scale: float, q_offset: int,
+):
+    j = pl.program_id(1)  # kv block (outer)
+    i = pl.program_id(2)  # q block (inner: dk/dv accumulate over it)
+    ni = pl.num_programs(2)
+
+    @pl.when(i == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    @pl.when(_block_live(i, j, causal=causal, block_q=block_q,
+                         block_k=block_k, q_offset=q_offset))
+    def _body():
+        q = q_ref[0]
+        do = do_ref[0]
+        p, ds = _recompute_p_ds(
+            q, k_ref[0], v_ref[0], do,
+            lse_ref[0, 0], delta_ref[0, 0],
+            i=i, j=j, causal=causal, block_q=block_q, block_k=block_k,
+            scale=scale, q_offset=q_offset,
+        )
+        dv_acc[:] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dk_acc[:] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(i == ni - 1)
+    def _finish():
+        dk_ref[0] = (dk_acc[:] * scale).astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
 def _bwd(causal, interpret, res, do):
+    """Blockwise flash backward: two Pallas kernels, O(S) peak memory.
+
+    Probability tiles are recomputed per (q block, kv block) pair from the
+    saved lse — the (S, S) matrix never exists.  dq runs with kv blocks
+    innermost (accumulating dq_i in VMEM); dk/dv run with q blocks
+    innermost (accumulating dk_j/dv_j).  ``delta = rowsum(do * out)`` is a
+    cheap O(S·D) XLA reduction done once up front.
+    """
     q, k, v, out, lse = res
     B, Sq, H, D = q.shape
     Skv = k.shape[1]
+    block_q = _pick_block(Sq)
+    block_k = _pick_block(Skv)
     scale = 1.0 / (D ** 0.5)
-    # Exact gradients from saved lse (flash-attention identities):
-    #   p   = exp(s - lse);  dv = pᵀ do
-    #   dp  = do vᵀ;         ds = p * (dp - rowsum(do * out))
-    #   dq  = ds k * scale;  dk = dsᵀ q * scale
-    qf = q.astype(jnp.float32)
-    kf = k.astype(jnp.float32)
-    vf = v.astype(jnp.float32)
-    dof = do.astype(jnp.float32)
-    s = jnp.einsum("bqhd,bkhd->bhqk", qf, kf) * scale
-    if causal:
-        # Same decode-offset convention as the forward kernel, via the one
-        # shared mask helper.
-        s = s + causal_mask_bias(Sq, Skv, q_offset=Skv - Sq)[None, None]
-    p = jnp.exp(s - lse.reshape(B, H, Sq)[..., None])
-    dv = jnp.einsum("bhqk,bqhd->bkhd", p, dof)
-    dp = jnp.einsum("bqhd,bkhd->bhqk", dof, vf)
-    delta = jnp.sum(dof * out.astype(jnp.float32), axis=-1)  # (B, Sq, H)
-    ds = p * (dp - delta.transpose(0, 2, 1)[..., None])
-    dq = jnp.einsum("bhqk,bkhd->bqhd", ds, kf) * scale
-    dk = jnp.einsum("bhqk,bqhd->bkhd", ds, qf) * scale
-    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+    q_offset = Skv - Sq
+
+    # (B, S, H, D) -> (B*H, S, D) flat layout, matching the forward.
+    qf = q.transpose(0, 2, 1, 3).reshape(B * H, Sq, D)
+    kf = k.transpose(0, 2, 1, 3).reshape(B * H, Skv, D)
+    vf = v.transpose(0, 2, 1, 3).reshape(B * H, Skv, D)
+    dof = do.transpose(0, 2, 1, 3).reshape(B * H, Sq, D)
+    outf = out.transpose(0, 2, 1, 3).reshape(B * H, Sq, D)
+
+    delta = jnp.sum(
+        dof.astype(jnp.float32) * outf.astype(jnp.float32), axis=-1
+    )  # (B*H, Sq)
+    # Row vectors enter the kernels broadcast over 8 sublanes (the TPU
+    # (8, 128) tiling minimum).  lse arrives from the forward already in
+    # that layout; only delta needs the broadcast.
+    lse8 = lse
+    delta8 = jnp.broadcast_to(delta[:, None, :], (B * H, 8, Sq))
+
+    from jax.experimental.pallas import tpu as pltpu
+
+    row_specs = [
+        pl.BlockSpec((1, block_q, D), lambda b, x, y: (b, x, 0)),   # q
+        pl.BlockSpec((1, block_k, D), lambda b, x, y: (b, y, 0)),   # k
+        pl.BlockSpec((1, block_k, D), lambda b, x, y: (b, y, 0)),   # v
+        pl.BlockSpec((1, block_q, D), lambda b, x, y: (b, x, 0)),   # do
+        pl.BlockSpec((1, 8, block_q), lambda b, x, y: (b, 0, x)),   # lse
+        pl.BlockSpec((1, 8, block_q), lambda b, x, y: (b, 0, x)),   # delta
+    ]
+    kw = dict(
+        causal=causal, block_q=block_q, block_k=block_k, scale=scale,
+        q_offset=q_offset,
+    )
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, **kw),
+        grid=(B * H, Sq // block_q, Skv // block_k),
+        in_specs=row_specs,
+        out_specs=pl.BlockSpec((1, block_q, D), lambda b, x, y: (b, x, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, Sq, D), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        interpret=interpret,
+    )(qf, kf, vf, dof, lse8, delta8)
+
+    # dkv grid transposes the block loops: (b, kv block, q block).  The
+    # same index maps apply with x=q-block and y=kv-block swapped.
+    kv_specs = [
+        pl.BlockSpec((1, block_q, D), lambda b, y, x: (b, x, 0)),   # q
+        pl.BlockSpec((1, block_k, D), lambda b, y, x: (b, y, 0)),   # k
+        pl.BlockSpec((1, block_k, D), lambda b, y, x: (b, y, 0)),   # v
+        pl.BlockSpec((1, block_q, D), lambda b, y, x: (b, x, 0)),   # do
+        pl.BlockSpec((1, 8, block_q), lambda b, y, x: (b, 0, x)),   # lse
+        pl.BlockSpec((1, 8, block_q), lambda b, y, x: (b, 0, x)),   # delta
+    ]
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, **kw),
+        grid=(B * H, Skv // block_k, Sq // block_q),
+        in_specs=kv_specs,
+        out_specs=[
+            pl.BlockSpec((1, block_k, D), lambda b, y, x: (b, y, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, y, x: (b, y, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, Skv, D), k.dtype),
+            jax.ShapeDtypeStruct((B * H, Skv, D), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, D), jnp.float32),
+            pltpu.VMEM((block_k, D), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf, dof, lse8, delta8)
+
+    dq = dq.reshape(B, H, Sq, D).transpose(0, 2, 1, 3)
+    dk = dk.reshape(B, H, Skv, D).transpose(0, 2, 1, 3)
+    dv = dv.reshape(B, H, Skv, D).transpose(0, 2, 1, 3)
+    return dq, dk, dv
 
 
 flash_attention.defvjp(_fwd, _bwd)

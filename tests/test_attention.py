@@ -153,3 +153,47 @@ def test_flash_supported_gating():
     assert pallas_attention._pick_block(256) == 256
     assert pallas_attention._pick_block(384) == 128
     assert pallas_attention._pick_block(100) is None
+
+
+def test_flash_decode_shape_grads_match_reference():
+    """Sq != Skv backward: the blockwise kernels' q_offset must align query
+    rows to the END of the kv sequence, matching the XLA reference."""
+    q, k, v = _qkv(jax.random.PRNGKey(11), B=1, S=256, H=2, D=16)
+    qh = q[:, 128:]  # 128 queries against 256 kv positions
+
+    def loss_flash(qh, k, v):
+        return jnp.sum(pallas_attention.flash_attention(qh, k, v, True, True) ** 2)
+
+    def loss_ref(qh, k, v):
+        return jnp.sum(dot_product_attention(qh, k, v, causal=True) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(qh, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(qh, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+
+def test_flash_backward_memory_is_linear_in_seq():
+    """Long-context guarantee: backward peak temp memory must scale O(S),
+    not O(S²) — the blockwise kernels never materialize the (S, S)
+    probability matrix (an O(S²) backward at S=2048 needs >100 MB here;
+    the blockwise one a few MB)."""
+
+    def temp_bytes(S):
+        def loss(q, k, v):
+            return jnp.sum(
+                pallas_attention.flash_attention(q, k, v, True, True) ** 2
+            )
+
+        args = [jax.ShapeDtypeStruct((1, S, 2, 16), jnp.float32)] * 3
+        compiled = jax.jit(jax.grad(loss, argnums=(0, 1, 2))).lower(*args).compile()
+        analysis = compiled.memory_analysis()
+        if analysis is None:
+            pytest.skip("backend exposes no memory analysis")
+        return analysis.temp_size_in_bytes
+
+    m512, m1024, m2048 = temp_bytes(512), temp_bytes(1024), temp_bytes(2048)
+    # Linear growth: each doubling adds ~2x the previous increment.
+    # Quadratic growth would multiply increments by ~4 and blow past this.
+    assert m2048 - m1024 < 3 * (m1024 - m512) + (1 << 20), (m512, m1024, m2048)
+    assert m2048 < 8 * m512, (m512, m2048)
