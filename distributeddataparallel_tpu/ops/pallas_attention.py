@@ -54,6 +54,12 @@ def supported(q, k, v) -> bool:
     return (
         _pick_block(Sq) is not None
         and _pick_block(Skv) is not None
+        # Sq > Skv has rows with NO visible keys under the causal
+        # align-to-end convention (q_offset < 0): softmax over an empty
+        # set is undefined and the kernels would emit uniform garbage for
+        # those rows.  Conservatively unsupported (XLA fallback) even for
+        # non-causal, where such shapes are rare.
+        and Sq <= Skv
         and D % 8 == 0
         and D <= 256
     )
@@ -141,6 +147,12 @@ def _flash_fwd_impl(q, k, v, *, causal: bool, interpret: bool):
     block_k = _pick_block(Skv)
     if block_q is None or block_k is None:
         raise ValueError(f"seq lens ({Sq}, {Skv}) not divisible by 128")
+    if causal and Sq > Skv:
+        raise ValueError(
+            f"causal flash attention requires Sq <= Skv (queries align to "
+            f"the END of the kv sequence); got Sq={Sq} > Skv={Skv}, which "
+            f"leaves rows with no visible keys"
+        )
     scale = 1.0 / (D ** 0.5)
 
     # (B, S, H, D) -> (B*H, S, D): one grid row per (batch, head).

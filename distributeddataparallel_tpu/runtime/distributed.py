@@ -78,6 +78,16 @@ def init_process_group(
     )
 
     if explicit or env_multiproc:
+        # jax.distributed.initialize does NOT read the JAX_COORDINATOR_*
+        # env vars itself (only cluster auto-detection, e.g. Cloud TPU
+        # metadata) — resolve the launcher's env contract here so a
+        # spawned child needs no explicit arguments.
+        if coordinator_address is None:
+            coordinator_address = os.environ.get("JAX_COORDINATOR_ADDRESS")
+        if num_processes is None and "JAX_NUM_PROCESSES" in os.environ:
+            num_processes = int(os.environ["JAX_NUM_PROCESSES"])
+        if process_id is None and "JAX_PROCESS_ID" in os.environ:
+            process_id = int(os.environ["JAX_PROCESS_ID"])
         kwargs = {}
         if coordinator_address is not None:
             kwargs["coordinator_address"] = coordinator_address

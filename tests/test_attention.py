@@ -197,3 +197,13 @@ def test_flash_backward_memory_is_linear_in_seq():
     # Quadratic growth would multiply increments by ~4 and blow past this.
     assert m2048 - m1024 < 3 * (m1024 - m512) + (1 << 20), (m512, m1024, m2048)
     assert m2048 < 8 * m512, (m512, m2048)
+
+
+def test_flash_rejects_causal_sq_gt_skv():
+    """Causal Sq > Skv leaves query rows with no visible keys (undefined
+    softmax) — must be rejected, not silently garbage."""
+    q = jnp.zeros((1, 256, 2, 16))
+    kv = jnp.zeros((1, 128, 2, 16))
+    assert not pallas_attention.supported(q, kv, kv)
+    with pytest.raises(ValueError, match="Sq <= Skv"):
+        pallas_attention.flash_attention(q, kv, kv, True, True)
