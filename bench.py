@@ -122,18 +122,75 @@ def bench_resnet50() -> dict:
     state, mean_s, dist = _time_steps(
         step, state, batch, jax.random.PRNGKey(1), warmup=4, iters=20
     )
+
+    # End-to-end variant: the DataLoader feeds the step from host RAM
+    # every step (threaded worker + prefetch — the input pipeline under
+    # load, not a resident batch).  Same compiled step, same shapes.
+    # Two numbers: the host pipeline alone (gather + collate rate), and
+    # the full loader->device->step path.  In THIS environment the
+    # latter crosses a network tunnel to the remote chip (~77 MB/batch),
+    # so it measures tunnel bandwidth, not the framework — flagged via
+    # h2d_note; on a real TPU VM the copy is local PCIe/DMA.
+    from distributeddataparallel_tpu.data import DataLoader
+    from distributeddataparallel_tpu.data.datasets import SyntheticClassification
+
+    ds = SyntheticClassification(
+        num_examples=B * 2, shape=image_shape, num_classes=1000, seed=1
+    )
+    host_loader = DataLoader(
+        ds, per_replica_batch=per_chip_batch, mesh=mesh, shuffle=True,
+        seed=0, device_feed=False,
+    )
+    rows = 0
+    t0 = time.perf_counter()
+    for epoch in range(4):
+        host_loader.set_epoch(epoch)
+        for b in host_loader:
+            rows += b["image"].shape[0]
+    host_img_s = rows / (time.perf_counter() - t0)
+
+    loader = DataLoader(
+        ds, per_replica_batch=per_chip_batch, mesh=mesh, shuffle=True,
+        seed=0, workers=1,
+    )
+    key = jax.random.PRNGKey(2)
+    for b in loader:  # warm epoch (loader thread spin-up, no recompile)
+        state, _ = step(state, b, key)
+    _fence(state)
+    steps = 0
+    t0 = time.perf_counter()
+    for epoch in range(1, 3):
+        loader.set_epoch(epoch)
+        for b in loader:
+            state, _ = step(state, b, key)
+            steps += 1
+    _fence(state)
+    e2e_s = (time.perf_counter() - t0) / max(steps, 1)
+
     return {
         "img_s_chip": round(per_chip_batch / mean_s, 2),
         "per_chip_batch": per_chip_batch,
         "step_ms_mean": round(mean_s * 1e3, 3),
         "step_ms_fenced_chunks": [round(t, 3) for t in dist],
+        "host_pipeline_img_s": round(host_img_s, 1),
+        "e2e_img_s_chip": round(per_chip_batch / e2e_s, 2),
+        "e2e_step_ms": round(e2e_s * 1e3, 3),
+        "e2e_steps": steps,
+        "h2d_note": (
+            "e2e pays host->device transfer; through this driver's "
+            "network tunnel that dominates (not framework overhead — "
+            "see host_pipeline_img_s for the input machinery's rate)"
+        ),
     }
 
 
-def bench_gpt2() -> dict:
-    """GPT-2 124M pure-DP LM step (BASELINE config 4): tokens/s/chip,
-    measured once with the Pallas flash kernel and once with the XLA
-    attention path; the winner is what users get from attn_impl='auto'."""
+def _gpt2_setup(attn_impl: str, *, per_chip_batch: int = 8,
+                seq_len: int = 1024, tx=None):
+    """Shared GPT-2 124M DP fixture: (mesh, loss_fn, state, batch).
+
+    Used by both the throughput and overlap sections so they measure the
+    SAME workload (config, batch geometry, loss) and cannot diverge.
+    """
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -145,39 +202,51 @@ def bench_gpt2() -> dict:
     from distributeddataparallel_tpu.ops import lm_cross_entropy
 
     mesh = ddp.make_mesh(("data",))
-    n_dev = len(jax.devices())
-    per_chip_batch, seq_len = 8, 1024
-    B = per_chip_batch * n_dev
+    B = per_chip_batch * len(jax.devices())
+    cfg = gpt2_124m(max_seq_len=seq_len, dtype=jnp.bfloat16,
+                    attn_impl=attn_impl)
+    model = TransformerLM(cfg)
+    # init at full seq_len (the forced-pallas path rejects non-block-
+    # aligned shapes); jit'd to avoid eager per-op tunnel round-trips.
+    params = jax.jit(model.init)(
+        jax.random.PRNGKey(0), jnp.zeros((1, seq_len), jnp.int32)
+    )["params"]
 
+    def loss_fn(params, batch, rng):
+        toks = batch["tokens"]
+        logits = model.apply({"params": params}, toks[:, :-1])
+        return lm_cross_entropy(logits, toks[:, 1:]), {}
+
+    state = ddp.TrainState.create(
+        apply_fn=model.apply, params=params, tx=tx or optax.adamw(3e-4)
+    )
+    state = ddp.broadcast_params(state, mesh)
     npr = np.random.default_rng(0)
     batch = shard_batch(
-        {"tokens": npr.integers(0, 50257, size=(B, seq_len + 1)).astype(np.int32)},
+        {"tokens": npr.integers(
+            0, 50257, size=(B, seq_len + 1)
+        ).astype(np.int32)},
         mesh,
     )
+    return mesh, loss_fn, state, batch
 
+
+def bench_gpt2() -> dict:
+    """GPT-2 124M pure-DP LM step (BASELINE config 4): tokens/s/chip,
+    measured once with the Pallas flash kernel and once with the XLA
+    attention path; the winner is what users get from attn_impl='auto'."""
+    import jax
+
+    import distributeddataparallel_tpu as ddp
+
+    per_chip_batch, seq_len = 8, 1024
     results = {}
     for impl in ("pallas", "xla"):
         want_pallas = impl == "pallas" and jax.default_backend() == "tpu"
-        cfg = gpt2_124m(
-            max_seq_len=seq_len, dtype=jnp.bfloat16,
-            attn_impl="pallas" if want_pallas else "xla",
+        mesh, loss_fn, state, batch = _gpt2_setup(
+            "pallas" if want_pallas else "xla",
+            per_chip_batch=per_chip_batch, seq_len=seq_len,
         )
-        model = TransformerLM(cfg)
-        # init at full seq_len (the forced-pallas path rejects non-block-
-        # aligned shapes); jit'd to avoid eager per-op tunnel round-trips.
-        params = jax.jit(model.init)(
-            jax.random.PRNGKey(0), jnp.zeros((1, seq_len), jnp.int32)
-        )["params"]
-
-        def loss_fn(params, batch, rng):
-            toks = batch["tokens"]
-            logits = model.apply({"params": params}, toks[:, :-1])
-            return lm_cross_entropy(logits, toks[:, 1:]), {}
-
-        state = ddp.TrainState.create(
-            apply_fn=model.apply, params=params, tx=optax.adamw(3e-4)
-        )
-        state = ddp.broadcast_params(state, mesh)
         step = ddp.make_train_step(loss_fn, mesh=mesh)
         state, mean_s, dist = _time_steps(
             step, state, batch, jax.random.PRNGKey(1), warmup=3, iters=12
@@ -198,6 +267,24 @@ def bench_gpt2() -> dict:
         "per_chip_batch": per_chip_batch,
         "seq_len": seq_len,
     }
+
+
+def bench_overlap() -> dict:
+    """Comm/compute overlap on the GPT-2 124M DP step (BASELINE config 5's
+    "overlap demonstrated"): full step vs compute-only (grad_sync=False,
+    the no_sync analog) vs bare grad-tree all-reduce.  With one visible
+    chip the collective is a no-op (overlap_frac None); on a multi-chip
+    axis the fraction quantifies how much of the psum XLA hides under the
+    backward."""
+    import jax
+    import optax
+
+    from distributeddataparallel_tpu.utils.metrics import overlap_probe
+
+    mesh, loss_fn, state, batch = _gpt2_setup("auto", tx=optax.sgd(0.01))
+    return overlap_probe(
+        loss_fn, state, batch, jax.random.PRNGKey(1), mesh=mesh, iters=6
+    )
 
 
 def _run(fn, label: str) -> dict:
@@ -237,6 +324,7 @@ def main() -> None:
     dev = jax.devices()[0]
     resnet = _run(bench_resnet50, "resnet50")
     gpt2 = _run(bench_gpt2, "gpt2")
+    overlap = _run(bench_overlap, "overlap")
 
     img_s_chip = resnet.get("img_s_chip", 0.0)
     target = TARGET_FRACTION * A100_DDP_RESNET50_IMG_S
@@ -253,6 +341,7 @@ def main() -> None:
                     "n_devices": len(jax.devices()),
                     "resnet50": resnet,
                     "gpt2_124m": gpt2,
+                    "overlap_gpt2_dp": overlap,
                 },
             }
         )

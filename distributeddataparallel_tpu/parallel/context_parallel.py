@@ -125,6 +125,7 @@ def make_cp_train_step(
     data_axis: str = "data",
     seq_axis: str = "seq",
     donate: bool = True,
+    **kwargs,
 ):
     """Compiled train step with DP × CP sharding.
 
@@ -139,38 +140,17 @@ def make_cp_train_step(
     Batches come pre-split by the host into {"inputs", "targets"} (the
     next-token shift crosses shard boundaries, so it must happen before
     sharding — see ``data.loader.shard_lm_batch``).
+
+    Thin wrapper over ``training.train_step.make_train_step(cp_axis=...)``
+    — every DP feature (gradient accumulation, bucketing, ZeRO-1,
+    grad_sync=False) composes with CP through ``kwargs``.
     """
-    from distributeddataparallel_tpu.training.state import TrainState
+    from distributeddataparallel_tpu.training.train_step import make_train_step
 
-    both = (data_axis, seq_axis)
-
-    def _step(state: TrainState, batch: Pytree, rng: jax.Array):
-        rng = jax.random.fold_in(rng, lax.axis_index(data_axis))
-        rng = jax.random.fold_in(rng, lax.axis_index(seq_axis))
-
-        def local_loss(params):
-            return loss_fn(params, batch, rng)
-
-        (loss, aux), grads = jax.value_and_grad(local_loss, has_aux=True)(
-            state.params
-        )
-        for ax in both:
-            grads = jax.tree.map(lambda g: lax.pmean(g, ax), grads)
-        new_state = state.apply_gradients(grads)
-        metrics = {"loss": lax.pmean(lax.pmean(loss, both[0]), both[1])}
-        for k, v in aux.items():
-            metrics[k] = lax.pmean(lax.pmean(v, both[0]), both[1])
-        return new_state, metrics
-
-    sharded = jax.shard_map(
-        _step,
-        mesh=mesh,
-        in_specs=(P(), P(data_axis, seq_axis), P()),
-        out_specs=(P(), P()),
-        check_vma=False,
+    return make_train_step(
+        loss_fn, mesh=mesh, axis_name=data_axis, cp_axis=seq_axis,
+        donate=donate, **kwargs,
     )
-    jit_kwargs = {"donate_argnums": (0,)} if donate else {}
-    return jax.jit(sharded, **jit_kwargs)
 
 
 def make_cp_eval_step(

@@ -53,6 +53,9 @@ def make_train_step(
     donate: bool = True,
     with_model_state: bool = False,
     zero: bool = False,
+    grad_sync: bool = True,
+    buffer_sync: str = "mean",
+    cp_axis: str | None = None,
 ):
     """Build the jit'd DP train step.
 
@@ -68,17 +71,51 @@ def make_train_step(
     With ``with_model_state=True``, the loss_fn signature becomes
     ``loss_fn(params, model_state, batch, rng) -> (loss, (aux, new_state))``
     — for models with non-gradient state such as BatchNorm running stats.
-    New model state is pmean'd across replicas each step, the SPMD
-    equivalent of DDP keeping module buffers consistent across ranks.
+    ``buffer_sync`` picks how replicas keep those buffers consistent:
+
+    - ``"mean"`` (default): average the stats across the data axis each
+      step — SyncBN-flavored, uses every replica's batch statistics.
+    - ``"broadcast"``: adopt replica 0's buffers everywhere — exactly
+      DDP's ``broadcast_buffers=True`` semantics (rank 0's running stats
+      win, the other replicas' updates are discarded).  Choose this for
+      bit-level parity with the reference's training behavior.
 
     With ``zero=True``, optimizer state is ZeRO-1-sharded across the data
     axis (see ``parallel.zero``): grads reduce_scatter instead of
     all-reduce, the update runs on each replica's 1/N shard, updated
     params all_gather back.  ``state`` must come from ``zero_state``.
     Mutually exclusive with ``bucket_bytes``.
+
+    ``grad_sync=False`` is the ``DDP.no_sync()`` analog: gradients are NOT
+    averaged across the data axis — each replica applies its local grads
+    and params diverge.  For manual accumulation schemes outside the
+    compiled step, and for the comm/compute overlap probe
+    (``utils.metrics.overlap_probe``), which times this compute-only
+    variant against the full step.
+
+    ``cp_axis`` adds context parallelism: batch leaves arrive sharded
+    (batch-dim → ``axis_name``, seq-dim → ``cp_axis``, all rank >= 2) and
+    the model must attend collectively over the sequence axis
+    (``TransformerConfig.cp_axis``, ring attention).  Gradients are first
+    pmean'd over ``cp_axis`` — that reduction COMPLETES the gradient of
+    the sequence-sharded loss (it is model math, not DP sync, so it
+    happens even under ``grad_sync=False``) — then flow through the
+    normal data-axis machinery, so accumulation, bucketing, and ZeRO-1
+    all compose with CP unchanged.
     """
     if zero and bucket_bytes is not None:
         raise ValueError("zero=True does its own reduction; drop bucket_bytes")
+    if not grad_sync and (zero or bucket_bytes is not None):
+        raise ValueError("grad_sync=False skips the reduction entirely; "
+                         "it does not compose with zero/bucket_bytes")
+    if buffer_sync not in ("mean", "broadcast"):
+        # No "local" mode: model state is declared replicated (out_specs
+        # P()), so per-replica divergent buffers would be silently
+        # inconsistent — unlike DDP's broadcast_buffers=False, where each
+        # process legitimately owns its module.
+        raise ValueError(
+            f"buffer_sync must be 'mean' or 'broadcast'; got {buffer_sync!r}"
+        )
     if accum_steps < 1:
         raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
 
@@ -100,6 +137,8 @@ def make_train_step(
         # shard; params/opt state are replicated.
         idx = lax.axis_index(axis_name)
         rng = jax.random.fold_in(rng, idx)
+        if cp_axis is not None:
+            rng = jax.random.fold_in(rng, lax.axis_index(cp_axis))
 
         if accum_steps == 1:
             loss, aux, new_ms, grads = _micro(
@@ -149,6 +188,14 @@ def make_train_step(
             loss = loss * inv
             aux = jax.tree.map(lambda a: a * inv, aux)
 
+        if cp_axis is not None:
+            # Complete the seq-sharded gradient: each position's loss saw
+            # only its sequence chunk; the replicated params' gradient is
+            # the mean over chunks.  Loss/aux likewise become global.
+            grads = jax.tree.map(lambda g: lax.pmean(g, cp_axis), grads)
+            loss = lax.pmean(loss, cp_axis)
+            aux = jax.tree.map(lambda a: lax.pmean(a, cp_axis), aux)
+
         if zero:
             # ZeRO-1: reduce_scatter + sharded update + all_gather.
             from distributeddataparallel_tpu.parallel.zero import zero_update
@@ -161,14 +208,41 @@ def make_train_step(
                 opt_state=new_opt_state,
             )
         else:
-            # THE DDP moment: average grads across the data axis.
-            grads = all_reduce_gradients(
-                grads, axis_name, op="mean", bucket_bytes=bucket_bytes
-            )
+            if grad_sync:
+                # THE DDP moment: average grads across the data axis.
+                grads = all_reduce_gradients(
+                    grads, axis_name, op="mean", bucket_bytes=bucket_bytes
+                )
             new_state = state.apply_gradients(grads)
         if with_model_state:
-            # Keep buffers replicated (SyncBN-flavored: average the stats).
-            new_ms = jax.tree.map(lambda s: lax.pmean(s, axis_name), new_ms)
+            sync_axes = (axis_name,) + (
+                (cp_axis,) if cp_axis is not None else ()
+            )
+            if buffer_sync == "mean":
+                # SyncBN-flavored: average the stats across replicas.
+                for ax in sync_axes:
+                    new_ms = jax.tree.map(
+                        lambda s, a=ax: lax.pmean(s, a), new_ms
+                    )
+            elif buffer_sync == "broadcast":
+                # DDP broadcast_buffers: everyone adopts position 0's
+                # buffers.  Mask to position (0[, 0]) ONCE, then psum over
+                # every sync axis — re-masking between psums would zero
+                # the value on non-zero data ranks before the second
+                # reduction ever sees it.
+                is_zero = lax.axis_index(axis_name) == 0
+                if cp_axis is not None:
+                    is_zero = jnp.logical_and(
+                        is_zero, lax.axis_index(cp_axis) == 0
+                    )
+
+                def _bcast(s):
+                    s = jnp.where(is_zero, s, jnp.zeros_like(s))
+                    for ax in sync_axes:
+                        s = lax.psum(s, ax)
+                    return s
+
+                new_ms = jax.tree.map(_bcast, new_ms)
             new_state = new_state.replace(model_state=new_ms)
         metrics = {"loss": lax.pmean(loss, axis_name)}
         metrics.update(
@@ -176,8 +250,8 @@ def make_train_step(
         )
         return new_state, metrics
 
-    # Params/opt-state replicated (P()), batch sharded on the data axis,
-    # rng replicated; outputs replicated.
+    # Params/opt-state replicated (P()), batch sharded on the data axis
+    # (and the seq axis under CP), rng replicated; outputs replicated.
     #
     # check_vma=False: with varying-manual-axes tracking on, the AD
     # transpose of replicated (unvarying) params inserts an implicit psum,
@@ -186,14 +260,16 @@ def make_train_step(
     # learning rate).  This framework keeps the DDP-style *explicit* sync
     # point — grads stay per-replica until all_reduce_gradients — which is
     # also what makes the bucketed/overlap variants possible.
-    data_axes = (axis_name,)
+    batch_spec = (
+        P(axis_name, cp_axis) if cp_axis is not None else P(axis_name)
+    )
     jit_kwargs = {"donate_argnums": (0,)} if donate else {}
 
     if not zero:
         sharded = jax.shard_map(
             _replica_step,
             mesh=mesh,
-            in_specs=(P(), P(*data_axes), P()),
+            in_specs=(P(), batch_spec, P()),
             out_specs=(P(), P()),
             check_vma=False,
         )
@@ -213,7 +289,7 @@ def make_train_step(
             sharded = jax.shard_map(
                 _replica_step,
                 mesh=mesh,
-                in_specs=(specs, P(*data_axes), P()),
+                in_specs=(specs, batch_spec, P()),
                 out_specs=(specs, P()),
                 check_vma=False,
             )

@@ -2,5 +2,6 @@ from distributeddataparallel_tpu.utils.logging import log0, get_logger  # noqa: 
 from distributeddataparallel_tpu.utils.metrics import (  # noqa: F401
     StepTimer,
     allreduce_bandwidth,
+    overlap_probe,
     profile_trace,
 )

@@ -66,6 +66,12 @@ def parse_args(argv=None):
     p.add_argument("--bucket-mb", type=float, default=None,
                    help="explicit DDP-style gradient bucket size in MiB "
                         "(default: let XLA schedule the all-reduce)")
+    p.add_argument("--buffer-sync", choices=["mean", "broadcast"],
+                   default="mean",
+                   help="BatchNorm-style buffer consistency across replicas: "
+                        "'mean' averages running stats (SyncBN-flavored), "
+                        "'broadcast' adopts replica 0's (exact DDP "
+                        "broadcast_buffers semantics)")
     p.add_argument("--log-every", type=int, default=100)     # ref dpp.py:54
     p.add_argument("--steps-per-epoch", type=int, default=None,
                    help="cap steps per epoch (smoke runs)")
@@ -151,12 +157,6 @@ def validate_args(args) -> None:
     if args.cp > 1:
         if not is_lm(args):
             raise SystemExit("--cp requires an LM model (--model gpt2|llama)")
-        if args.zero:
-            raise SystemExit("--cp with --zero is not supported yet")
-        if args.accum_steps > 1 or args.bucket_mb:
-            raise SystemExit(
-                "--cp composes with plain DP only (no --accum-steps/--bucket-mb)"
-            )
         if args.seq_len % args.cp:
             raise SystemExit("--seq-len must be divisible by --cp")
 
@@ -344,16 +344,14 @@ def train(args) -> float:
             loss = cross_entropy_loss(logits, batch["label"])  # ref dpp.py:40
             return loss, {"accuracy": accuracy(logits, batch["label"])}
 
-    if cp:
-        from distributeddataparallel_tpu.parallel import make_cp_train_step
-
-        step_fn = make_cp_train_step(loss_fn, mesh=mesh)
-    else:
-        step_fn = ddp.make_train_step(
-            loss_fn, mesh=mesh, accum_steps=args.accum_steps,
-            bucket_bytes=int(args.bucket_mb * 1024 * 1024) if args.bucket_mb else None,
-            with_model_state=has_ms, zero=args.zero,
-        )
+    # One factory for every composition: DP × {accum, buckets, ZeRO} × CP.
+    step_fn = ddp.make_train_step(
+        loss_fn, mesh=mesh, accum_steps=args.accum_steps,
+        bucket_bytes=int(args.bucket_mb * 1024 * 1024) if args.bucket_mb else None,
+        with_model_state=has_ms, zero=args.zero,
+        buffer_sync=args.buffer_sync,
+        cp_axis="seq" if cp else None,
+    )
 
     ckpt = None
     start_epoch = 0

@@ -1,0 +1,260 @@
+#!/usr/bin/env python
+"""Llama-3 8B memory-fit analysis (BASELINE config 5 evidence).
+
+Strategy: XLA's compile-time memory assignment is exact, but the full 8B
+config exceeds this chip's HBM and XLA refuses to compile it (its OOM
+message reports only a lower bound).  With ``scan_layers=True`` peak
+memory is affine in the layer count L (scanned layers stack parameters;
+remat keeps one layer's backward live at a time) and in the vocab size V
+(embedding + lm_head params and the f32 logits buffer), with no L x V
+cross term.  So the full config's peak is recovered by measuring configs
+that compile on this chip — the REAL shapes (d_model 4096, d_ff 14336,
+GQA 32/8, full 128256 vocab, seq as given; bf16 compute, f32 params,
+remat + scan, donated state — the exact step ``dpp.py`` runs), just
+fewer layers — and extrapolating only the layer direction:
+
+    peak(32, mb) = peak_measured(L0, full_vocab, mb) + (32-L0)*dL(mb)
+
+The grid runs with STATELESS sgd; optimizer state is then added
+analytically (its exact bytes from ``tx.init``'s abstract shapes — the
+donated update is elementwise, so opt state is purely additional
+resident memory).  Three validation points are measured and reported:
+the L midpoint (affinity in L), the full-vocab column (affinity in V),
+and an sgd+momentum compile (the optimizer-bytes additivity).
+
+Nothing is allocated at any point — compile-only on the real TPU
+backend.  Run: ``python memfit.py [--seq-len 4096]``; output committed
+as MEMFIT.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+# Usable HBM reported by this environment's XLA when a program exceeds it
+# ("Used ... of 15.75G hbm", v5e); memory_stats() is not exposed through
+# the remote-compile tunnel, so the observed figure is the fallback.
+V5E_HBM_BYTES = int(15.75 * (1 << 30))
+V5P_HBM_BYTES = 95 * (1 << 30)  # BASELINE config 5's platform
+
+
+def gb(x: float) -> float:
+    return round(x / (1 << 30), 2)
+
+
+def _abstract_state(model, tx):
+    import jax
+    import jax.numpy as jnp
+
+    import distributeddataparallel_tpu as ddp
+
+    def make():
+        params = model.init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 16), jnp.int32)
+        )["params"]
+        return ddp.TrainState.create(apply_fn=model.apply, params=params, tx=tx)
+
+    return jax.eval_shape(make)
+
+
+def _tree_bytes(tree) -> int:
+    import jax
+
+    return sum(l.size * l.dtype.itemsize for l in jax.tree.leaves(tree))
+
+
+def _peak_bytes(model, tx, mb: int, seq_len: int) -> int:
+    """AOT-compile the DP train step; return XLA's peak memory figure."""
+    import jax
+    import jax.numpy as jnp
+
+    import distributeddataparallel_tpu as ddp
+    from distributeddataparallel_tpu.ops import lm_cross_entropy
+
+    astate = _abstract_state(model, tx)
+
+    def loss_fn(params, batch, rng):
+        toks = batch["tokens"]
+        logits = model.apply({"params": params}, toks[:, :-1])
+        return lm_cross_entropy(logits, toks[:, 1:]), {}
+
+    mesh = ddp.make_mesh(("data",), devices=jax.devices()[:1])
+    step = ddp.make_train_step(loss_fn, mesh=mesh)
+    akey = jax.eval_shape(lambda: jax.random.PRNGKey(0))
+    abatch = {"tokens": jax.ShapeDtypeStruct((mb, seq_len + 1), jnp.int32)}
+    ma = step.lower(astate, abatch, akey).compile().memory_analysis()
+    return ma.peak_memory_in_bytes or (
+        ma.argument_size_in_bytes
+        + ma.temp_size_in_bytes
+        + ma.output_size_in_bytes
+        - ma.alias_size_in_bytes
+    )
+
+
+def analyze(seq_len: int, microbatches=(1, 2)) -> dict:
+    import jax
+    import optax
+
+    from distributeddataparallel_tpu.models import TransformerLM, llama3_8b
+
+    sgd = optax.sgd(1e-3)  # stateless: isolates model memory
+    L0, L1, Lmid = 2, 4, 3
+    V0 = 16032  # small vocab for the layer direction (keeps L=4 on-chip)
+
+    full_cfg = llama3_8b(max_seq_len=seq_len)
+    target_layers, target_vocab = full_cfg.num_layers, full_cfg.vocab_size
+
+    def model_at(L, V):
+        return TransformerLM(
+            llama3_8b(max_seq_len=seq_len, num_layers=L, vocab_size=V)
+        )
+
+    def peak(L, V, mb, tx=sgd):
+        return _peak_bytes(model_at(L, V), tx, mb, seq_len)
+
+    # The FULL-vocab base is measured directly at L0 (it fits on-chip) —
+    # no extrapolation in V at all (vocab-coupled memory is not quite
+    # affine: XLA pads/lays out the big logits buffer differently at
+    # 128256 than at small vocabs; measured 17% off in an earlier affine
+    # attempt).  Only the layer direction, which IS affine under scan
+    # (validated below), is extrapolated.
+    peak_model, checks = {}, []
+    for mb in microbatches:
+        a = peak(L0, V0, mb)
+        dL = (peak(L1, V0, mb) - a) / (L1 - L0)
+        base_full = peak(L0, target_vocab, mb)
+        peak_model[mb] = base_full + (target_layers - L0) * dL
+        if mb == microbatches[0]:
+            # Validation 1: affinity in L — the midpoint must sit on the line.
+            mid_pred = a + (Lmid - L0) * dL
+            mid_meas = peak(Lmid, V0, mb)
+            checks.append({
+                "what": f"L affinity (L={Lmid}, V={V0}, mb={mb})",
+                "predicted_gb": gb(mid_pred), "measured_gb": gb(mid_meas),
+                "rel_err": round(abs(mid_pred - mid_meas) / mid_meas, 4),
+            })
+            # Validation 2: dL is vocab-independent (no L x V cross term) —
+            # the L2->L3 delta at FULL vocab must equal dL measured at V0.
+            try:
+                l3_pred = base_full + (Lmid - L0) * dL
+                l3_meas = peak(Lmid, target_vocab, mb)
+                checks.append({
+                    "what": f"dL vocab-independence (L={Lmid}, "
+                            f"V={target_vocab}, mb={mb})",
+                    "predicted_gb": gb(l3_pred), "measured_gb": gb(l3_meas),
+                    "rel_err": round(abs(l3_pred - l3_meas) / l3_meas, 4),
+                })
+            except Exception as e:  # noqa: BLE001 — validation point OOM
+                checks.append({
+                    "what": f"dL vocab-independence (L={Lmid}): "
+                            f"did not fit on this chip ({type(e).__name__})",
+                    "predicted_gb": None, "measured_gb": None,
+                    "rel_err": None,
+                })
+            # Validation 3: optimizer state adds exactly its bytes.
+            mom = optax.sgd(1e-3, momentum=0.9)
+            mom_bytes = _tree_bytes(
+                _abstract_state(model_at(L0, V0), mom).opt_state
+            )
+            mom_pred = a + mom_bytes
+            mom_meas = peak(L0, V0, mb, tx=mom)
+            checks.append({
+                "what": f"opt-state additivity (sgd+momentum, L={L0}, V={V0})",
+                "predicted_gb": gb(mom_pred), "measured_gb": gb(mom_meas),
+                "rel_err": round(abs(mom_pred - mom_meas) / mom_meas, 4),
+            })
+
+    b0, b1 = microbatches
+    slope = (peak_model[b1] - peak_model[b0]) / (b1 - b0)
+    model_fixed = peak_model[b0] - b0 * slope
+
+    dev = jax.local_devices()[0]
+    hbm = (dev.memory_stats() or {}).get("bytes_limit") or V5E_HBM_BYTES
+
+    full_model = TransformerLM(full_cfg)
+    params_bytes = _tree_bytes(_abstract_state(full_model, sgd).params)
+
+    def max_mb(limit, fixed_bytes):
+        if slope <= 0:
+            return None
+        return max(0, int((limit - fixed_bytes) // slope))
+
+    rows = []
+    for name, tx in (
+        ("sgd", sgd),
+        ("sgd_momentum", optax.sgd(1e-3, momentum=0.9)),
+        ("adamw", optax.adamw(3e-4)),
+    ):
+        opt_bytes = _tree_bytes(_abstract_state(full_model, tx).opt_state)
+        fixed = model_fixed + opt_bytes
+        rows.append({
+            "optimizer": name,
+            "opt_state_gb": gb(opt_bytes),
+            "peak8b_gb": {mb: gb(p + opt_bytes) for mb, p in peak_model.items()},
+            "fixed_gb": gb(fixed),
+            "max_mb_v5e": max_mb(hbm, fixed),
+            "max_mb_v5p": max_mb(V5P_HBM_BYTES, fixed),
+            # ZeRO-1 over N chips keeps 1/N of the opt state per chip
+            # (parallel/zero.py); nothing else changes.
+            "zero1x8_fixed_gb": gb(model_fixed + opt_bytes / 8),
+            "zero1x8_max_mb_v5p": max_mb(
+                V5P_HBM_BYTES, model_fixed + opt_bytes / 8
+            ),
+        })
+
+    return {
+        "device_kind": dev.device_kind,
+        "seq_len": seq_len,
+        "hbm_gb": gb(hbm),
+        "params_gb": gb(params_bytes),
+        "act_gb_per_row": gb(slope),
+        "model_fixed_gb": gb(model_fixed),
+        "validations": checks,
+        "optimizers": rows,
+    }
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--seq-len", type=int, default=4096)
+    args = p.parse_args()
+
+    r = analyze(args.seq_len)
+    print(f"# Llama-3 8B memory fit — measured on {r['device_kind']} "
+          f"({r['hbm_gb']} GB HBM), seq {r['seq_len']}, remat+scan, "
+          f"bf16 compute / f32 params, donated state\n")
+    print(f"Params: {r['params_gb']} GB f32; model fixed cost "
+          f"{r['model_fixed_gb']} GB (params + grads + residue); "
+          f"activations {r['act_gb_per_row']} GB per batch row.  Peaks "
+          f"are XLA's exact compile-time memory assignment (AOT, nothing "
+          f"allocated): the full-128256-vocab base is measured directly "
+          f"at 2 layers, then extrapolated in the layer direction only "
+          f"(affine under scan, validated below); optimizer state adds "
+          f"its exact byte size.  v5p columns project onto a 95 GB chip "
+          f"(BASELINE config 5's platform).\n")
+    print("Regression validations (each predicted from the regression "
+          "basis, then measured directly):\n")
+    for c in r["validations"]:
+        print(f"- {c['what']}: predicted {c['predicted_gb']} GB, measured "
+              f"{c['measured_gb']} GB, rel err {c['rel_err']}")
+    print()
+    print("| optimizer | opt state | 8B peak @mb=1 | 8B peak @mb=2 | "
+          "max mb (v5e 16G) | max mb (v5p 95G) | ZeRO-1x8 fixed | "
+          "ZeRO-1x8 max mb (v5p) |")
+    print("|---|---|---|---|---|---|---|---|")
+    for row in r["optimizers"]:
+        mbs = sorted(row["peak8b_gb"])
+        print(
+            f"| {row['optimizer']} | {row['opt_state_gb']} GB "
+            f"| {row['peak8b_gb'][mbs[0]]} GB | {row['peak8b_gb'][mbs[1]]} GB "
+            f"| {row['max_mb_v5e']} | {row['max_mb_v5p']} "
+            f"| {row['zero1x8_fixed_gb']} GB | {row['zero1x8_max_mb_v5p']} |"
+        )
+    import json
+    print("\n```json")
+    print(json.dumps(r))
+    print("```")
+
+
+if __name__ == "__main__":
+    main()

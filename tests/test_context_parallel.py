@@ -151,3 +151,87 @@ def test_cp_global_seq_len_guard(devices):
     apply_sharded(toks)  # global 64 == max_seq_len: fine
     with pytest.raises(ValueError, match="global seq len 128"):
         apply_sharded(jnp.zeros((1, 128), jnp.int32))  # 16/shard: global 128
+
+
+def test_cp_accum_matches_plain_cp(devices):
+    """CP × gradient accumulation: accumulating 2 microbatches must equal
+    the single-step CP run on the same global batch (no_sync boundary
+    semantics compose with sequence sharding)."""
+    mesh = ddp.make_mesh(("data", "seq"), shape=(4, 2))
+    cfg_cp = tiny_lm(max_seq_len=32, cp_axis="seq")
+    model_cp = TransformerLM(cfg_cp)
+    rng = np.random.default_rng(1)
+    tokens = rng.integers(0, 256, size=(8, 33)).astype(np.int32)
+    params = TransformerLM(tiny_lm(max_seq_len=32)).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 32), jnp.int32)
+    )["params"]
+
+    def loss_fn(p, batch, rng):
+        logits = model_cp.apply({"params": p}, batch["inputs"])
+        return lm_cross_entropy(logits, batch["targets"]), {}
+
+    def run(accum):
+        state = ddp.TrainState.create(
+            apply_fn=model_cp.apply, params=params, tx=optax.sgd(0.1)
+        )
+        state = ddp.broadcast_params(state, mesh)
+        step = make_cp_train_step(
+            loss_fn, mesh=mesh, accum_steps=accum, donate=False
+        )
+        state, metrics = step(
+            state, shard_lm_batch(tokens, mesh), jax.random.PRNGKey(0)
+        )
+        return float(metrics["loss"]), state.params
+
+    loss1, p1 = run(1)
+    loss2, p2 = run(2)
+    assert loss1 == pytest.approx(loss2, rel=1e-6)
+    for a, b in zip(jax.tree.leaves(p1), jax.tree.leaves(p2)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+
+def test_cp_zero_matches_plain_cp(devices):
+    """CP × ZeRO-1: the sharded-optimizer update under sequence sharding
+    must reproduce the replicated CP step exactly (adam state included)."""
+    mesh = ddp.make_mesh(("data", "seq"), shape=(4, 2))
+    cfg_cp = tiny_lm(max_seq_len=32, cp_axis="seq")
+    model_cp = TransformerLM(cfg_cp)
+    rng = np.random.default_rng(2)
+    tokens = [
+        rng.integers(0, 256, size=(8, 33)).astype(np.int32) for _ in range(2)
+    ]
+    params = TransformerLM(tiny_lm(max_seq_len=32)).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 32), jnp.int32)
+    )["params"]
+    tx = optax.adam(1e-2)
+
+    def loss_fn(p, batch, rng):
+        logits = model_cp.apply({"params": p}, batch["inputs"])
+        return lm_cross_entropy(logits, batch["targets"]), {}
+
+    # Replicated CP baseline, two steps.
+    state = ddp.TrainState.create(
+        apply_fn=model_cp.apply, params=params, tx=tx
+    )
+    state = ddp.broadcast_params(state, mesh)
+    step = make_cp_train_step(loss_fn, mesh=mesh, donate=False)
+    for t in tokens:
+        state, _ = step(state, shard_lm_batch(t, mesh), jax.random.PRNGKey(0))
+
+    # ZeRO-1 CP, same two steps.
+    zstate = ddp.zero_state(
+        apply_fn=model_cp.apply, params=ddp.broadcast_params(params, mesh),
+        tx=tx, mesh=mesh,
+    )
+    zstep = make_cp_train_step(loss_fn, mesh=mesh, zero=True, donate=False)
+    for t in tokens:
+        zstate, _ = zstep(
+            zstate, shard_lm_batch(t, mesh), jax.random.PRNGKey(0)
+        )
+
+    for a, b in zip(
+        jax.tree.leaves(state.params), jax.tree.leaves(zstate.params)
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=2e-6
+        )

@@ -8,6 +8,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
+import pytest
 from jax.sharding import PartitionSpec as P
 
 from distributeddataparallel_tpu.models.simple_cnn import TinyMLP
@@ -161,3 +162,86 @@ def test_metrics_are_replicated_and_aux_flows(devices):
     assert set(metrics) == {"loss", "accuracy"}
     assert metrics["loss"].sharding.is_fully_replicated
     assert 0.0 <= float(metrics["accuracy"]) <= 1.0
+
+
+def test_buffer_sync_mean_vs_broadcast(devices):
+    """Model-state consistency modes: 'mean' averages per-replica stats
+    (SyncBN-flavored); 'broadcast' adopts replica 0's exactly (DDP
+    broadcast_buffers semantics)."""
+    mesh = make_mesh(("data",))
+    n = mesh.shape["data"]
+    model, _, _ = _setup()
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8, 8, 1)))["params"]
+
+    def loss_fn(params, ms, batch, rng):
+        logits = model.apply({"params": params}, batch["image"])
+        # Fake buffer update: each replica records ITS batch's pixel mean
+        # (distinct per replica, like BatchNorm running stats would be).
+        new_ms = {"probe": batch["image"].mean()}
+        return cross_entropy_loss(logits, batch["label"]), ({}, new_ms)
+
+    B = 4
+    batch = _fake_batches(1, B * n, seed=3)[0]
+    from distributeddataparallel_tpu.data.loader import shard_batch
+
+    sbatch = shard_batch(batch, mesh)
+    per_replica_means = np.asarray([
+        batch["image"][r * B : (r + 1) * B].mean() for r in range(n)
+    ])
+
+    def run(buffer_sync):
+        state = TrainState.create(
+            apply_fn=model.apply, params=params, tx=optax.sgd(0.1),
+            model_state={"probe": jnp.zeros(())},
+        )
+        state = broadcast_params(state, mesh)
+        step = make_train_step(
+            loss_fn, mesh=mesh, with_model_state=True,
+            buffer_sync=buffer_sync, donate=False,
+        )
+        state, _ = step(state, sbatch, jax.random.PRNGKey(0))
+        return float(state.model_state["probe"])
+
+    assert run("mean") == pytest.approx(float(per_replica_means.mean()), abs=1e-6)
+    assert run("broadcast") == pytest.approx(float(per_replica_means[0]), abs=1e-6)
+    with pytest.raises(ValueError, match="buffer_sync"):
+        make_train_step(
+            loss_fn, mesh=mesh, with_model_state=True, buffer_sync="local"
+        )
+
+
+def test_buffer_sync_broadcast_composes_with_cp(devices):
+    """broadcast under DP×CP must deliver position (0,0)'s buffers to ALL
+    replicas — regression for the re-masked double-psum that zeroed
+    buffers on every data-rank != 0."""
+    from distributeddataparallel_tpu.parallel.sampler import DistributedSampler  # noqa: F401 (layout parity with other tests)
+    from jax.sharding import NamedSharding
+
+    mesh = make_mesh(("data", "seq"), shape=(4, 2))
+
+    def loss_fn(params, ms, batch, rng):
+        # per-position "buffer": this position's input mean (distinct
+        # everywhere); loss ties params in so grads exist.
+        new_ms = {"probe": batch["x"].mean()}
+        return (params["w"] * batch["x"].mean()).sum(), ({}, new_ms)
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(8, 8)).astype(np.float32)  # (rows, seq)
+    batch = jax.device_put(
+        {"x": x}, NamedSharding(mesh, jax.sharding.PartitionSpec("data", "seq"))
+    )
+    state = TrainState.create(
+        apply_fn=None, params={"w": jnp.ones(())}, tx=optax.sgd(0.0),
+        model_state={"probe": jnp.zeros(())},
+    )
+    state = broadcast_params(state, mesh)
+    step = make_train_step(
+        loss_fn, mesh=mesh, with_model_state=True, buffer_sync="broadcast",
+        cp_axis="seq", donate=False,
+    )
+    state, _ = step(state, batch, jax.random.PRNGKey(0))
+    # Position (0,0) holds rows 0-1 x seq cols 0-3.
+    want = float(x[0:2, 0:4].mean())
+    got = np.asarray(state.model_state["probe"])
+    assert got.shape == () or got.size == 1
+    assert float(got) == pytest.approx(want, abs=1e-6)
