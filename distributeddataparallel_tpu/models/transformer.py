@@ -43,6 +43,11 @@ from distributeddataparallel_tpu.ops.attention import (
     repeat_kv,
     rope_frequencies,
 )
+from distributeddataparallel_tpu.parallel.tensor_parallel import (
+    copy_to_tp,
+    reduce_from_tp,
+    tp_size,
+)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -71,6 +76,13 @@ class TransformerConfig:
     # that axis bound; attention becomes ring attention over the axis and
     # positions default to each shard's global offsets.
     cp_axis: str | None = None
+    # Tensor parallelism: name of the mesh axis attention heads and MLP
+    # hidden units are sharded over (Megatron column/row split, see
+    # parallel.tensor_parallel).  When set, the model must run inside
+    # shard_map with that axis bound and params sharded by
+    # ``tp_param_specs``; unbound (init / direct apply) it degrades to
+    # the full unsharded shapes.
+    tp_axis: str | None = None
 
     @property
     def kv_heads(self) -> int:
@@ -139,6 +151,46 @@ def _make_norm(cfg: TransformerConfig, name: str):
     return nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32, name=name)
 
 
+class _RowParallelOut(nn.Module):
+    """Row-parallel output projection (attention o / MLP down).
+
+    Parameter names and full shapes are identical to the DenseGeneral /
+    Dense it replaces (``kernel``, optional ``bias``) so checkpoints and
+    weight-io never see TP.  Under TP the kernel's leading (input) dims
+    are sharded; the partial product is completed with ``reduce_from_tp``
+    and the bias — replicated — is added AFTER the psum (adding it per
+    position would count it tp× times).
+    """
+
+    features: int
+    kernel_shape: tuple  # full kernel shape, batch-axes first
+    contract_ndim: int   # how many trailing input dims the kernel eats
+    use_bias: bool
+    dtype: Any
+    kernel_init: Any
+    tp_axis: Any = None
+
+    @nn.compact
+    def __call__(self, x):
+        n_tp = tp_size(self.tp_axis)
+        shape = (self.kernel_shape[0] // n_tp,) + tuple(self.kernel_shape[1:])
+        kernel = self.param("kernel", self.kernel_init, shape, jnp.float32)
+        cdims = tuple(range(x.ndim - self.contract_ndim, x.ndim))
+        kdims = tuple(range(self.contract_ndim))
+        y = jax.lax.dot_general(
+            x.astype(self.dtype), kernel.astype(self.dtype),
+            ((cdims, kdims), ((), ())),
+        )
+        if self.tp_axis is not None and n_tp > 1:
+            y = reduce_from_tp(y, self.tp_axis)
+        if self.use_bias:
+            bias = self.param(
+                "bias", nn.initializers.zeros, (self.features,), jnp.float32
+            )
+            y = y + bias.astype(self.dtype)
+        return y
+
+
 class Attention(nn.Module):
     cfg: TransformerConfig
 
@@ -147,13 +199,21 @@ class Attention(nn.Module):
         cfg = self.cfg
         B, S, _ = x.shape
         H, Hkv, D = cfg.num_heads, cfg.kv_heads, cfg.dims_per_head
+        n_tp = tp_size(cfg.tp_axis)
+        if H % n_tp or Hkv % n_tp:
+            raise ValueError(
+                f"tp={n_tp} must divide num_heads={H} and kv_heads={Hkv}"
+            )
+        Hl, Hkvl = H // n_tp, Hkv // n_tp  # per-position head counts
+        if cfg.tp_axis is not None and n_tp > 1:
+            x = copy_to_tp(x, cfg.tp_axis)
         dense = lambda feats, name: nn.DenseGeneral(
             feats, axis=-1, dtype=cfg.dtype, name=name, use_bias=cfg.use_bias,
             kernel_init=nn.initializers.normal(0.02),
         )
-        q = dense((H, D), "q_proj")(x)
-        k = dense((Hkv, D), "k_proj")(x)
-        v = dense((Hkv, D), "v_proj")(x)
+        q = dense((Hl, D), "q_proj")(x)
+        k = dense((Hkvl, D), "k_proj")(x)
+        v = dense((Hkvl, D), "v_proj")(x)
         if cfg.positional == "rope":
             # Tables are computed once in TransformerLM and passed down so
             # they sit outside the scanned/remat'd block body.
@@ -162,8 +222,8 @@ class Attention(nn.Module):
             )
             q = apply_rope(q, cos, sin, positions=positions)
             k = apply_rope(k, cos, sin, positions=positions)
-        k = repeat_kv(k, H // Hkv)
-        v = repeat_kv(v, H // Hkv)
+        k = repeat_kv(k, Hl // Hkvl)
+        v = repeat_kv(v, Hl // Hkvl)
         if cfg.cp_axis is not None:
             from distributeddataparallel_tpu.parallel.context_parallel import (
                 ring_attention,
@@ -172,12 +232,18 @@ class Attention(nn.Module):
             out = ring_attention(q, k, v, axis_name=cfg.cp_axis, causal=True)
         else:
             out = attention(q, k, v, causal=True, impl=cfg.attn_impl)
-        out = nn.DenseGeneral(
-            cfg.d_model, axis=(-2, -1), dtype=cfg.dtype, name="o_proj",
+        return _RowParallelOut(
+            features=cfg.d_model,
+            kernel_shape=(H, D, cfg.d_model),
+            contract_ndim=2,
             use_bias=cfg.use_bias,
-            kernel_init=nn.initializers.normal(0.02 / (2 * cfg.num_layers) ** 0.5),
+            dtype=cfg.dtype,
+            kernel_init=nn.initializers.normal(
+                0.02 / (2 * cfg.num_layers) ** 0.5
+            ),
+            tp_axis=cfg.tp_axis,
+            name="o_proj",
         )(out)
-        return out
 
 
 class MLP(nn.Module):
@@ -186,19 +252,34 @@ class MLP(nn.Module):
     @nn.compact
     def __call__(self, x):
         cfg = self.cfg
+        n_tp = tp_size(cfg.tp_axis)
+        if cfg.d_ff % n_tp:
+            raise ValueError(f"tp={n_tp} must divide d_ff={cfg.d_ff}")
+        ffl = cfg.d_ff // n_tp  # per-position hidden width
+        if cfg.tp_axis is not None and n_tp > 1:
+            x = copy_to_tp(x, cfg.tp_axis)
         dense = lambda feats, name: nn.Dense(
             feats, dtype=cfg.dtype, name=name, use_bias=cfg.use_bias,
             kernel_init=nn.initializers.normal(0.02),
         )
         if cfg.activation == "swiglu":
-            gate = dense(cfg.d_ff, "gate_proj")(x)
-            up = dense(cfg.d_ff, "up_proj")(x)
+            gate = dense(ffl, "gate_proj")(x)
+            up = dense(ffl, "up_proj")(x)
             h = nn.silu(gate) * up
         elif cfg.activation == "gelu":
-            h = nn.gelu(dense(cfg.d_ff, "up_proj")(x), approximate=True)
+            h = nn.gelu(dense(ffl, "up_proj")(x), approximate=True)
         else:
             raise ValueError(f"unknown activation {cfg.activation!r}")
-        return dense(cfg.d_model, "down_proj")(h)
+        return _RowParallelOut(
+            features=cfg.d_model,
+            kernel_shape=(cfg.d_ff, cfg.d_model),
+            contract_ndim=1,
+            use_bias=cfg.use_bias,
+            dtype=cfg.dtype,
+            kernel_init=nn.initializers.normal(0.02),
+            tp_axis=cfg.tp_axis,
+            name="down_proj",
+        )(h)
 
 
 class DecoderBlock(nn.Module):
@@ -230,6 +311,28 @@ class _ScanBlock(nn.Module):
             x, positions, rope, deterministic
         )
         return x, None
+
+
+class LMHead(nn.Module):
+    """Untied output projection: params identical to a bias-free Dense
+    (``{"kernel": (d_model, vocab)}`` f32, so checkpoints/weight-io are
+    unchanged), but the matmul takes ``compute_dtype`` operands with f32
+    MXU accumulation instead of casting operands to f32."""
+
+    vocab_size: int
+    compute_dtype: Any
+
+    @nn.compact
+    def __call__(self, x):
+        kernel = self.param(
+            "kernel", nn.initializers.normal(0.02),
+            (x.shape[-1], self.vocab_size), jnp.float32,
+        )
+        return jax.lax.dot_general(
+            x.astype(self.compute_dtype), kernel.astype(self.compute_dtype),
+            (((x.ndim - 1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
 
 
 class TransformerLM(nn.Module):
@@ -316,12 +419,18 @@ class TransformerLM(nn.Module):
                 )
 
         x = _make_norm(cfg, "final_norm")(x)
-        # Logits in f32 (loss precision; analog of the ResNet head rule).
+        # Logits in f32 (loss precision; analog of the ResNet head rule),
+        # but the matmul runs with cfg.dtype OPERANDS and f32 MXU
+        # accumulation (preferred_element_type): f32 operands would run
+        # the vocab-sized matmul at 1/4 MXU rate — measured ~25% of the
+        # whole GPT-2 train step.  Under cfg.dtype=float32 (tests, CPU)
+        # the casts are no-ops and this is exactly the f32 matmul.
         if cfg.tie_embeddings:
-            logits = x.astype(jnp.float32) @ embed.embedding.T.astype(jnp.float32)
+            w = embed.embedding.astype(cfg.dtype)  # (V, D)
+            logits = jax.lax.dot_general(
+                x.astype(cfg.dtype), w, (((x.ndim - 1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
         else:
-            logits = nn.Dense(
-                cfg.vocab_size, dtype=jnp.float32, use_bias=False,
-                kernel_init=nn.initializers.normal(0.02), name="lm_head",
-            )(x.astype(jnp.float32))
+            logits = LMHead(cfg.vocab_size, cfg.dtype, name="lm_head")(x)
         return logits

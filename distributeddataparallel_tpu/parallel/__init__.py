@@ -12,3 +12,10 @@ from distributeddataparallel_tpu.parallel.context_parallel import (  # noqa: F40
     ring_attention,
 )
 from distributeddataparallel_tpu.parallel.zero import zero_state  # noqa: F401
+from distributeddataparallel_tpu.parallel.tensor_parallel import (  # noqa: F401
+    copy_to_tp,
+    reduce_from_tp,
+    shard_state_tp,
+    tp_param_specs,
+    tp_state_specs,
+)

@@ -1,0 +1,187 @@
+"""Tensor parallelism: Megatron-style sharded attention/MLP over a
+``model`` mesh axis (beyond-reference scope; SURVEY.md §2c notes the mesh
+design must not preclude a model axis — this module fills it in).
+
+The TPU-native shape of TP (Shoeybi et al., arXiv 1909.08053 pattern,
+re-expressed for shard_map + ICI collectives):
+
+- Column-parallel projections (q/k/v, MLP up/gate) shard their OUTPUT
+  features over the axis: each position holds ``H / tp`` attention heads
+  and ``d_ff / tp`` hidden units.  Their biases shard with the features.
+- Row-parallel projections (attention o, MLP down) shard their INPUT
+  features; their partial outputs are summed over the axis with one
+  ``psum`` per block — the only two collectives per layer, riding ICI.
+- Activations entering a sharded region pass through ``copy_to_tp``
+  (forward identity, backward psum) and leave through ``reduce_from_tp``
+  (forward psum, backward identity) — the conjugate operator pair that
+  makes every replicated parameter's gradient come out complete and
+  identical on all positions, so the data-parallel gradient sync needs
+  no TP-awareness at all.
+
+Parameter layout is by NAME (``tp_param_specs``): the rules mirror the
+module structure in ``models.transformer`` and tolerate scanned layers
+(extra leading layer dim) by right-aligning the spec.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+Pytree = Any
+
+
+def tp_size(axis_name: str | None) -> int:
+    """Static size of the TP axis: the real size inside shard_map, 1 when
+    the axis is unbound (direct apply / init — full, unsharded shapes)."""
+    if axis_name is None:
+        return 1
+    try:
+        return int(lax.psum(1, axis_name))
+    except NameError:
+        return 1
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def copy_to_tp(x, axis_name: str):
+    """Identity forward, psum backward — entry into a sharded region.
+
+    Downstream column-parallel layers consume the (replicated) input; in
+    the backward pass each position produces only ITS shard's
+    contribution to dx, and this operator's transpose completes it.
+    """
+    return x
+
+
+def _copy_fwd(x, axis_name):
+    return x, None
+
+
+def _copy_bwd(axis_name, _, g):
+    return (lax.psum(g, axis_name),)
+
+
+copy_to_tp.defvjp(_copy_fwd, _copy_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def reduce_from_tp(x, axis_name: str):
+    """psum forward, identity backward — exit from a sharded region.
+
+    Row-parallel layers produce partial sums; the forward psum completes
+    them and the cotangent is already replicated, so the backward is the
+    identity (a psum there would double-count).
+    """
+    return lax.psum(x, axis_name)
+
+
+def _reduce_fwd(x, axis_name):
+    return lax.psum(x, axis_name), None
+
+
+def _reduce_bwd(axis_name, _, g):
+    return (g,)
+
+
+reduce_from_tp.defvjp(_reduce_fwd, _reduce_bwd)
+
+
+# --- Parameter layout ----------------------------------------------------
+
+#: path-suffix -> partition of the TRAILING dims (right-aligned against
+#: the leaf, so scanned layers' leading layer dim stays unsharded).
+_TP_RULES: tuple[tuple[tuple[str, str], tuple[str | None, ...]], ...] = (
+    (("q_proj", "kernel"), (None, "model", None)),   # (d, H, D)
+    (("k_proj", "kernel"), (None, "model", None)),
+    (("v_proj", "kernel"), (None, "model", None)),
+    (("q_proj", "bias"), ("model", None)),           # (H, D)
+    (("k_proj", "bias"), ("model", None)),
+    (("v_proj", "bias"), ("model", None)),
+    (("o_proj", "kernel"), ("model", None, None)),   # (H, D, d)
+    (("o_proj", "bias"), (None,)),                   # added after the psum
+    (("up_proj", "kernel"), (None, "model")),        # (d, f)
+    (("gate_proj", "kernel"), (None, "model")),
+    (("up_proj", "bias"), ("model",)),
+    (("gate_proj", "bias"), ("model",)),
+    (("down_proj", "kernel"), ("model", None)),      # (f, d)
+    (("down_proj", "bias"), (None,)),                # added after the psum
+)
+
+
+def _spec_for_path(path: tuple[str, ...], leaf, axis_name: str) -> P:
+    for suffix, dims in _TP_RULES:
+        if path[-len(suffix):] == suffix:
+            trailing = tuple(
+                axis_name if d == "model" else None for d in dims
+            )
+            pad = leaf.ndim - len(trailing)
+            if pad < 0:
+                raise ValueError(
+                    f"param {'/'.join(path)} has rank {leaf.ndim}, "
+                    f"expected >= {len(trailing)}"
+                )
+            if not any(trailing):
+                return P()  # canonical fully-replicated form
+            return P(*((None,) * pad + trailing))
+    return P()
+
+
+def tp_param_specs(params: Pytree, axis_name: str = "model") -> Pytree:
+    """PartitionSpec tree for a TransformerLM param tree under TP."""
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    treedef = jax.tree.structure(params)
+    specs = []
+    for path, leaf in flat:
+        names = tuple(
+            getattr(k, "key", getattr(k, "name", str(k))) for k in path
+        )
+        specs.append(_spec_for_path(names, leaf, axis_name))
+    return jax.tree.unflatten(treedef, specs)
+
+
+def tp_state_specs(state, axis_name: str = "model") -> Pytree:
+    """Spec tree for a whole TrainState.
+
+    Optimizer state gets the SAME path-suffix rules as params: optax
+    state trees embed the param tree (e.g. ``.../trace/.../q_proj/kernel``
+    for momentum, mu/nu for adam), so the suffix match lands on the right
+    leaves, and scalars like step counts match no rule → replicated.
+    """
+    return state.replace(
+        step=P(),
+        params=tp_param_specs(state.params, axis_name),
+        opt_state=tp_param_specs(state.opt_state, axis_name),
+        model_state=jax.tree.map(lambda _: P(), state.model_state),
+    )
+
+
+def shard_state_tp(state, mesh: Mesh, axis_name: str = "model"):
+    """Place a (host/full) TrainState on the mesh with TP param sharding —
+    the TP analog of ``broadcast_params`` (which fully replicates)."""
+    specs = tp_state_specs(state, axis_name)
+    n = mesh.shape[axis_name]
+    for (path, leaf), spec in zip(
+        jax.tree_util.tree_flatten_with_path(state.params)[0],
+        jax.tree.leaves(specs.params),
+    ):
+        for dim, name in enumerate(spec):
+            if name == axis_name and leaf.shape[dim] % n:
+                pretty = "/".join(
+                    str(getattr(k, "key", k)) for k in path
+                )
+                raise ValueError(
+                    f"TP degree {n} does not divide dim {dim} of param "
+                    f"{pretty} (shape {leaf.shape}) — the model's head/"
+                    f"kv-head/d_ff counts must all be divisible by the "
+                    f"size of the {axis_name!r} mesh axis"
+                )
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        state,
+        specs,
+    )

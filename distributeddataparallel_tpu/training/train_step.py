@@ -56,6 +56,7 @@ def make_train_step(
     grad_sync: bool = True,
     buffer_sync: str = "mean",
     cp_axis: str | None = None,
+    tp_axis: str | None = None,
 ):
     """Build the jit'd DP train step.
 
@@ -102,12 +103,27 @@ def make_train_step(
     happens even under ``grad_sync=False``) — then flow through the
     normal data-axis machinery, so accumulation, bucketing, and ZeRO-1
     all compose with CP unchanged.
+
+    ``tp_axis`` adds tensor parallelism (``parallel.tensor_parallel``):
+    params/opt-state arrive sharded by ``tp_state_specs`` (build the
+    state with ``shard_state_tp``), the batch is replicated over the
+    axis, and the model must set ``TransformerConfig.tp_axis``.  Thanks
+    to the copy/reduce operator pair inside the model, every gradient
+    leaf comes out complete per position — sharded leaves as their local
+    shard, replicated leaves identically everywhere — so the data-axis
+    sync needs no TP-awareness.  ``zero=True`` with TP is not supported
+    (the flat-chunk layout assumes replicated params).
     """
     if zero and bucket_bytes is not None:
         raise ValueError("zero=True does its own reduction; drop bucket_bytes")
     if not grad_sync and (zero or bucket_bytes is not None):
         raise ValueError("grad_sync=False skips the reduction entirely; "
                          "it does not compose with zero/bucket_bytes")
+    if zero and tp_axis is not None:
+        raise ValueError(
+            "zero=True with tp_axis is not supported: ZeRO's flat-chunk "
+            "layout assumes replicated params"
+        )
     if buffer_sync not in ("mean", "broadcast"):
         # No "local" mode: model state is declared replicated (out_specs
         # P()), so per-replica divergent buffers would be silently
@@ -265,7 +281,7 @@ def make_train_step(
     )
     jit_kwargs = {"donate_argnums": (0,)} if donate else {}
 
-    if not zero:
+    if not zero and tp_axis is None:
         sharded = jax.shard_map(
             _replica_step,
             mesh=mesh,
@@ -275,17 +291,27 @@ def make_train_step(
         )
         return jax.jit(sharded, **jit_kwargs)
 
-    # ZeRO: the state's opt leaves are sharded along the data axis, so the
-    # per-leaf spec tree depends on the state structure — build on first
-    # call (jit caches thereafter).
-    from distributeddataparallel_tpu.parallel.zero import state_specs
-
+    # ZeRO / TP: the state's leaves carry per-leaf shardings (ZeRO: flat
+    # opt chunks over the data axis; TP: Megatron param layout over the
+    # model axis), so the spec tree depends on the state structure —
+    # build on first call (jit caches thereafter).
     compiled = None
 
     def step(state: TrainState, batch: Pytree, rng: jax.Array):
         nonlocal compiled
         if compiled is None:
-            specs = state_specs(state, axis_name)
+            if zero:
+                from distributeddataparallel_tpu.parallel.zero import (
+                    state_specs,
+                )
+
+                specs = state_specs(state, axis_name)
+            else:
+                from distributeddataparallel_tpu.parallel.tensor_parallel import (
+                    tp_state_specs,
+                )
+
+                specs = tp_state_specs(state, tp_axis)
             sharded = jax.shard_map(
                 _replica_step,
                 mesh=mesh,

@@ -60,6 +60,10 @@ def parse_args(argv=None):
     p.add_argument("--cp", type=int, default=1,
                    help="context-parallel degree: shard the sequence over "
                         "a 'seq' mesh axis with ring attention (LM only)")
+    p.add_argument("--tp", type=int, default=1,
+                   help="tensor-parallel degree: Megatron column/row "
+                        "sharding of attention heads + MLP hidden over a "
+                        "'model' mesh axis (LM only)")
     p.add_argument("--zero", action="store_true",
                    help="ZeRO-1 optimizer-state sharding across the data "
                         "axis (reduce_scatter + sharded update + all_gather)")
@@ -136,6 +140,11 @@ def setup(args):
         if n % args.cp:
             raise SystemExit(f"--cp {args.cp} does not divide {n} devices")
         return ddp.make_mesh(("data", "seq"), shape=(n // args.cp, args.cp))
+    if args.tp > 1:
+        n = ddp.global_device_count()
+        if n % args.tp:
+            raise SystemExit(f"--tp {args.tp} does not divide {n} devices")
+        return ddp.make_mesh(("data", "model"), shape=(n // args.tp, args.tp))
     return ddp.make_mesh(("data",))
 
 
@@ -159,6 +168,16 @@ def validate_args(args) -> None:
             raise SystemExit("--cp requires an LM model (--model gpt2|llama)")
         if args.seq_len % args.cp:
             raise SystemExit("--seq-len must be divisible by --cp")
+    if args.tp > 1:
+        if not is_lm(args):
+            raise SystemExit("--tp requires an LM model (--model gpt2|llama)")
+        if args.cp > 1:
+            raise SystemExit("--tp with --cp is not supported yet")
+        if args.zero:
+            raise SystemExit(
+                "--tp with --zero is not supported (ZeRO assumes "
+                "replicated params)"
+            )
 
 
 def build_model(args, num_classes: int = 10, vocab_size: int | None = None):
@@ -184,6 +203,8 @@ def build_model(args, num_classes: int = 10, vocab_size: int | None = None):
         )
         if args.cp > 1:
             overrides["cp_axis"] = "seq"
+        if args.tp > 1:
+            overrides["tp_axis"] = "model"
         if args.layers:
             overrides["num_layers"] = args.layers
         if args.d_model:
@@ -198,11 +219,21 @@ def build_model(args, num_classes: int = 10, vocab_size: int | None = None):
             )
             if args.model == "llama":
                 # Largest kv count <= heads/4 that divides heads (GQA
-                # requires num_heads % num_kv_heads == 0).
+                # requires num_heads % num_kv_heads == 0) — and that the
+                # TP degree divides (kv heads shard over the model axis).
                 kv = max(
-                    (d for d in range(1, heads // 4 + 1) if heads % d == 0),
-                    default=1,
+                    (
+                        d for d in range(1, max(heads // 4, args.tp) + 1)
+                        if heads % d == 0 and d % args.tp == 0
+                    ),
+                    default=None,
                 )
+                if kv is None:
+                    raise SystemExit(
+                        f"no GQA kv-head count divides heads={heads} and "
+                        f"is divisible by --tp {args.tp}; pick a larger "
+                        f"--d-model"
+                    )
                 overrides["num_kv_heads"] = kv
         return tfm.TransformerLM(family(**overrides))
     raise NotImplementedError(f"--model {args.model}")
@@ -308,6 +339,13 @@ def train(args) -> float:
             apply_fn=model.apply, params=params, tx=tx, mesh=mesh,
             model_state=model_state,
         )
+    elif args.tp > 1:
+        state = ddp.TrainState.create(
+            apply_fn=model.apply, params=params, tx=tx, model_state=model_state
+        )
+        # TP layout: Megatron param sharding over the 'model' axis,
+        # replicated over 'data' (the broadcast analog for a 2-D mesh).
+        state = ddp.shard_state_tp(state, mesh)
     else:
         state = ddp.TrainState.create(
             apply_fn=model.apply, params=params, tx=tx, model_state=model_state
@@ -344,13 +382,14 @@ def train(args) -> float:
             loss = cross_entropy_loss(logits, batch["label"])  # ref dpp.py:40
             return loss, {"accuracy": accuracy(logits, batch["label"])}
 
-    # One factory for every composition: DP × {accum, buckets, ZeRO} × CP.
+    # One factory for every composition: DP × {accum, buckets, ZeRO} × CP/TP.
     step_fn = ddp.make_train_step(
         loss_fn, mesh=mesh, accum_steps=args.accum_steps,
         bucket_bytes=int(args.bucket_mb * 1024 * 1024) if args.bucket_mb else None,
         with_model_state=has_ms, zero=args.zero,
         buffer_sync=args.buffer_sync,
         cp_axis="seq" if cp else None,
+        tp_axis="model" if args.tp > 1 else None,
     )
 
     ckpt = None
@@ -395,9 +434,22 @@ def train(args) -> float:
         )
 
         if lm:
+            eval_model = model
+            if args.tp > 1:
+                # Eval runs data-parallel with replicated (full) params:
+                # use a non-TP twin so the module expects full shapes even
+                # though the mesh's 'model' axis is bound in the step.
+                import dataclasses
+
+                from distributeddataparallel_tpu.models import TransformerLM
+
+                eval_model = TransformerLM(
+                    dataclasses.replace(model.cfg, tp_axis=None)
+                )
+
             def metric_fn(params, batch):
                 toks = batch["tokens"]
-                logits = model.apply({"params": params}, toks[:, :-1])
+                logits = eval_model.apply({"params": params}, toks[:, :-1])
                 return {
                     "loss": per_example_cross_entropy(logits, toks[:, 1:]),
                     "accuracy": per_example_accuracy(logits, toks[:, 1:]),
@@ -487,12 +539,22 @@ def train(args) -> float:
             # Masked eval: each step returns (masked means, valid-row
             # count); weighting means by counts is exactly the mean over
             # unique samples — sampler pad duplicates contribute nothing.
+            eval_params = state.params
+            if args.tp > 1:
+                # Replicate TP-sharded params ONCE per epoch (a single
+                # all-gather) instead of letting the eval step's P()
+                # in_specs re-gather them inside every compiled call.
+                from jax.sharding import NamedSharding, PartitionSpec
+
+                eval_params = jax.device_put(
+                    state.params, NamedSharding(mesh, PartitionSpec())
+                )
             evals = []
             for b in eval_loader:
                 m, cnt = (
-                    eval_step(state.params, state.model_state, b)
+                    eval_step(eval_params, state.model_state, b)
                     if has_ms and not cp
-                    else eval_step(state.params, b)
+                    else eval_step(eval_params, b)
                 )
                 evals.append((m, float(cnt)))
             if evals:
