@@ -160,6 +160,7 @@ def make_cp_eval_step(
     data_axis: str = "data",
     seq_axis: str = "seq",
     masked: bool = False,
+    param_specs=None,
 ):
     """Jit'd DP×CP eval: ``metric_fn(params, batch) -> dict`` per position,
     pmean'd over both axes.
@@ -202,7 +203,8 @@ def make_cp_eval_step(
     sharded = jax.shard_map(
         _eval,
         mesh=mesh,
-        in_specs=(P(), batch_specs),
+        in_specs=(param_specs if param_specs is not None else P(),
+                  batch_specs),
         out_specs=P(),
         check_vma=False,
     )

@@ -332,6 +332,7 @@ def make_eval_step(
     axis_name: str = "data",
     with_model_state: bool = False,
     masked: bool = False,
+    param_specs=None,
 ):
     """Jit'd eval step: per-replica metrics pmean'd across the data axis.
 
@@ -349,6 +350,11 @@ def make_eval_step(
     weighting each batch's means by its returned count reduces exactly to
     the mean over unique samples — no host-side knowledge of the sampler's
     pad geometry required.
+
+    ``param_specs``: per-leaf PartitionSpec tree for TP-sharded params
+    (``tp_param_specs``) — evaluation then runs on the sharded params
+    directly (metric_fn built on the TP model) instead of gathering a
+    replicated copy.  Default: params replicated.
     """
 
     def _replica_eval(params: Pytree, model_state: Pytree, batch: Pytree):
@@ -370,7 +376,8 @@ def make_eval_step(
     sharded = jax.shard_map(
         _replica_eval,
         mesh=mesh,
-        in_specs=(P(), P(), P(axis_name)),
+        in_specs=(param_specs if param_specs is not None else P(), P(),
+                  P(axis_name)),
         out_specs=P(),
         check_vma=False,
     )

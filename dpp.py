@@ -135,15 +135,19 @@ def setup(args):
         num_processes=args.num_processes,
         process_id=args.process_id,
     )
+    n = ddp.global_device_count()
+    if n % (args.cp * args.tp):
+        raise SystemExit(
+            f"--cp {args.cp} x --tp {args.tp} does not divide {n} devices"
+        )
+    if args.cp > 1 and args.tp > 1:
+        return ddp.make_mesh(
+            ("data", "seq", "model"),
+            shape=(n // (args.cp * args.tp), args.cp, args.tp),
+        )
     if args.cp > 1:
-        n = ddp.global_device_count()
-        if n % args.cp:
-            raise SystemExit(f"--cp {args.cp} does not divide {n} devices")
         return ddp.make_mesh(("data", "seq"), shape=(n // args.cp, args.cp))
     if args.tp > 1:
-        n = ddp.global_device_count()
-        if n % args.tp:
-            raise SystemExit(f"--tp {args.tp} does not divide {n} devices")
         return ddp.make_mesh(("data", "model"), shape=(n // args.tp, args.tp))
     return ddp.make_mesh(("data",))
 
@@ -171,8 +175,6 @@ def validate_args(args) -> None:
     if args.tp > 1:
         if not is_lm(args):
             raise SystemExit("--tp requires an LM model (--model gpt2|llama)")
-        if args.cp > 1:
-            raise SystemExit("--tp with --cp is not supported yet")
         if args.zero:
             raise SystemExit(
                 "--tp with --zero is not supported (ZeRO assumes "
@@ -403,6 +405,13 @@ def train(args) -> float:
     # Evaluation is exact over the padded tail: the loader emits a per-row
     # "valid" mask (0 on sampler-padded duplicate rows) and the masked eval
     # steps take per-row metrics, so padded rows contribute nothing.
+    # Under --tp, eval runs directly on the TP-sharded params (same model,
+    # same Megatron psums) — no gathered replica is ever materialized.
+    eval_param_specs = None
+    if args.tp > 1:
+        from distributeddataparallel_tpu.parallel import tp_param_specs
+
+        eval_param_specs = tp_param_specs(state.params)
     eval_step = None
     if args.eval and cp:
         from distributeddataparallel_tpu.data import shard_lm_batch
@@ -418,7 +427,10 @@ def train(args) -> float:
                 "loss": per_example_cross_entropy(logits, batch["targets"]),
                 "accuracy": per_example_accuracy(logits, batch["targets"]),
             }
-        eval_step = make_cp_eval_step(metric_fn, mesh=mesh, masked=True)
+        eval_step = make_cp_eval_step(
+            metric_fn, mesh=mesh, masked=True,
+            param_specs=eval_param_specs,
+        )
         eval_loader = DataLoader(
             build_dataset(args, train=False), per_replica_batch=args.batch_size,
             mesh=mesh, shuffle=False, seed=args.seed, drop_last=False,
@@ -434,22 +446,9 @@ def train(args) -> float:
         )
 
         if lm:
-            eval_model = model
-            if args.tp > 1:
-                # Eval runs data-parallel with replicated (full) params:
-                # use a non-TP twin so the module expects full shapes even
-                # though the mesh's 'model' axis is bound in the step.
-                import dataclasses
-
-                from distributeddataparallel_tpu.models import TransformerLM
-
-                eval_model = TransformerLM(
-                    dataclasses.replace(model.cfg, tp_axis=None)
-                )
-
             def metric_fn(params, batch):
                 toks = batch["tokens"]
-                logits = eval_model.apply({"params": params}, toks[:, :-1])
+                logits = model.apply({"params": params}, toks[:, :-1])
                 return {
                     "loss": per_example_cross_entropy(logits, toks[:, 1:]),
                     "accuracy": per_example_accuracy(logits, toks[:, 1:]),
@@ -471,7 +470,8 @@ def train(args) -> float:
                     "accuracy": per_example_accuracy(logits, batch["label"]),
                 }
         eval_step = make_eval_step(
-            metric_fn, mesh=mesh, with_model_state=has_ms, masked=True
+            metric_fn, mesh=mesh, with_model_state=has_ms, masked=True,
+            param_specs=eval_param_specs,
         )
         # drop_last=False: evaluation must cover the tail of the eval set
         # (sampler padding keeps per-replica counts equal, so the one
@@ -539,22 +539,12 @@ def train(args) -> float:
             # Masked eval: each step returns (masked means, valid-row
             # count); weighting means by counts is exactly the mean over
             # unique samples — sampler pad duplicates contribute nothing.
-            eval_params = state.params
-            if args.tp > 1:
-                # Replicate TP-sharded params ONCE per epoch (a single
-                # all-gather) instead of letting the eval step's P()
-                # in_specs re-gather them inside every compiled call.
-                from jax.sharding import NamedSharding, PartitionSpec
-
-                eval_params = jax.device_put(
-                    state.params, NamedSharding(mesh, PartitionSpec())
-                )
             evals = []
             for b in eval_loader:
                 m, cnt = (
-                    eval_step(eval_params, state.model_state, b)
+                    eval_step(state.params, state.model_state, b)
                     if has_ms and not cp
-                    else eval_step(eval_params, b)
+                    else eval_step(state.params, b)
                 )
                 evals.append((m, float(cnt)))
             if evals:
