@@ -78,6 +78,7 @@ class SyntheticClassification(ArrayDataset):
         num_classes: int = 10,
         seed: int = 0,
         proto_seed: int = 0,
+        keep_u8: bool = False,
     ):
         rng = np.random.default_rng(seed)
         labels = rng.integers(0, num_classes, size=(num_examples,), dtype=np.int32)
@@ -90,7 +91,21 @@ class SyntheticClassification(ArrayDataset):
         images = protos[labels] + 0.5 * rng.normal(size=(num_examples,) + shape).astype(
             np.float32
         )
-        super().__init__(images.astype(np.float32), labels)
+        if keep_u8:
+            # u8 storage mode (the CIFAR payload's layout): 4x less host
+            # RAM, and batch access runs the fused native gather+normalize
+            # kernel.  NOTE: the fixed ToTensor+Normalize decode maps the
+            # encoded values to 0.25 * x (the f32 data spans ~±4σ, far
+            # wider than the transform's [-1, 1] range) — a deliberately
+            # DIFFERENT but self-consistent dataset with the same labels
+            # and class structure, not a bit-identical twin of f32 mode.
+            u8 = np.clip((images * 0.125 + 0.5) * 255.0, 0.0, 255.0)
+            super().__init__(
+                np.ascontiguousarray(u8.astype(np.uint8)), labels,
+                normalize_u8=True,
+            )
+        else:
+            super().__init__(images.astype(np.float32), labels)
         self.num_classes = num_classes
 
 

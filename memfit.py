@@ -179,14 +179,40 @@ def analyze(seq_len: int, microbatches=(1, 2)) -> dict:
             return None
         return max(0, int((limit - fixed_bytes) // slope))
 
+    # TP-8: Megatron layout shards the layer params (q/k/v/o, MLP) over 8
+    # chips — exact byte fractions from the spec tree; embeddings/norms
+    # stay replicated.  Params AND grads shard; opt state mirrors params.
+    # The activation slope is kept unsharded (a conservative upper bound:
+    # TP also divides attention/MLP activations, which we cannot measure
+    # on one chip).
+    from distributeddataparallel_tpu.parallel.tensor_parallel import (
+        tp_param_specs,
+    )
+
+    def sharded_bytes(tree) -> int:
+        specs = tp_param_specs(tree)
+        return sum(
+            l.size * l.dtype.itemsize
+            for l, s in zip(jax.tree.leaves(tree), jax.tree.leaves(specs))
+            if any(s)
+        )
+
+    TPN = 8
     rows = []
     for name, tx in (
         ("sgd", sgd),
         ("sgd_momentum", optax.sgd(1e-3, momentum=0.9)),
         ("adamw", optax.adamw(3e-4)),
     ):
-        opt_bytes = _tree_bytes(_abstract_state(full_model, tx).opt_state)
+        ast = _abstract_state(full_model, tx)
+        opt_bytes = _tree_bytes(ast.opt_state)
         fixed = model_fixed + opt_bytes
+        # params + grads each drop their sharded fraction (N-1)/N; opt
+        # state drops its own sharded fraction.
+        tp_saving = (
+            2 * sharded_bytes(ast.params) + sharded_bytes(ast.opt_state)
+        ) * (TPN - 1) / TPN
+        tp_fixed = fixed - tp_saving
         rows.append({
             "optimizer": name,
             "opt_state_gb": gb(opt_bytes),
@@ -200,6 +226,9 @@ def analyze(seq_len: int, microbatches=(1, 2)) -> dict:
             "zero1x8_max_mb_v5p": max_mb(
                 V5P_HBM_BYTES, model_fixed + opt_bytes / 8
             ),
+            "tp8_fixed_gb": gb(tp_fixed),
+            "tp8_max_mb_v5p": max_mb(V5P_HBM_BYTES, tp_fixed),
+            "tp8_max_mb_v5e": max_mb(hbm, tp_fixed),
         })
 
     return {
@@ -215,6 +244,17 @@ def analyze(seq_len: int, microbatches=(1, 2)) -> dict:
 
 
 def main() -> None:
+    import os
+
+    import jax
+
+    # Persistent compile cache: reruns reuse the measured grid's binaries.
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"),
+    )
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
     p = argparse.ArgumentParser()
     p.add_argument("--seq-len", type=int, default=4096)
     args = p.parse_args()
@@ -240,15 +280,16 @@ def main() -> None:
     print()
     print("| optimizer | opt state | 8B peak @mb=1 | 8B peak @mb=2 | "
           "max mb (v5e 16G) | max mb (v5p 95G) | ZeRO-1x8 fixed | "
-          "ZeRO-1x8 max mb (v5p) |")
-    print("|---|---|---|---|---|---|---|---|")
+          "ZeRO-1x8 max mb (v5p) | TP-8 fixed | TP-8 max mb (v5p) |")
+    print("|---|---|---|---|---|---|---|---|---|---|")
     for row in r["optimizers"]:
         mbs = sorted(row["peak8b_gb"])
         print(
             f"| {row['optimizer']} | {row['opt_state_gb']} GB "
             f"| {row['peak8b_gb'][mbs[0]]} GB | {row['peak8b_gb'][mbs[1]]} GB "
             f"| {row['max_mb_v5e']} | {row['max_mb_v5p']} "
-            f"| {row['zero1x8_fixed_gb']} GB | {row['zero1x8_max_mb_v5p']} |"
+            f"| {row['zero1x8_fixed_gb']} GB | {row['zero1x8_max_mb_v5p']} "
+            f"| {row['tp8_fixed_gb']} GB | {row['tp8_max_mb_v5p']} |"
         )
     import json
     print("\n```json")
