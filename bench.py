@@ -144,7 +144,7 @@ def bench_resnet50() -> dict:
         )
         rows = 0
         t0 = time.perf_counter()
-        for epoch in range(4):
+        for epoch in range(2):
             loader.set_epoch(epoch)
             for b in loader:
                 rows += b["image"].shape[0]
@@ -302,7 +302,7 @@ def bench_overlap() -> dict:
 
     mesh, loss_fn, state, batch = _gpt2_setup("auto", tx=optax.sgd(0.01))
     return overlap_probe(
-        loss_fn, state, batch, jax.random.PRNGKey(1), mesh=mesh, iters=6
+        loss_fn, state, batch, jax.random.PRNGKey(1), mesh=mesh, iters=4
     )
 
 

@@ -222,15 +222,18 @@ class Attention(nn.Module):
             )
             q = apply_rope(q, cos, sin, positions=positions)
             k = apply_rope(k, cos, sin, positions=positions)
-        k = repeat_kv(k, Hl // Hkvl)
-        v = repeat_kv(v, Hl // Hkvl)
         if cfg.cp_axis is not None:
             from distributeddataparallel_tpu.parallel.context_parallel import (
                 ring_attention,
             )
 
+            # Ring attention contracts q and kv headwise: expand GQA here.
+            k = repeat_kv(k, Hl // Hkvl)
+            v = repeat_kv(v, Hl // Hkvl)
             out = ring_attention(q, k, v, axis_name=cfg.cp_axis, causal=True)
         else:
+            # GQA kv stays at its own head count: the flash kernel indexes
+            # the shared head natively; the XLA path expands internally.
             out = attention(q, k, v, causal=True, impl=cfg.attn_impl)
         return _RowParallelOut(
             features=cfg.d_model,

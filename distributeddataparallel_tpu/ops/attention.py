@@ -186,6 +186,11 @@ def attention(
     XLA instead of aborting the jit (they are not catchable around the
     traced call itself).  'pallas' forces the kernel and lets failures
     propagate.
+
+    GQA: k/v may carry fewer heads than q (H % Hkv == 0).  The flash
+    kernel consumes them natively (the shared kv head is indexed per
+    query-head group — the repeated tensor never materializes); the XLA
+    path expands via ``repeat_kv`` here.
     """
     if impl not in ("auto", "xla", "pallas"):
         raise ValueError(f"unknown attention impl {impl!r}")
@@ -200,4 +205,12 @@ def attention(
                 f"pallas flash attention unsupported for shapes "
                 f"q={q.shape} k={k.shape} on {jax.default_backend()}"
             )
+    if k.shape[2] != q.shape[2]:
+        H, Hkv = q.shape[2], k.shape[2]
+        if H % Hkv:
+            raise ValueError(
+                f"num_heads {H} not a multiple of kv heads {Hkv}"
+            )
+        k = repeat_kv(k, H // Hkv)
+        v = repeat_kv(v, H // Hkv)
     return dot_product_attention(q, k, v, causal=causal)

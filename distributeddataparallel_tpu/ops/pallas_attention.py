@@ -44,13 +44,19 @@ def _pick_block(s: int, preferred: tuple[int, ...] = (512, 256, 128)) -> int | N
 
 
 def supported(q, k, v) -> bool:
-    """True when the flash kernel can run natively on this backend/shapes."""
+    """True when the flash kernel can run natively on this backend/shapes.
+
+    GQA is native: k/v may carry fewer heads than q (H % Hkv == 0) — the
+    kernels index the shared kv head per query-head group through the
+    BlockSpec maps, so the repeated kv tensor never materializes.
+    """
     if jax.default_backend() != "tpu":
         return False
     B, Sq, H, D = q.shape
     Skv = k.shape[1]
-    if k.shape[2] != H or v.shape != k.shape:
-        return False  # GQA callers must repeat_kv first
+    Hkv = k.shape[2]
+    if H % Hkv or v.shape != k.shape:
+        return False
     return (
         _pick_block(Sq) is not None
         and _pick_block(Skv) is not None
@@ -140,9 +146,20 @@ def _flash_kernel(
         lse_ref[0] = jnp.broadcast_to(lse[None, :], lse_ref.shape[1:])
 
 
+def _gqa_kv_row(b, *, H: int, Hkv: int):
+    """Flat kv row for flat q row ``b``: query head h of batch n reads kv
+    head h // (H // Hkv) — the GQA group mapping, done in the BlockSpec
+    index map so the repeated kv never materializes."""
+    group = H // Hkv
+    return (b // H) * Hkv + (b % H) // group
+
+
 def _flash_fwd_impl(q, k, v, *, causal: bool, interpret: bool):
     B, Sq, H, D = q.shape
     Skv = k.shape[1]
+    Hkv = k.shape[2]
+    if H % Hkv:
+        raise ValueError(f"num_heads {H} not a multiple of kv heads {Hkv}")
     block_q = _pick_block(Sq)
     block_k = _pick_block(Skv)
     if block_q is None or block_k is None:
@@ -155,10 +172,12 @@ def _flash_fwd_impl(q, k, v, *, causal: bool, interpret: bool):
         )
     scale = 1.0 / (D ** 0.5)
 
-    # (B, S, H, D) -> (B*H, S, D): one grid row per (batch, head).
+    # (B, S, H, D) -> (B*H, S, D): one grid row per (batch, head); kv
+    # stays at its own (smaller) head count.
     qf = q.transpose(0, 2, 1, 3).reshape(B * H, Sq, D)
-    kf = k.transpose(0, 2, 1, 3).reshape(B * H, Skv, D)
-    vf = v.transpose(0, 2, 1, 3).reshape(B * H, Skv, D)
+    kf = k.transpose(0, 2, 1, 3).reshape(B * Hkv, Skv, D)
+    vf = v.transpose(0, 2, 1, 3).reshape(B * Hkv, Skv, D)
+    kv_row = functools.partial(_gqa_kv_row, H=H, Hkv=Hkv)
 
     grid = (B * H, Sq // block_q, Skv // block_k)
     kernel = functools.partial(
@@ -173,8 +192,8 @@ def _flash_fwd_impl(q, k, v, *, causal: bool, interpret: bool):
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (kv_row(b), j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (kv_row(b), j, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
@@ -277,13 +296,19 @@ def _bwd_dkv_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,  # inputs
     dk_ref, dv_ref,                                   # (1, BK, D) each
     dk_acc, dv_acc,                                   # VMEM (BK, D) f32
-    *, causal: bool, block_q: int, block_k: int, scale: float, q_offset: int,
+    *, causal: bool, block_q: int, block_k: int, scale: float,
+    q_offset: int, group: int,
 ):
-    j = pl.program_id(1)  # kv block (outer)
-    i = pl.program_id(2)  # q block (inner: dk/dv accumulate over it)
-    ni = pl.num_programs(2)
+    """Grid (B*Hkv, kv blocks, q blocks * group): the inner index walks
+    every (q block, group-member q head) pair feeding this KV HEAD's
+    block, so GQA's shared kv gradients accumulate in one scratch pass —
+    no repeated-kv tensor, no cross-iteration output hazard."""
+    j = pl.program_id(1)   # kv block (outer)
+    t = pl.program_id(2)   # inner: q block index * group + group member
+    nt = pl.num_programs(2)
+    i = t // group         # q block (the causal predicate needs it)
 
-    @pl.when(i == 0)
+    @pl.when(t == 0)
     def _init():
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
@@ -308,7 +333,7 @@ def _bwd_dkv_kernel(
             preferred_element_type=jnp.float32,
         )
 
-    @pl.when(i == ni - 1)
+    @pl.when(t == nt - 1)
     def _finish():
         dk_ref[0] = (dk_acc[:] * scale).astype(dk_ref.dtype)
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
@@ -326,17 +351,21 @@ def _bwd(causal, interpret, res, do):
     q, k, v, out, lse = res
     B, Sq, H, D = q.shape
     Skv = k.shape[1]
+    Hkv = k.shape[2]
+    group = H // Hkv
     block_q = _pick_block(Sq)
     block_k = _pick_block(Skv)
     scale = 1.0 / (D ** 0.5)
     q_offset = Skv - Sq
 
-    # (B, S, H, D) -> (B*H, S, D) flat layout, matching the forward.
+    # (B, S, H, D) -> (B*H, S, D) flat layout, matching the forward; kv
+    # stays at its own head count (GQA shares it across the group).
     qf = q.transpose(0, 2, 1, 3).reshape(B * H, Sq, D)
-    kf = k.transpose(0, 2, 1, 3).reshape(B * H, Skv, D)
-    vf = v.transpose(0, 2, 1, 3).reshape(B * H, Skv, D)
+    kf = k.transpose(0, 2, 1, 3).reshape(B * Hkv, Skv, D)
+    vf = v.transpose(0, 2, 1, 3).reshape(B * Hkv, Skv, D)
     dof = do.transpose(0, 2, 1, 3).reshape(B * H, Sq, D)
     outf = out.transpose(0, 2, 1, 3).reshape(B * H, Sq, D)
+    kv_row = functools.partial(_gqa_kv_row, H=H, Hkv=Hkv)
 
     delta = jnp.sum(
         dof.astype(jnp.float32) * outf.astype(jnp.float32), axis=-1
@@ -351,8 +380,8 @@ def _bwd(causal, interpret, res, do):
 
     row_specs = [
         pl.BlockSpec((1, block_q, D), lambda b, x, y: (b, x, 0)),   # q
-        pl.BlockSpec((1, block_k, D), lambda b, x, y: (b, y, 0)),   # k
-        pl.BlockSpec((1, block_k, D), lambda b, x, y: (b, y, 0)),   # v
+        pl.BlockSpec((1, block_k, D), lambda b, x, y: (kv_row(b), y, 0)),  # k
+        pl.BlockSpec((1, block_k, D), lambda b, x, y: (kv_row(b), y, 0)),  # v
         pl.BlockSpec((1, block_q, D), lambda b, x, y: (b, x, 0)),   # do
         pl.BlockSpec((1, 8, block_q), lambda b, x, y: (b, 0, x)),   # lse
         pl.BlockSpec((1, 8, block_q), lambda b, x, y: (b, 0, x)),   # delta
@@ -372,27 +401,34 @@ def _bwd(causal, interpret, res, do):
         interpret=interpret,
     )(qf, kf, vf, dof, lse8, delta8)
 
-    # dkv grid transposes the block loops: (b, kv block, q block).  The
-    # same index maps apply with x=q-block and y=kv-block swapped.
+    # dkv grid: one row per KV head; the inner index t walks every
+    # (q block, group member) pair so the group's q heads accumulate into
+    # the shared kv gradient consecutively (no output-revisit hazard).
+    def q_row(b, t):
+        return (b // Hkv) * H + (b % Hkv) * group + t % group
+
+    def q_blk(t):
+        return t // group
+
     kv_specs = [
-        pl.BlockSpec((1, block_q, D), lambda b, y, x: (b, x, 0)),   # q
-        pl.BlockSpec((1, block_k, D), lambda b, y, x: (b, y, 0)),   # k
-        pl.BlockSpec((1, block_k, D), lambda b, y, x: (b, y, 0)),   # v
-        pl.BlockSpec((1, block_q, D), lambda b, y, x: (b, x, 0)),   # do
-        pl.BlockSpec((1, 8, block_q), lambda b, y, x: (b, 0, x)),   # lse
-        pl.BlockSpec((1, 8, block_q), lambda b, y, x: (b, 0, x)),   # delta
+        pl.BlockSpec((1, block_q, D), lambda b, y, t: (q_row(b, t), q_blk(t), 0)),  # q
+        pl.BlockSpec((1, block_k, D), lambda b, y, t: (b, y, 0)),   # k
+        pl.BlockSpec((1, block_k, D), lambda b, y, t: (b, y, 0)),   # v
+        pl.BlockSpec((1, block_q, D), lambda b, y, t: (q_row(b, t), q_blk(t), 0)),  # do
+        pl.BlockSpec((1, 8, block_q), lambda b, y, t: (q_row(b, t), 0, q_blk(t))),  # lse
+        pl.BlockSpec((1, 8, block_q), lambda b, y, t: (q_row(b, t), 0, q_blk(t))),  # delta
     ]
     dk, dv = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, **kw),
-        grid=(B * H, Skv // block_k, Sq // block_q),
+        functools.partial(_bwd_dkv_kernel, group=group, **kw),
+        grid=(B * Hkv, Skv // block_k, (Sq // block_q) * group),
         in_specs=kv_specs,
         out_specs=[
-            pl.BlockSpec((1, block_k, D), lambda b, y, x: (b, y, 0)),
-            pl.BlockSpec((1, block_k, D), lambda b, y, x: (b, y, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, y, t: (b, y, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, y, t: (b, y, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((B * H, Skv, D), k.dtype),
-            jax.ShapeDtypeStruct((B * H, Skv, D), v.dtype),
+            jax.ShapeDtypeStruct((B * Hkv, Skv, D), k.dtype),
+            jax.ShapeDtypeStruct((B * Hkv, Skv, D), v.dtype),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, D), jnp.float32),
@@ -402,8 +438,8 @@ def _bwd(causal, interpret, res, do):
     )(qf, kf, vf, dof, lse8, delta8)
 
     dq = dq.reshape(B, H, Sq, D).transpose(0, 2, 1, 3)
-    dk = dk.reshape(B, H, Skv, D).transpose(0, 2, 1, 3)
-    dv = dv.reshape(B, H, Skv, D).transpose(0, 2, 1, 3)
+    dk = dk.reshape(B, Hkv, Skv, D).transpose(0, 2, 1, 3)
+    dv = dv.reshape(B, Hkv, Skv, D).transpose(0, 2, 1, 3)
     return dq, dk, dv
 
 

@@ -207,3 +207,38 @@ def test_flash_rejects_causal_sq_gt_skv():
     assert not pallas_attention.supported(q, kv, kv)
     with pytest.raises(ValueError, match="Sq <= Skv"):
         pallas_attention.flash_attention(q, kv, kv, True, True)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_gqa_matches_repeated_reference(causal):
+    """GQA-native flash (kv at Hkv < H, indexed per group in the kernel)
+    must equal the reference on repeat_kv-expanded kv."""
+    q, k, v = _qkv(jax.random.PRNGKey(12), B=2, S=256, H=4, D=16, Hkv=2)
+    ref = dot_product_attention(
+        q, repeat_kv(k, 2), repeat_kv(v, 2), causal=causal
+    )
+    out = pallas_attention.flash_attention(q, k, v, causal, True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_flash_gqa_grads_match_repeated_reference():
+    """GQA backward: dk/dv accumulate over the whole query-head group
+    (the dkv grid walks every (q block, group member) pair per kv head)."""
+    q, k, v = _qkv(jax.random.PRNGKey(13), B=1, S=128, H=4, D=16, Hkv=2)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(pallas_attention.flash_attention(q, k, v, True, True) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(
+            dot_product_attention(
+                q, repeat_kv(k, 2), repeat_kv(v, 2), causal=True
+            ) ** 2
+        )
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for name, a, b in zip("qkv", gf, gr):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-4, err_msg=f"d{name}"
+        )
