@@ -288,6 +288,70 @@ def bench_gpt2() -> dict:
     }
 
 
+def bench_llama() -> dict:
+    """Llama-family DP step (BASELINE config 5's model class, scaled to
+    one chip): GQA 16q/4kv, RoPE, SwiGLU, remat + scanned layers, bf16 —
+    the flash kernel consumes the grouped kv natively.  ~0.6B params;
+    the full 8B memory story lives in MEMFIT.md."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    import distributeddataparallel_tpu as ddp
+    from distributeddataparallel_tpu.data.loader import shard_batch
+    from distributeddataparallel_tpu.models import TransformerLM, llama3_8b
+    from distributeddataparallel_tpu.ops import lm_cross_entropy
+
+    mesh = ddp.make_mesh(("data",))
+    n_dev = len(jax.devices())
+    per_chip_batch, seq_len = 4, 2048
+
+    cfg = llama3_8b(
+        num_layers=8, d_model=2048, d_ff=7168, num_heads=16, num_kv_heads=4,
+        vocab_size=32000, max_seq_len=seq_len,
+    )
+    model = TransformerLM(cfg)
+    params = jax.jit(model.init)(
+        jax.random.PRNGKey(0), jnp.zeros((1, seq_len), jnp.int32)
+    )["params"]
+    n_params = sum(l.size for l in jax.tree.leaves(params))
+
+    def loss_fn(params, batch, rng):
+        toks = batch["tokens"]
+        logits = model.apply({"params": params}, toks[:, :-1])
+        return lm_cross_entropy(logits, toks[:, 1:]), {}
+
+    state = ddp.TrainState.create(
+        apply_fn=model.apply, params=params,
+        tx=optax.sgd(1e-3, momentum=0.9),
+    )
+    state = ddp.broadcast_params(state, mesh)
+    step = ddp.make_train_step(loss_fn, mesh=mesh)
+    npr = np.random.default_rng(0)
+    batch = shard_batch(
+        {"tokens": npr.integers(
+            0, 32000, size=(per_chip_batch * n_dev, seq_len + 1)
+        ).astype(np.int32)},
+        mesh,
+    )
+    state, mean_s, dist = _time_steps(
+        step, state, batch, jax.random.PRNGKey(1), warmup=3, iters=8
+    )
+    toks_per_s = per_chip_batch * seq_len / mean_s
+    return {
+        "tokens_s_chip": round(toks_per_s, 1),
+        "params_m": round(n_params / 1e6, 1),
+        # Model FLOPs utilization from the 6*N*T estimate against v5e's
+        # 197 bf16 TFLOPS (attention flops excluded -> conservative).
+        "mfu_est": round(6 * n_params * toks_per_s / 197e12, 4),
+        "per_chip_batch": per_chip_batch,
+        "seq_len": seq_len,
+        "step_ms_mean": round(mean_s * 1e3, 3),
+        "step_ms_fenced_chunks": [round(t, 3) for t in dist],
+    }
+
+
 def bench_overlap() -> dict:
     """Comm/compute overlap on the GPT-2 124M DP step (BASELINE config 5's
     "overlap demonstrated"): full step vs compute-only (grad_sync=False,
@@ -343,6 +407,7 @@ def main() -> None:
     dev = jax.devices()[0]
     resnet = _run(bench_resnet50, "resnet50")
     gpt2 = _run(bench_gpt2, "gpt2")
+    llama = _run(bench_llama, "llama")
     overlap = _run(bench_overlap, "overlap")
 
     img_s_chip = resnet.get("img_s_chip", 0.0)
@@ -360,6 +425,7 @@ def main() -> None:
                     "n_devices": len(jax.devices()),
                     "resnet50": resnet,
                     "gpt2_124m": gpt2,
+                    "llama_0p6b": llama,
                     "overlap_gpt2_dp": overlap,
                 },
             }

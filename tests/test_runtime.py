@@ -171,3 +171,99 @@ def test_spawn_two_process_dp_step(tmp_path, devices):
     )
     assert results[0]["loss"] == pytest.approx(float(loss), abs=1e-5)
     assert results[0]["checksum"] == pytest.approx(checksum, rel=1e-5)
+
+
+def _mp_tp_worker(process_id, tmpdir):
+    """Child of test_spawn_two_process_dp_tp_step: DP(2) x TP(2) in the
+    standard multi-host topology — the TP axis pairs each process's own
+    devices (fastest interconnect) while the DP gradient sync crosses
+    the process boundary over the collective backend."""
+    import json
+    import os
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 2)
+
+    import dataclasses
+
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    import distributeddataparallel_tpu as ddp
+    from distributeddataparallel_tpu.data.loader import shard_batch
+    from distributeddataparallel_tpu.models import TransformerLM, tiny_lm
+    from distributeddataparallel_tpu.ops import lm_cross_entropy
+
+    ddp.init_process_group("cpu")
+    assert jax.process_count() == 2
+
+    # 4 global devices as (data=2, model=2), row-major over
+    # [p0d0, p0d1, p1d0, p1d1]: each process is one data row and its two
+    # local devices form the model (TP) pair — TP stays intra-process,
+    # DP crosses processes (the standard deployment layout).
+    mesh = ddp.make_mesh(("data", "model"), shape=(2, 2))
+    cfg = tiny_lm(num_heads=4, num_kv_heads=2, d_model=32, d_ff=64)
+    model_tp = TransformerLM(dataclasses.replace(cfg, tp_axis="model"))
+    params = TransformerLM(cfg).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 16), jnp.int32)
+    )["params"]
+
+    def loss_fn(p, batch, rng):
+        toks = batch["tokens"]
+        logits = model_tp.apply({"params": p}, toks[:, :-1])
+        return lm_cross_entropy(logits, toks[:, 1:]), {}
+
+    state = ddp.TrainState.create(
+        apply_fn=model_tp.apply, params=params, tx=optax.sgd(0.1)
+    )
+    state = ddp.shard_state_tp(state, mesh)
+    step = ddp.make_train_step(loss_fn, mesh=mesh, tp_axis="model")
+    rng = np.random.default_rng(11)
+    tokens = rng.integers(0, 256, size=(4, 17)).astype(np.int32)
+    batch = shard_batch({"tokens": tokens}, mesh)
+    state, metrics = step(state, batch, jax.random.PRNGKey(0))
+
+    with open(os.path.join(tmpdir, f"tp_rank{process_id}.json"), "w") as f:
+        json.dump({"loss": float(metrics["loss"])}, f)
+    ddp.destroy_process_group()
+
+
+def test_spawn_two_process_dp_tp_step(tmp_path, devices):
+    """Multi-process Megatron: two OS processes hold a (data=2, model=2)
+    mesh (TP intra-process, DP across processes); the step's loss must
+    match the single-process single-device computation."""
+    import json
+
+    import jax.numpy as jnp
+
+    from distributeddataparallel_tpu.models import TransformerLM, tiny_lm
+    from distributeddataparallel_tpu.ops import lm_cross_entropy
+
+    procs = spawn(_mp_tp_worker, args=(str(tmp_path),), nprocs=2, join=False)
+    for p in procs:
+        p.join(timeout=240)
+    codes = [p.exitcode for p in procs]
+    for p in procs:
+        if p.is_alive():
+            p.terminate()
+    assert codes == [0, 0], f"child exit codes {codes}"
+
+    results = [
+        json.load(open(tmp_path / f"tp_rank{i}.json")) for i in range(2)
+    ]
+    assert results[0]["loss"] == pytest.approx(results[1]["loss"], abs=1e-6)
+
+    # Single-device reference on the same global batch.
+    cfg = tiny_lm(num_heads=4, num_kv_heads=2, d_model=32, d_ff=64)
+    model = TransformerLM(cfg)
+    rng = np.random.default_rng(11)
+    tokens = rng.integers(0, 256, size=(4, 17)).astype(np.int32)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 16), jnp.int32)
+    )["params"]
+    logits = model.apply({"params": params}, jnp.asarray(tokens[:, :-1]))
+    ref = float(lm_cross_entropy(logits, jnp.asarray(tokens[:, 1:])))
+    assert results[0]["loss"] == pytest.approx(ref, rel=1e-5)
