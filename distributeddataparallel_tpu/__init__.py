@@ -45,5 +45,9 @@ from distributeddataparallel_tpu.parallel.data_parallel import (  # noqa: F401
 )
 from distributeddataparallel_tpu.parallel.zero import zero_state  # noqa: F401
 from distributeddataparallel_tpu.parallel.tensor_parallel import shard_state_tp  # noqa: F401
+from distributeddataparallel_tpu.parallel.pipeline_parallel import (  # noqa: F401
+    make_pp_train_step,
+    shard_state_pp,
+)
 from distributeddataparallel_tpu.training.state import TrainState  # noqa: F401
 from distributeddataparallel_tpu.training.train_step import make_train_step  # noqa: F401

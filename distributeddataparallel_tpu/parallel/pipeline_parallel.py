@@ -1,0 +1,270 @@
+"""Pipeline parallelism: GPipe-style stage sharding over a ``pipe`` mesh
+axis (beyond-reference scope, completing the DP/TP/CP/PP axis set).
+
+The TPU-native shape of PP exploits a property this framework already
+has: with ``scan_layers=True`` the decoder stack's parameters are
+STACKED arrays with a leading layer dimension, so "split the model into
+stages" is literally "shard that leading dim over the pipe axis" — each
+mesh position holds ``L / n_stages`` layers and runs the same scanned
+block code on its slice.
+
+The schedule is plain GPipe inside ``shard_map``:
+
+- The per-position batch splits into M microbatches.  Each tick, stage 0
+  injects the next microbatch's embeddings, every stage applies its
+  layer slice, and activations rotate one hop with ``lax.ppermute``
+  (XLA overlaps the transfer with the next tick's compute).
+- After ``n_stages - 1`` warm-up ticks the pipe is full; the last stage
+  computes logits + loss for one microbatch per tick.  Bubble ticks
+  process don't-care buffers whose results never reach the loss, so AD
+  gives them zero cotangents — and the BACKWARD pipeline (reverse
+  schedule, reverse ppermute) emerges entirely from differentiating the
+  forward loop; no hand-written reverse schedule exists anywhere.
+- Replicated parameters (embeddings, final norm, lm head) get gradient
+  contributions only on the stages that use them (0 and n-1); a psum
+  over the pipe axis completes them.  Layer-slice gradients are local by
+  construction.  The data axis then applies the ordinary DDP mean.
+
+Restrictions (v1): ``scan_layers=True`` configs without dropout; the
+sequence axis is not also sharded (no PP x CP).  DP x PP composes; the
+microbatch loop is itself the gradient-accumulation analog.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+Pytree = Any
+
+
+def pp_param_specs(tree: Pytree, axis_name: str = "pipe") -> Pytree:
+    """Spec tree: any leaf under a ``layers`` path component shards its
+    LEADING (stacked-layer) dim over the pipe axis; everything else is
+    replicated.  Works for optimizer state too (optax trees embed the
+    param paths)."""
+    flat = jax.tree_util.tree_flatten_with_path(tree)[0]
+    treedef = jax.tree.structure(tree)
+    specs = []
+    for path, leaf in flat:
+        names = tuple(
+            str(getattr(k, "key", getattr(k, "name", k))) for k in path
+        )
+        if "layers" in names and getattr(leaf, "ndim", 0) >= 1:
+            specs.append(P(*((axis_name,) + (None,) * (leaf.ndim - 1))))
+        else:
+            specs.append(P())
+    return jax.tree.unflatten(treedef, specs)
+
+
+def pp_state_specs(state, axis_name: str = "pipe") -> Pytree:
+    """Spec tree for a whole TrainState under PP (single source for both
+    placement and the step's shard_map in_specs)."""
+    return state.replace(
+        step=P(),
+        params=pp_param_specs(state.params, axis_name),
+        opt_state=pp_param_specs(state.opt_state, axis_name),
+        model_state=jax.tree.map(lambda _: P(), state.model_state),
+    )
+
+
+def shard_state_pp(state, mesh: Mesh, axis_name: str = "pipe"):
+    """Place a full TrainState with the stacked layer dim sharded over the
+    pipe axis (the PP analog of ``broadcast_params``)."""
+    n = mesh.shape[axis_name]
+    for path, leaf in jax.tree_util.tree_flatten_with_path(state.params)[0]:
+        names = tuple(str(getattr(k, "key", k)) for k in path)
+        if "layers" in names and leaf.shape[0] % n:
+            raise ValueError(
+                f"pipeline: stacked layer dim {leaf.shape[0]} of param "
+                f"{'/'.join(names)} is not divisible by {n} stages"
+            )
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        state,
+        pp_state_specs(state, axis_name),
+    )
+
+
+def _stage_stack(cfg, n_stages: int):
+    """The scanned block module for ONE stage's layer slice — identical
+    structure to TransformerLM's named-"layers" scan, so a slice of the
+    full model's stacked params applies directly."""
+    from distributeddataparallel_tpu.models.transformer import _ScanBlock
+
+    if cfg.num_layers % n_stages:
+        raise ValueError(
+            f"pipeline: num_layers {cfg.num_layers} not divisible by "
+            f"{n_stages} stages"
+        )
+    scan_block = (
+        nn.remat(_ScanBlock, prevent_cse=False, static_argnums=(4,))
+        if cfg.remat
+        else _ScanBlock
+    )
+    return nn.scan(
+        scan_block,
+        variable_axes={"params": 0},
+        split_rngs={"params": True, "dropout": True},
+        in_axes=(nn.broadcast, nn.broadcast, nn.broadcast),
+        length=cfg.num_layers // n_stages,
+        metadata_params={nn.PARTITION_NAME: "layers"},
+    )(cfg)
+
+
+def _embed(cfg, params, tokens):
+    """Token (+ learned positional) embedding from raw params — mirrors
+    TransformerLM's input block (models/transformer.py) without dropout."""
+    emb = params["token_embed"]["embedding"]  # (V, d) f32
+    x = emb[tokens].astype(cfg.dtype)
+    if cfg.positional == "learned":
+        S = tokens.shape[1]
+        x = x + params["pos_embed"][:S].astype(cfg.dtype)
+    return x
+
+
+def _head(cfg, params, x):
+    """Final norm + logits from raw params — mirrors TransformerLM's
+    output block (f32 logits, cfg.dtype matmul operands)."""
+    from distributeddataparallel_tpu.models.transformer import RMSNorm
+
+    if cfg.norm == "rmsnorm":
+        x = RMSNorm().apply({"params": params["final_norm"]}, x)
+    else:
+        x = nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32).apply(
+            {"params": params["final_norm"]}, x
+        )
+    if cfg.tie_embeddings:
+        w = params["token_embed"]["embedding"].astype(cfg.dtype)  # (V, d)
+        return jax.lax.dot_general(
+            x.astype(cfg.dtype), w, (((x.ndim - 1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+    w = params["lm_head"]["kernel"].astype(cfg.dtype)  # (d, V)
+    return jax.lax.dot_general(
+        x.astype(cfg.dtype), w, (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+
+def make_pp_train_step(
+    cfg,
+    *,
+    mesh: Mesh,
+    microbatches: int,
+    data_axis: str = "data",
+    pp_axis: str = "pipe",
+    donate: bool = True,
+    grad_sync: bool = True,
+):
+    """Compiled DP x PP train step for a scanned TransformerLM config.
+
+    ``step(state, batch, rng) -> (state, metrics)`` with
+    ``batch = {"tokens": (B, S+1) int32}`` sharded over ``data_axis``
+    (replicated over the pipe axis); the per-position rows must divide
+    ``microbatches``.  State comes from ``shard_state_pp``.
+    """
+    from distributeddataparallel_tpu.models.transformer import (
+        rope_frequencies,
+    )
+    from distributeddataparallel_tpu.ops.losses import lm_cross_entropy
+    from distributeddataparallel_tpu.parallel.data_parallel import (
+        all_reduce_gradients,
+    )
+
+    if not cfg.scan_layers:
+        raise ValueError("pipeline parallelism requires scan_layers=True")
+    if cfg.dropout_rate:
+        raise ValueError("pipeline v1 does not support dropout")
+    n_stages = mesh.shape[pp_axis]
+    M = microbatches
+    stack = _stage_stack(cfg, n_stages)
+
+    def pp_loss(params, tokens):
+        s = lax.axis_index(pp_axis)
+        n = n_stages
+        mb_rows = tokens.shape[0] // M
+        mbs = tokens.reshape(M, mb_rows, tokens.shape[1])
+        S = tokens.shape[1] - 1
+        rope = (
+            rope_frequencies(
+                cfg.dims_per_head, cfg.max_seq_len, theta=cfg.rope_theta
+            )
+            if cfg.positional == "rope"
+            else None
+        )
+        layer_shard = params["layers"]
+
+        def run_stage(x):
+            y, _ = stack.apply({"params": layer_shard}, x, None, rope, True)
+            return y
+
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        buf = jnp.zeros((mb_rows, S, cfg.d_model), cfg.dtype)
+        acc = jnp.zeros((), jnp.float32)
+        # Static GPipe schedule: M + n - 1 ticks.  Every stage computes
+        # every tick (SPMD); bubble results are masked out of the loss,
+        # so their gradients vanish and AD reconstructs the reverse
+        # pipeline schedule on its own.
+        for t in range(M + n - 1):
+            x0 = _embed(cfg, params, mbs[min(t, M - 1)][:, :-1])
+            x = jnp.where(s == 0, x0, buf)
+            y = run_stage(x)
+            buf = lax.ppermute(y, pp_axis, perm)
+            out_idx = t - (n - 1)
+            if out_idx < 0:
+                continue  # pipe still filling: no stage has output yet
+            logits = _head(cfg, params, y)
+            tgt = mbs[out_idx][:, 1:]
+            mb_loss = lm_cross_entropy(logits, tgt)
+            acc = acc + jnp.where(s == n - 1, mb_loss, 0.0)
+        # Only the last stage accumulated; the psum replicates the total.
+        # MUST be the custom-vjp reduce (psum fwd, identity bwd): under
+        # check_vma=False, lax.psum's transpose psums the replicated
+        # cotangent again, scaling every gradient by n_stages.
+        from distributeddataparallel_tpu.parallel.tensor_parallel import (
+            reduce_from_tp,
+        )
+
+        return reduce_from_tp(acc, pp_axis) / M
+
+    def _step(state, batch, rng):
+        loss, grads = jax.value_and_grad(pp_loss)(
+            state.params, batch["tokens"]
+        )
+        # Complete replicated-param grads over the pipe (only the stages
+        # that use them contributed); layer-slice grads stay local.
+        gspecs = pp_param_specs(grads, pp_axis)
+        grads = jax.tree.map(
+            lambda g, sp: g if any(sp) else lax.psum(g, pp_axis),
+            grads,
+            gspecs,
+        )
+        if grad_sync:
+            grads = all_reduce_gradients(grads, data_axis, op="mean")
+        new_state = state.apply_gradients(grads)
+        return new_state, {"loss": lax.pmean(loss, data_axis)}
+
+    compiled = None
+    jit_kwargs = {"donate_argnums": (0,)} if donate else {}
+
+    def step(state, batch, rng):
+        nonlocal compiled
+        if compiled is None:
+            specs = pp_state_specs(state, pp_axis)
+            sharded = jax.shard_map(
+                _step,
+                mesh=mesh,
+                in_specs=(specs, P(data_axis), P()),
+                out_specs=(specs, P()),
+                check_vma=False,
+            )
+            compiled = jax.jit(sharded, **jit_kwargs)
+        return compiled(state, batch, rng)
+
+    return step

@@ -64,6 +64,12 @@ def parse_args(argv=None):
                    help="tensor-parallel degree: Megatron column/row "
                         "sharding of attention heads + MLP hidden over a "
                         "'model' mesh axis (LM only)")
+    p.add_argument("--pp", type=int, default=1,
+                   help="pipeline-parallel degree: GPipe stages over a "
+                        "'pipe' mesh axis, layer stack sharded per stage "
+                        "(scanned LM models only)")
+    p.add_argument("--pp-microbatches", type=int, default=None,
+                   help="GPipe microbatches per step (default: --pp)")
     p.add_argument("--zero", action="store_true",
                    help="ZeRO-1 optimizer-state sharding across the data "
                         "axis (reduce_scatter + sharded update + all_gather)")
@@ -136,10 +142,13 @@ def setup(args):
         process_id=args.process_id,
     )
     n = ddp.global_device_count()
-    if n % (args.cp * args.tp):
+    if n % (args.cp * args.tp * args.pp):
         raise SystemExit(
-            f"--cp {args.cp} x --tp {args.tp} does not divide {n} devices"
+            f"--cp {args.cp} x --tp {args.tp} x --pp {args.pp} does not "
+            f"divide {n} devices"
         )
+    if args.pp > 1:
+        return ddp.make_mesh(("data", "pipe"), shape=(n // args.pp, args.pp))
     if args.cp > 1 and args.tp > 1:
         return ddp.make_mesh(
             ("data", "seq", "model"),
@@ -180,6 +189,26 @@ def validate_args(args) -> None:
                 "--tp with --zero is not supported (ZeRO assumes "
                 "replicated params)"
             )
+    if args.pp > 1:
+        if not is_lm(args):
+            raise SystemExit("--pp requires an LM model (--model gpt2|llama)")
+        if args.cp > 1 or args.tp > 1 or args.zero:
+            raise SystemExit(
+                "--pp composes with DP only for now (no --cp/--tp/--zero)"
+            )
+        if args.eval:
+            raise SystemExit("--pp does not support --eval yet")
+        if args.accum_steps > 1:
+            raise SystemExit(
+                "--pp's microbatch loop IS the accumulation; use "
+                "--pp-microbatches instead of --accum-steps"
+            )
+        if args.bucket_mb:
+            raise SystemExit("--pp does not support --bucket-mb")
+        if args.layers and args.layers % args.pp:
+            raise SystemExit(
+                f"--layers {args.layers} must be divisible by --pp {args.pp}"
+            )
 
 
 def build_model(args, num_classes: int = 10, vocab_size: int | None = None):
@@ -207,6 +236,9 @@ def build_model(args, num_classes: int = 10, vocab_size: int | None = None):
             overrides["cp_axis"] = "seq"
         if args.tp > 1:
             overrides["tp_axis"] = "model"
+        if args.pp > 1:
+            # GPipe shards the scanned layer stack's leading dim.
+            overrides["scan_layers"] = True
         if args.layers:
             overrides["num_layers"] = args.layers
         if args.d_model:
@@ -348,6 +380,12 @@ def train(args) -> float:
         # TP layout: Megatron param sharding over the 'model' axis,
         # replicated over 'data' (the broadcast analog for a 2-D mesh).
         state = ddp.shard_state_tp(state, mesh)
+    elif args.pp > 1:
+        state = ddp.TrainState.create(
+            apply_fn=model.apply, params=params, tx=tx, model_state=model_state
+        )
+        # PP layout: the stacked layer dim sharded over the 'pipe' axis.
+        state = ddp.shard_state_pp(state, mesh)
     else:
         state = ddp.TrainState.create(
             apply_fn=model.apply, params=params, tx=tx, model_state=model_state
@@ -384,15 +422,35 @@ def train(args) -> float:
             loss = cross_entropy_loss(logits, batch["label"])  # ref dpp.py:40
             return loss, {"accuracy": accuracy(logits, batch["label"])}
 
-    # One factory for every composition: DP × {accum, buckets, ZeRO} × CP/TP.
-    step_fn = ddp.make_train_step(
-        loss_fn, mesh=mesh, accum_steps=args.accum_steps,
-        bucket_bytes=int(args.bucket_mb * 1024 * 1024) if args.bucket_mb else None,
-        with_model_state=has_ms, zero=args.zero,
-        buffer_sync=args.buffer_sync,
-        cp_axis="seq" if cp else None,
-        tp_axis="model" if args.tp > 1 else None,
-    )
+    if args.pp > 1:
+        # GPipe: the step factory takes the model CONFIG (it decomposes
+        # the transformer into embed / stage stack / head itself); the
+        # microbatch loop is the accumulation.
+        M = args.pp_microbatches or args.pp
+        if args.batch_size % M:
+            raise SystemExit(
+                f"--batch-size {args.batch_size} must be divisible by "
+                f"--pp-microbatches {M}"
+            )
+        if model.cfg.num_layers % args.pp:
+            raise SystemExit(
+                f"model layer count {model.cfg.num_layers} must be "
+                f"divisible by --pp {args.pp}"
+            )
+        step_fn = ddp.make_pp_train_step(
+            model.cfg, mesh=mesh, microbatches=M,
+        )
+    else:
+        # One factory for the other compositions: DP × {accum, buckets,
+        # ZeRO} × CP/TP.
+        step_fn = ddp.make_train_step(
+            loss_fn, mesh=mesh, accum_steps=args.accum_steps,
+            bucket_bytes=int(args.bucket_mb * 1024 * 1024) if args.bucket_mb else None,
+            with_model_state=has_ms, zero=args.zero,
+            buffer_sync=args.buffer_sync,
+            cp_axis="seq" if cp else None,
+            tp_axis="model" if args.tp > 1 else None,
+        )
 
     ckpt = None
     start_epoch = 0
