@@ -316,6 +316,30 @@ class _ScanBlock(nn.Module):
         return x, None
 
 
+def scanned_layer_cls(cfg: TransformerConfig, length: int | None = None):
+    """The scan-transformed decoder-block class — ONE construction shared
+    by TransformerLM and the pipeline-parallel stage runner, so a slice
+    of the stacked params always applies under identical scan settings
+    (remat wrapper, rng splitting, partition metadata).
+
+    ``length`` overrides the layer count (a PP stage runs
+    ``num_layers / n_stages`` of the stack).
+    """
+    scan_block = (
+        nn.remat(_ScanBlock, prevent_cse=False, static_argnums=(4,))
+        if cfg.remat
+        else _ScanBlock
+    )
+    return nn.scan(
+        scan_block,
+        variable_axes={"params": 0},
+        split_rngs={"params": True, "dropout": True},
+        in_axes=(nn.broadcast, nn.broadcast, nn.broadcast),
+        length=length if length is not None else cfg.num_layers,
+        metadata_params={nn.PARTITION_NAME: "layers"},
+    )
+
+
 class LMHead(nn.Module):
     """Untied output projection: params identical to a bias-free Dense
     (``{"kernel": (d_model, vocab)}`` f32, so checkpoints/weight-io are
@@ -397,19 +421,9 @@ class TransformerLM(nn.Module):
         if cfg.scan_layers:
             # One traced layer instead of L (compile time); under scan,
             # remat wraps the scan body (prevent_cse must be False there).
-            scan_block = (
-                nn.remat(_ScanBlock, prevent_cse=False, static_argnums=(4,))
-                if cfg.remat
-                else _ScanBlock
+            x, _ = scanned_layer_cls(cfg)(cfg, name="layers")(
+                x, positions, rope, deterministic
             )
-            x, _ = nn.scan(
-                scan_block,
-                variable_axes={"params": 0},
-                split_rngs={"params": True, "dropout": True},
-                in_axes=(nn.broadcast, nn.broadcast, nn.broadcast),
-                length=cfg.num_layers,
-                metadata_params={nn.PARTITION_NAME: "layers"},
-            )(cfg, name="layers")(x, positions, rope, deterministic)
         else:
             block_cls = (
                 nn.remat(DecoderBlock, static_argnums=(4,))

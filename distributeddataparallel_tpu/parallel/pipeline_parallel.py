@@ -43,11 +43,22 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 Pytree = Any
 
 
-def pp_param_specs(tree: Pytree, axis_name: str = "pipe") -> Pytree:
+def pp_param_specs(
+    tree: Pytree, axis_name: str = "pipe", tp_axis: str | None = None
+) -> Pytree:
     """Spec tree: any leaf under a ``layers`` path component shards its
     LEADING (stacked-layer) dim over the pipe axis; everything else is
     replicated.  Works for optimizer state too (optax trees embed the
-    param paths)."""
+    param paths).
+
+    With ``tp_axis`` the Megatron trailing-dim rules compose underneath:
+    a stacked q_proj kernel becomes e.g. ``P('pipe', None, 'model',
+    None)`` — stages over the pipe axis, heads over the model axis.
+    """
+    from distributeddataparallel_tpu.parallel.tensor_parallel import (
+        _spec_for_path,
+    )
+
     flat = jax.tree_util.tree_flatten_with_path(tree)[0]
     treedef = jax.tree.structure(tree)
     specs = []
@@ -56,24 +67,35 @@ def pp_param_specs(tree: Pytree, axis_name: str = "pipe") -> Pytree:
             str(getattr(k, "key", getattr(k, "name", k))) for k in path
         )
         if "layers" in names and getattr(leaf, "ndim", 0) >= 1:
-            specs.append(P(*((axis_name,) + (None,) * (leaf.ndim - 1))))
+            trailing = (None,) * (leaf.ndim - 1)
+            if tp_axis is not None:
+                tp = _spec_for_path(names, leaf, tp_axis)
+                if any(tp):
+                    # Right-aligned TP partition of the trailing dims
+                    # (the leading dim is the stacked layer axis).
+                    trailing = tuple(tp)[-(leaf.ndim - 1):]
+            specs.append(P(*((axis_name,) + trailing)))
         else:
             specs.append(P())
     return jax.tree.unflatten(treedef, specs)
 
 
-def pp_state_specs(state, axis_name: str = "pipe") -> Pytree:
+def pp_state_specs(
+    state, axis_name: str = "pipe", tp_axis: str | None = None
+) -> Pytree:
     """Spec tree for a whole TrainState under PP (single source for both
     placement and the step's shard_map in_specs)."""
     return state.replace(
         step=P(),
-        params=pp_param_specs(state.params, axis_name),
-        opt_state=pp_param_specs(state.opt_state, axis_name),
+        params=pp_param_specs(state.params, axis_name, tp_axis),
+        opt_state=pp_param_specs(state.opt_state, axis_name, tp_axis),
         model_state=jax.tree.map(lambda _: P(), state.model_state),
     )
 
 
-def shard_state_pp(state, mesh: Mesh, axis_name: str = "pipe"):
+def shard_state_pp(
+    state, mesh: Mesh, axis_name: str = "pipe", tp_axis: str | None = None
+):
     """Place a full TrainState with the stacked layer dim sharded over the
     pipe axis (the PP analog of ``broadcast_params``)."""
     n = mesh.shape[axis_name]
@@ -87,34 +109,25 @@ def shard_state_pp(state, mesh: Mesh, axis_name: str = "pipe"):
     return jax.tree.map(
         lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
         state,
-        pp_state_specs(state, axis_name),
+        pp_state_specs(state, axis_name, tp_axis),
     )
 
 
 def _stage_stack(cfg, n_stages: int):
-    """The scanned block module for ONE stage's layer slice — identical
-    structure to TransformerLM's named-"layers" scan, so a slice of the
-    full model's stacked params applies directly."""
-    from distributeddataparallel_tpu.models.transformer import _ScanBlock
+    """The scanned block module for ONE stage's layer slice — built by
+    the same factory TransformerLM uses (``scanned_layer_cls``), so a
+    slice of the full model's stacked params applies directly and the
+    two can never drift."""
+    from distributeddataparallel_tpu.models.transformer import (
+        scanned_layer_cls,
+    )
 
     if cfg.num_layers % n_stages:
         raise ValueError(
             f"pipeline: num_layers {cfg.num_layers} not divisible by "
             f"{n_stages} stages"
         )
-    scan_block = (
-        nn.remat(_ScanBlock, prevent_cse=False, static_argnums=(4,))
-        if cfg.remat
-        else _ScanBlock
-    )
-    return nn.scan(
-        scan_block,
-        variable_axes={"params": 0},
-        split_rngs={"params": True, "dropout": True},
-        in_axes=(nn.broadcast, nn.broadcast, nn.broadcast),
-        length=cfg.num_layers // n_stages,
-        metadata_params={nn.PARTITION_NAME: "layers"},
-    )(cfg)
+    return scanned_layer_cls(cfg, cfg.num_layers // n_stages)(cfg)
 
 
 def _embed(cfg, params, tokens):
@@ -131,14 +144,11 @@ def _embed(cfg, params, tokens):
 def _head(cfg, params, x):
     """Final norm + logits from raw params — mirrors TransformerLM's
     output block (f32 logits, cfg.dtype matmul operands)."""
-    from distributeddataparallel_tpu.models.transformer import RMSNorm
+    from distributeddataparallel_tpu.models.transformer import _make_norm
 
-    if cfg.norm == "rmsnorm":
-        x = RMSNorm().apply({"params": params["final_norm"]}, x)
-    else:
-        x = nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32).apply(
-            {"params": params["final_norm"]}, x
-        )
+    x = _make_norm(cfg, "final_norm").apply(
+        {"params": params["final_norm"]}, x
+    )
     if cfg.tie_embeddings:
         w = params["token_embed"]["embedding"].astype(cfg.dtype)  # (V, d)
         return jax.lax.dot_general(
@@ -168,6 +178,13 @@ def make_pp_train_step(
     ``batch = {"tokens": (B, S+1) int32}`` sharded over ``data_axis``
     (replicated over the pipe axis); the per-position rows must divide
     ``microbatches``.  State comes from ``shard_state_pp``.
+
+    PP x TP: when ``cfg.tp_axis`` is set, each stage's blocks run
+    Megatron-sharded over that (third) mesh axis; layer params shard over
+    BOTH pipe (leading layer dim) and model (trailing dims).  Embeddings
+    and head are computed replicated over the model axis (their grads
+    complete through the blocks' copy/reduce operators), so only the
+    pipe-axis psum below is needed for them.
     """
     from distributeddataparallel_tpu.models.transformer import (
         rope_frequencies,
@@ -191,6 +208,13 @@ def make_pp_train_step(
         mb_rows = tokens.shape[0] // M
         mbs = tokens.reshape(M, mb_rows, tokens.shape[1])
         S = tokens.shape[1] - 1
+        if S > cfg.max_seq_len:
+            # Same guard TransformerLM.__call__ enforces: past the table
+            # bound, XLA silently CLAMPS RoPE/pos_embed gathers instead
+            # of erroring — training would proceed on wrong positions.
+            raise ValueError(
+                f"seq len {S} > max_seq_len {cfg.max_seq_len}"
+            )
         rope = (
             rope_frequencies(
                 cfg.dims_per_head, cfg.max_seq_len, theta=cfg.rope_theta
@@ -239,7 +263,7 @@ def make_pp_train_step(
         )
         # Complete replicated-param grads over the pipe (only the stages
         # that use them contributed); layer-slice grads stay local.
-        gspecs = pp_param_specs(grads, pp_axis)
+        gspecs = pp_param_specs(grads, pp_axis, cfg.tp_axis)
         grads = jax.tree.map(
             lambda g, sp: g if any(sp) else lax.psum(g, pp_axis),
             grads,
@@ -256,7 +280,7 @@ def make_pp_train_step(
     def step(state, batch, rng):
         nonlocal compiled
         if compiled is None:
-            specs = pp_state_specs(state, pp_axis)
+            specs = pp_state_specs(state, pp_axis, cfg.tp_axis)
             sharded = jax.shard_map(
                 _step,
                 mesh=mesh,

@@ -147,6 +147,11 @@ def setup(args):
             f"--cp {args.cp} x --tp {args.tp} x --pp {args.pp} does not "
             f"divide {n} devices"
         )
+    if args.pp > 1 and args.tp > 1:
+        return ddp.make_mesh(
+            ("data", "pipe", "model"),
+            shape=(n // (args.pp * args.tp), args.pp, args.tp),
+        )
     if args.pp > 1:
         return ddp.make_mesh(("data", "pipe"), shape=(n // args.pp, args.pp))
     if args.cp > 1 and args.tp > 1:
@@ -192,9 +197,9 @@ def validate_args(args) -> None:
     if args.pp > 1:
         if not is_lm(args):
             raise SystemExit("--pp requires an LM model (--model gpt2|llama)")
-        if args.cp > 1 or args.tp > 1 or args.zero:
+        if args.cp > 1 or args.zero:
             raise SystemExit(
-                "--pp composes with DP only for now (no --cp/--tp/--zero)"
+                "--pp composes with DP and --tp (no --cp/--zero yet)"
             )
         if args.eval:
             raise SystemExit("--pp does not support --eval yet")
@@ -373,6 +378,15 @@ def train(args) -> float:
             apply_fn=model.apply, params=params, tx=tx, mesh=mesh,
             model_state=model_state,
         )
+    elif args.pp > 1:
+        state = ddp.TrainState.create(
+            apply_fn=model.apply, params=params, tx=tx, model_state=model_state
+        )
+        # PP layout: the stacked layer dim sharded over the 'pipe' axis
+        # (plus Megatron trailing-dim sharding under --tp).
+        state = ddp.shard_state_pp(
+            state, mesh, tp_axis="model" if args.tp > 1 else None
+        )
     elif args.tp > 1:
         state = ddp.TrainState.create(
             apply_fn=model.apply, params=params, tx=tx, model_state=model_state
@@ -380,12 +394,6 @@ def train(args) -> float:
         # TP layout: Megatron param sharding over the 'model' axis,
         # replicated over 'data' (the broadcast analog for a 2-D mesh).
         state = ddp.shard_state_tp(state, mesh)
-    elif args.pp > 1:
-        state = ddp.TrainState.create(
-            apply_fn=model.apply, params=params, tx=tx, model_state=model_state
-        )
-        # PP layout: the stacked layer dim sharded over the 'pipe' axis.
-        state = ddp.shard_state_pp(state, mesh)
     else:
         state = ddp.TrainState.create(
             apply_fn=model.apply, params=params, tx=tx, model_state=model_state

@@ -131,3 +131,36 @@ def test_pp_rejects_unscanned(devices):
     cfg = dataclasses.replace(_scan_cfg(), scan_layers=False)
     with pytest.raises(ValueError, match="scan_layers"):
         make_pp_train_step(cfg, mesh=mesh, microbatches=2)
+
+
+def test_dp_pp_tp_matches_single_device(devices):
+    """Three axes at once: DP(2) x PP(2) x TP(2) — stages over 'pipe',
+    Megatron head/hidden sharding over 'model' inside each stage — must
+    still reproduce the single-device step."""
+    cfg = _scan_cfg()
+    cfg_x = dataclasses.replace(cfg, tp_axis="model")
+    mesh = ddp.make_mesh(("data", "pipe", "model"), shape=(2, 2, 2))
+    params = TransformerLM(cfg).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 32), jnp.int32)
+    )["params"]
+    tx = optax.sgd(0.1)
+    rng = np.random.default_rng(2)
+    tokens = rng.integers(0, 256, size=(8, 33)).astype(np.int32)
+
+    ref_loss, ref_params = _reference_step(cfg, params, tokens, tx)
+
+    step = make_pp_train_step(cfg_x, mesh=mesh, microbatches=2, donate=False)
+    state = ddp.TrainState.create(apply_fn=None, params=params, tx=tx)
+    state = shard_state_pp(state, mesh, tp_axis="model")
+    batch = shard_batch({"tokens": tokens}, mesh)
+    state, metrics = step(state, batch, jax.random.PRNGKey(0))
+
+    assert float(metrics["loss"]) == pytest.approx(ref_loss, rel=1e-5)
+    for (path, a), b in zip(
+        jax.tree_util.tree_flatten_with_path(state.params)[0],
+        jax.tree.leaves(ref_params),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=2e-5,
+            err_msg="/".join(str(getattr(k, "key", k)) for k in path),
+        )
