@@ -45,6 +45,7 @@ from distributeddataparallel_tpu.parallel.data_parallel import (  # noqa: F401
 )
 from distributeddataparallel_tpu.parallel.zero import zero_state  # noqa: F401
 from distributeddataparallel_tpu.parallel.tensor_parallel import shard_state_tp  # noqa: F401
+from distributeddataparallel_tpu.parallel.expert_parallel import shard_state_ep  # noqa: F401
 from distributeddataparallel_tpu.parallel.pipeline_parallel import (  # noqa: F401
     make_pp_train_step,
     shard_state_pp,

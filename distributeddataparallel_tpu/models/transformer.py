@@ -83,6 +83,13 @@ class TransformerConfig:
     # ``tp_param_specs``; unbound (init / direct apply) it degrades to
     # the full unsharded shapes.
     tp_axis: str | None = None
+    # Mixture-of-experts: replace every block's MLP with `moe_experts`
+    # switch-routed (top-1) expert MLPs.  `ep_axis` shards the expert
+    # dimension over a mesh axis (parallel.expert_parallel) — each
+    # position computes its E/n local experts over all tokens (dense
+    # einsum dispatch, MXU-friendly) and the combine is one psum.
+    moe_experts: int = 0
+    ep_axis: str | None = None
 
     @property
     def kv_heads(self) -> int:
@@ -285,6 +292,105 @@ class MLP(nn.Module):
         )(h)
 
 
+class MoEMLP(nn.Module):
+    """Switch-style top-1 mixture-of-experts MLP with dense einsum
+    dispatch: every token's hidden state is pushed through each LOCAL
+    expert as one batched einsum (MXU-friendly — no gather/scatter), and
+    the router's one-hot gate selects the matching expert's output.
+
+    Under expert parallelism (``cfg.ep_axis``) each mesh position holds
+    ``moe_experts / ep`` experts; the masked combine is completed with
+    one psum (``reduce_from_tp``), and activations enter through the
+    copy operator so replicated-parameter gradients (the router's
+    included) come out complete — the same conjugate-operator pattern as
+    tensor parallelism.
+    """
+
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        from distributeddataparallel_tpu.parallel.tensor_parallel import (
+            copy_to_tp,
+            reduce_from_tp,
+            tp_size,
+        )
+
+        cfg = self.cfg
+        E = cfg.moe_experts
+        n_ep = tp_size(cfg.ep_axis)
+        if E % n_ep:
+            raise ValueError(f"ep={n_ep} must divide moe_experts={E}")
+        El = E // n_ep
+        d, f = cfg.d_model, cfg.d_ff
+
+        # Router runs replicated (its params are tiny); f32 for a stable
+        # softmax.  Top-1 ("switch") routing: the gate probability
+        # multiplies the expert output, which is what lets gradients
+        # train the router.
+        logits = nn.Dense(
+            E, dtype=jnp.float32, use_bias=False, name="router",
+            kernel_init=nn.initializers.normal(0.02),
+        )(x.astype(jnp.float32))
+        probs = jax.nn.softmax(logits, axis=-1)        # (B, S, E)
+        expert_idx = jnp.argmax(probs, axis=-1)        # (B, S)
+        gate = jnp.max(probs, axis=-1)                 # (B, S)
+
+        # Switch load-balance auxiliary (Fedus et al.): E * sum_e f_e*P_e,
+        # f_e = fraction of tokens routed to expert e (stop-grad via
+        # argmax), P_e = mean router probability.  Minimized at uniform
+        # routing; without it top-1 routing can collapse onto one expert.
+        # Computed replicated (router side) and exposed through sow —
+        # loss_fns opt in with apply(..., mutable=["intermediates"]) and
+        # add moe_aux * weight to the loss (the dpp.py CLI does).
+        frac = jnp.mean(
+            jax.nn.one_hot(expert_idx, E, dtype=jnp.float32), axis=(0, 1)
+        )
+        self.sow(
+            "intermediates", "moe_aux",
+            E * jnp.sum(frac * probs.mean(axis=(0, 1))),
+        )
+
+        if cfg.ep_axis is not None and n_ep > 1:
+            x = copy_to_tp(x, cfg.ep_axis)
+        init = nn.initializers.normal(0.02)
+        w_up = self.param("experts_up", init, (El, d, f), jnp.float32)
+        w_down = self.param("experts_down", init, (El, f, d), jnp.float32)
+        xe = x.astype(cfg.dtype)
+        h = jnp.einsum(
+            "bsd,edf->ebsf", xe, w_up.astype(cfg.dtype)
+        )
+        if cfg.activation == "swiglu":
+            w_gate = self.param(
+                "experts_gate", init, (El, d, f), jnp.float32
+            )
+            g = jnp.einsum("bsd,edf->ebsf", xe, w_gate.astype(cfg.dtype))
+            h = nn.silu(g) * h
+        else:
+            h = nn.gelu(h, approximate=True)
+        y = jnp.einsum(
+            "ebsf,efd->ebsd", h, w_down.astype(cfg.dtype)
+        )  # (El, B, S, d)
+
+        # One-hot combine: local expert e is global expert ep_rank*El + e.
+        # Only the 0/1 mask lives inside the expert region; the gate
+        # multiply happens AFTER the psum, where the computation is
+        # replicated — otherwise the router's backward contribution
+        # (through d gate and d logits) would be per-position partial
+        # and the replicated router/attention grads would come out wrong.
+        first = (
+            jax.lax.axis_index(cfg.ep_axis) * El
+            if cfg.ep_axis is not None and n_ep > 1
+            else 0
+        )
+        eid = first + jnp.arange(El)                   # (El,)
+        mask = (expert_idx[None] == eid[:, None, None]).astype(cfg.dtype)
+        out = jnp.einsum("ebsd,ebs->bsd", y, mask)
+        if cfg.ep_axis is not None and n_ep > 1:
+            out = reduce_from_tp(out, cfg.ep_axis)
+        return out * gate[..., None].astype(cfg.dtype)
+
+
 class DecoderBlock(nn.Module):
     cfg: TransformerConfig
 
@@ -299,7 +405,11 @@ class DecoderBlock(nn.Module):
             )
         )
         y = _make_norm(cfg, "mlp_norm")(x)
-        x = x + drop(MLP(cfg, name="mlp")(y))
+        mlp = (
+            MoEMLP(cfg, name="mlp") if cfg.moe_experts > 0
+            else MLP(cfg, name="mlp")
+        )
+        x = x + drop(mlp(y))
         return x
 
 
@@ -332,7 +442,10 @@ def scanned_layer_cls(cfg: TransformerConfig, length: int | None = None):
     )
     return nn.scan(
         scan_block,
-        variable_axes={"params": 0},
+        # intermediates: MoE blocks sow their load-balance aux per layer;
+        # stacked along the scan dim when the caller makes it mutable
+        # (a no-op for dense models / immutable applies).
+        variable_axes={"params": 0, "intermediates": 0},
         split_rngs={"params": True, "dropout": True},
         in_axes=(nn.broadcast, nn.broadcast, nn.broadcast),
         length=length if length is not None else cfg.num_layers,

@@ -25,3 +25,8 @@ from distributeddataparallel_tpu.parallel.pipeline_parallel import (  # noqa: F4
     pp_state_specs,
     shard_state_pp,
 )
+from distributeddataparallel_tpu.parallel.expert_parallel import (  # noqa: F401
+    ep_param_specs,
+    ep_state_specs,
+    shard_state_ep,
+)

@@ -1,0 +1,141 @@
+"""Expert parallelism: MoE expert sharding over an ``expert`` mesh axis.
+
+Thin layout layer over the same conjugate-operator machinery as tensor
+parallelism (``parallel.tensor_parallel``): ``models.transformer.MoEMLP``
+enters the expert region through ``copy_to_tp`` and combines with
+``reduce_from_tp``, so every replicated parameter's gradient (router,
+attention, norms, embeddings) comes out complete on all positions and
+the data-axis sync needs no EP-awareness.  This module supplies the
+parameter layout: expert weight stacks shard their EXPERT dim, which is
+the leading dim unscanned and the second dim under scanned layers —
+expressed by right-aligning the rule against each leaf.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+Pytree = Any
+
+#: path-suffix -> partition of the TRAILING dims (right-aligned).
+_EP_RULES: tuple[tuple[tuple[str, ...], tuple[str | None, ...]], ...] = (
+    (("experts_up",), ("expert", None, None)),    # (E, d, f)
+    (("experts_gate",), ("expert", None, None)),
+    (("experts_down",), ("expert", None, None)),  # (E, f, d)
+)
+
+
+def _spec_for_path(path, leaf, axis_name: str) -> P:
+    for suffix, dims in _EP_RULES:
+        if path[-len(suffix):] == suffix:
+            trailing = tuple(
+                axis_name if d == "expert" else None for d in dims
+            )
+            pad = leaf.ndim - len(trailing)
+            if pad < 0:
+                raise ValueError(
+                    f"param {'/'.join(path)} has rank {leaf.ndim}, "
+                    f"expected >= {len(trailing)}"
+                )
+            return P(*((None,) * pad + trailing))
+    return P()
+
+
+def ep_param_specs(tree: Pytree, axis_name: str = "expert") -> Pytree:
+    """PartitionSpec tree sharding expert stacks over ``axis_name``;
+    works on optimizer state too (optax trees embed the param paths)."""
+    flat = jax.tree_util.tree_flatten_with_path(tree)[0]
+    treedef = jax.tree.structure(tree)
+    specs = []
+    for path, leaf in flat:
+        names = tuple(
+            str(getattr(k, "key", getattr(k, "name", k))) for k in path
+        )
+        specs.append(_spec_for_path(names, leaf, axis_name))
+    return jax.tree.unflatten(treedef, specs)
+
+
+def ep_state_specs(state, axis_name: str = "expert") -> Pytree:
+    return state.replace(
+        step=P(),
+        params=ep_param_specs(state.params, axis_name),
+        opt_state=ep_param_specs(state.opt_state, axis_name),
+        model_state=jax.tree.map(lambda _: P(), state.model_state),
+    )
+
+
+def shard_state_ep(state, mesh: Mesh, axis_name: str = "expert"):
+    """Place a full TrainState with expert stacks sharded over the expert
+    axis (the EP analog of ``broadcast_params``)."""
+    n = mesh.shape[axis_name]
+    for path, leaf in jax.tree_util.tree_flatten_with_path(state.params)[0]:
+        names = tuple(str(getattr(k, "key", k)) for k in path)
+        spec = _spec_for_path(names, leaf, axis_name)
+        for dim, name in enumerate(spec):
+            if name == axis_name and leaf.shape[dim] % n:
+                raise ValueError(
+                    f"EP degree {n} does not divide dim {dim} of param "
+                    f"{'/'.join(names)} (shape {leaf.shape}) — "
+                    f"moe_experts must be divisible by the expert-axis size"
+                )
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        state,
+        ep_state_specs(state, axis_name),
+    )
+
+
+def model_axes_param_specs(
+    params: Pytree,
+    tp_axis: str | None = None,
+    ep_axis: str | None = None,
+) -> Pytree:
+    """Combined per-leaf specs for the model-sharding axes: Megatron TP
+    rules and expert EP rules hit disjoint leaves, so each leaf takes
+    whichever rule is non-trivial (replicated when neither applies).
+    THE single source for train-step in_specs, state placement, and eval
+    in_specs — keep them from diverging."""
+    from distributeddataparallel_tpu.parallel.tensor_parallel import (
+        tp_param_specs,
+    )
+
+    specs = (
+        tp_param_specs(params, tp_axis)
+        if tp_axis is not None
+        else jax.tree.map(lambda _: P(), params)
+    )
+    if ep_axis is not None:
+        specs = jax.tree.map(
+            lambda t, e: e if any(e) else t,
+            specs,
+            ep_param_specs(params, ep_axis),
+        )
+    return specs
+
+
+def model_axes_state_specs(
+    state, tp_axis: str | None = None, ep_axis: str | None = None
+) -> Pytree:
+    return state.replace(
+        step=P(),
+        params=model_axes_param_specs(state.params, tp_axis, ep_axis),
+        opt_state=model_axes_param_specs(state.opt_state, tp_axis, ep_axis),
+        model_state=jax.tree.map(lambda _: P(), state.model_state),
+    )
+
+
+def shard_state_model_axes(
+    state,
+    mesh: Mesh,
+    tp_axis: str | None = None,
+    ep_axis: str | None = None,
+):
+    """Place a full TrainState under any combination of TP and EP."""
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        state,
+        model_axes_state_specs(state, tp_axis, ep_axis),
+    )

@@ -57,6 +57,7 @@ def make_train_step(
     buffer_sync: str = "mean",
     cp_axis: str | None = None,
     tp_axis: str | None = None,
+    ep_axis: str | None = None,
 ):
     """Build the jit'd DP train step.
 
@@ -113,16 +114,22 @@ def make_train_step(
     shard, replicated leaves identically everywhere — so the data-axis
     sync needs no TP-awareness.  ``zero=True`` with TP is not supported
     (the flat-chunk layout assumes replicated params).
+
+    ``ep_axis`` adds expert parallelism for MoE configs
+    (``parallel.expert_parallel``): expert weight stacks shard over the
+    axis, the batch replicates, and — as with TP — the MoE module's
+    copy/reduce operators complete every gradient, so no extra sync is
+    needed here.  TP and EP compose (disjoint parameter sets).
     """
     if zero and bucket_bytes is not None:
         raise ValueError("zero=True does its own reduction; drop bucket_bytes")
     if not grad_sync and (zero or bucket_bytes is not None):
         raise ValueError("grad_sync=False skips the reduction entirely; "
                          "it does not compose with zero/bucket_bytes")
-    if zero and tp_axis is not None:
+    if zero and (tp_axis is not None or ep_axis is not None):
         raise ValueError(
-            "zero=True with tp_axis is not supported: ZeRO's flat-chunk "
-            "layout assumes replicated params"
+            "zero=True with tp_axis/ep_axis is not supported: ZeRO's "
+            "flat-chunk layout assumes replicated params"
         )
     if buffer_sync not in ("mean", "broadcast"):
         # No "local" mode: model state is declared replicated (out_specs
@@ -281,7 +288,7 @@ def make_train_step(
     )
     jit_kwargs = {"donate_argnums": (0,)} if donate else {}
 
-    if not zero and tp_axis is None:
+    if not zero and tp_axis is None and ep_axis is None:
         sharded = jax.shard_map(
             _replica_step,
             mesh=mesh,
@@ -291,10 +298,10 @@ def make_train_step(
         )
         return jax.jit(sharded, **jit_kwargs)
 
-    # ZeRO / TP: the state's leaves carry per-leaf shardings (ZeRO: flat
-    # opt chunks over the data axis; TP: Megatron param layout over the
-    # model axis), so the spec tree depends on the state structure —
-    # build on first call (jit caches thereafter).
+    # ZeRO / TP / EP: the state's leaves carry per-leaf shardings (ZeRO:
+    # flat opt chunks over the data axis; TP/EP: Megatron/expert layouts
+    # over their model axes), so the spec tree depends on the state
+    # structure — build on first call (jit caches thereafter).
     compiled = None
 
     def step(state: TrainState, batch: Pytree, rng: jax.Array):
@@ -307,11 +314,11 @@ def make_train_step(
 
                 specs = state_specs(state, axis_name)
             else:
-                from distributeddataparallel_tpu.parallel.tensor_parallel import (
-                    tp_state_specs,
+                from distributeddataparallel_tpu.parallel.expert_parallel import (
+                    model_axes_state_specs,
                 )
 
-                specs = tp_state_specs(state, tp_axis)
+                specs = model_axes_state_specs(state, tp_axis, ep_axis)
             sharded = jax.shard_map(
                 _replica_step,
                 mesh=mesh,

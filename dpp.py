@@ -70,6 +70,14 @@ def parse_args(argv=None):
                         "(scanned LM models only)")
     p.add_argument("--pp-microbatches", type=int, default=None,
                    help="GPipe microbatches per step (default: --pp)")
+    p.add_argument("--moe-experts", type=int, default=0,
+                   help="replace every block's MLP with N switch-routed "
+                        "(top-1) experts (LM only)")
+    p.add_argument("--ep", type=int, default=1,
+                   help="expert-parallel degree: shard MoE experts over "
+                        "an 'expert' mesh axis (requires --moe-experts)")
+    p.add_argument("--moe-aux-weight", type=float, default=0.01,
+                   help="weight of the switch load-balance auxiliary loss")
     p.add_argument("--zero", action="store_true",
                    help="ZeRO-1 optimizer-state sharding across the data "
                         "axis (reduce_scatter + sharded update + all_gather)")
@@ -154,6 +162,21 @@ def setup(args):
         )
     if args.pp > 1:
         return ddp.make_mesh(("data", "pipe"), shape=(n // args.pp, args.pp))
+    if args.ep > 1 and args.tp > 1:
+        if n % (args.ep * args.tp):
+            raise SystemExit(
+                f"--ep {args.ep} x --tp {args.tp} does not divide {n} devices"
+            )
+        return ddp.make_mesh(
+            ("data", "expert", "model"),
+            shape=(n // (args.ep * args.tp), args.ep, args.tp),
+        )
+    if args.ep > 1:
+        if n % args.ep:
+            raise SystemExit(f"--ep {args.ep} does not divide {n} devices")
+        return ddp.make_mesh(
+            ("data", "expert"), shape=(n // args.ep, args.ep)
+        )
     if args.cp > 1 and args.tp > 1:
         return ddp.make_mesh(
             ("data", "seq", "model"),
@@ -214,6 +237,20 @@ def validate_args(args) -> None:
             raise SystemExit(
                 f"--layers {args.layers} must be divisible by --pp {args.pp}"
             )
+    if args.moe_experts and not is_lm(args):
+        raise SystemExit("--moe-experts requires an LM model")
+    if args.ep > 1:
+        if not args.moe_experts:
+            raise SystemExit("--ep requires --moe-experts")
+        if args.moe_experts % args.ep:
+            raise SystemExit(
+                f"--moe-experts {args.moe_experts} must be divisible by "
+                f"--ep {args.ep}"
+            )
+        if args.cp > 1 or args.pp > 1 or args.zero:
+            raise SystemExit(
+                "--ep composes with DP and --tp (no --cp/--pp/--zero yet)"
+            )
 
 
 def build_model(args, num_classes: int = 10, vocab_size: int | None = None):
@@ -244,6 +281,10 @@ def build_model(args, num_classes: int = 10, vocab_size: int | None = None):
         if args.pp > 1:
             # GPipe shards the scanned layer stack's leading dim.
             overrides["scan_layers"] = True
+        if args.moe_experts:
+            overrides["moe_experts"] = args.moe_experts
+        if args.ep > 1:
+            overrides["ep_axis"] = "expert"
         if args.layers:
             overrides["num_layers"] = args.layers
         if args.d_model:
@@ -387,6 +428,22 @@ def train(args) -> float:
         state = ddp.shard_state_pp(
             state, mesh, tp_axis="model" if args.tp > 1 else None
         )
+    elif args.ep > 1:
+        state = ddp.TrainState.create(
+            apply_fn=model.apply, params=params, tx=tx, model_state=model_state
+        )
+        if args.tp > 1:
+            # Combined EP x TP placement (disjoint leaf sets) — ONE spec
+            # source shared with the train step's in_specs.
+            from distributeddataparallel_tpu.parallel.expert_parallel import (
+                shard_state_model_axes,
+            )
+
+            state = shard_state_model_axes(
+                state, mesh, tp_axis="model", ep_axis="expert"
+            )
+        else:
+            state = ddp.shard_state_ep(state, mesh)
     elif args.tp > 1:
         state = ddp.TrainState.create(
             apply_fn=model.apply, params=params, tx=tx, model_state=model_state
@@ -410,11 +467,31 @@ def train(args) -> float:
     elif lm:
         from distributeddataparallel_tpu.ops import lm_cross_entropy
 
-        def loss_fn(params, batch, rng):
-            toks = batch["tokens"]
-            logits = model.apply({"params": params}, toks[:, :-1])
-            loss = lm_cross_entropy(logits, toks[:, 1:])
-            return loss, {"accuracy": accuracy(logits, toks[:, 1:])}
+        if args.moe_experts:
+            def loss_fn(params, batch, rng):
+                toks = batch["tokens"]
+                logits, col = model.apply(
+                    {"params": params}, toks[:, :-1],
+                    mutable=["intermediates"],
+                )
+                # Mean of the per-layer sown aux terms (sow wraps each in
+                # a tuple; scan stacks them) — layer-count independent.
+                terms = jax.tree.leaves(col)
+                aux = sum(jnp.mean(t) for t in terms) / max(len(terms), 1)
+                loss = (
+                    lm_cross_entropy(logits, toks[:, 1:])
+                    + args.moe_aux_weight * aux
+                )
+                return loss, {
+                    "accuracy": accuracy(logits, toks[:, 1:]),
+                    "moe_aux": aux,
+                }
+        else:
+            def loss_fn(params, batch, rng):
+                toks = batch["tokens"]
+                logits = model.apply({"params": params}, toks[:, :-1])
+                loss = lm_cross_entropy(logits, toks[:, 1:])
+                return loss, {"accuracy": accuracy(logits, toks[:, 1:])}
     elif has_ms:
         def loss_fn(params, ms, batch, rng):
             logits, new_vars = model.apply(
@@ -458,6 +535,7 @@ def train(args) -> float:
             buffer_sync=args.buffer_sync,
             cp_axis="seq" if cp else None,
             tp_axis="model" if args.tp > 1 else None,
+            ep_axis="expert" if args.ep > 1 else None,
         )
 
     ckpt = None
@@ -471,13 +549,21 @@ def train(args) -> float:
     # Evaluation is exact over the padded tail: the loader emits a per-row
     # "valid" mask (0 on sampler-padded duplicate rows) and the masked eval
     # steps take per-row metrics, so padded rows contribute nothing.
-    # Under --tp, eval runs directly on the TP-sharded params (same model,
-    # same Megatron psums) — no gathered replica is ever materialized.
+    # Under --tp/--ep, eval runs directly on the sharded params (same
+    # model, same per-layer psums) — no gathered replica is ever
+    # materialized, and the specs come from the SAME source the train
+    # step compiled with.
     eval_param_specs = None
-    if args.tp > 1:
-        from distributeddataparallel_tpu.parallel import tp_param_specs
+    if args.tp > 1 or args.ep > 1:
+        from distributeddataparallel_tpu.parallel.expert_parallel import (
+            model_axes_param_specs,
+        )
 
-        eval_param_specs = tp_param_specs(state.params)
+        eval_param_specs = model_axes_param_specs(
+            state.params,
+            tp_axis="model" if args.tp > 1 else None,
+            ep_axis="expert" if args.ep > 1 else None,
+        )
     eval_step = None
     if args.eval and cp:
         from distributeddataparallel_tpu.data import shard_lm_batch
