@@ -169,6 +169,38 @@ def test_cli_resume_matches_uninterrupted(tmp_path, devices):
     assert loss_resumed == loss_full, (loss_resumed, loss_full)
 
 
+def _resume_matches_uninterrupted(
+    tmp_path, name, step, fresh_state, batches, key, check_restored=None
+):
+    """Shared skeleton for the sharded-layout resume tests: 4-step
+    uninterrupted reference vs 2 steps -> save -> restore into a fresh
+    skeleton (-> optional layout check) -> 2 more steps; must match
+    leaf-for-leaf."""
+    ref = fresh_state()
+    for b in batches:
+        ref, _ = step(ref, b, key)
+
+    st = fresh_state()
+    for b in batches[:2]:
+        st, _ = step(st, b, key)
+    ckpt = Checkpointer(str(tmp_path / name))
+    ckpt.save(st, epoch=0)
+    ckpt.wait()
+    restored, epoch = Checkpointer(str(tmp_path / name)).restore_latest(
+        fresh_state()
+    )
+    assert epoch == 1  # next epoch to run
+    if check_restored is not None:
+        check_restored(restored)
+    for b in batches[2:]:
+        restored, _ = step(restored, b, key)
+
+    _assert_trees_equal(restored.params, ref.params, "params after resume")
+    _assert_trees_equal(
+        restored.opt_state, ref.opt_state, "opt state after resume"
+    )
+
+
 def test_checkpoint_resume_tp_sharded(tmp_path, devices):
     """TP-sharded state survives save -> restore with its Megatron layout
     intact, and resumed training matches the uninterrupted run exactly."""
@@ -210,39 +242,64 @@ def test_checkpoint_resume_tp_sharded(tmp_path, devices):
     step = ddp.make_train_step(
         loss_fn, mesh=mesh, tp_axis="model", donate=False
     )
-    key = jax.random.PRNGKey(1)
 
-    # Uninterrupted: 4 steps.
-    ref = fresh_state()
-    for b in batches:
-        ref, _ = step(ref, b, key)
+    def check(restored):
+        # Restored leaves keep the TP sharding (no silent replication).
+        from distributeddataparallel_tpu.parallel import tp_param_specs
 
-    # Interrupted: 2 steps -> save -> restore into a fresh skeleton -> 2 more.
-    st = fresh_state()
-    for b in batches[:2]:
-        st, _ = step(st, b, key)
-    ckpt = Checkpointer(str(tmp_path / "tp"))
-    ckpt.save(st, epoch=0)
-    ckpt.wait()
+        for leaf, spec in zip(
+            jax.tree.leaves(restored.params),
+            jax.tree.leaves(tp_param_specs(params)),
+        ):
+            got = leaf.sharding.spec if hasattr(leaf.sharding, "spec") else None
+            if any(spec):
+                assert got == spec, (got, spec)
 
-    restored, epoch = Checkpointer(str(tmp_path / "tp")).restore_latest(
-        fresh_state()
+    _resume_matches_uninterrupted(
+        tmp_path, "tp", step, fresh_state, batches, jax.random.PRNGKey(1),
+        check_restored=check,
     )
-    assert epoch == 1  # next epoch to run
-    # Restored leaves keep the TP sharding (no silent replication).
-    from distributeddataparallel_tpu.parallel import tp_param_specs
 
-    for leaf, spec in zip(
-        jax.tree.leaves(restored.params),
-        jax.tree.leaves(tp_param_specs(params)),
-    ):
-        got = leaf.sharding.spec if hasattr(leaf.sharding, "spec") else None
-        if any(spec):
-            assert got == spec, (got, spec)
-    for b in batches[2:]:
-        restored, _ = step(restored, b, key)
 
-    _assert_trees_equal(restored.params, ref.params, "params after resume")
-    _assert_trees_equal(
-        restored.opt_state, ref.opt_state, "opt state after resume"
+def test_checkpoint_resume_pp_sharded(tmp_path, devices):
+    """GPipe-sharded state (layer stack over the pipe axis) survives
+    save -> restore with its sharding intact; resumed training matches
+    the uninterrupted run exactly."""
+    from distributeddataparallel_tpu.models import TransformerLM, tiny_lm
+    from distributeddataparallel_tpu.parallel import (
+        make_pp_train_step,
+        shard_state_pp,
+    )
+
+    mesh = ddp.make_mesh(("data", "pipe"), shape=(2, 4))
+    cfg = tiny_lm(
+        num_layers=4, num_heads=2, d_model=32, d_ff=64, max_seq_len=32,
+        scan_layers=True,
+    )
+    params = TransformerLM(cfg).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 32), jnp.int32)
+    )["params"]
+    tx = optax.adam(1e-2)
+    rng = np.random.default_rng(5)
+    batches = [
+        shard_batch(
+            {"tokens": rng.integers(0, 256, size=(8, 33)).astype(np.int32)},
+            mesh,
+        )
+        for _ in range(4)
+    ]
+    step = make_pp_train_step(cfg, mesh=mesh, microbatches=2, donate=False)
+
+    def fresh():
+        st = ddp.TrainState.create(apply_fn=None, params=params, tx=tx)
+        return shard_state_pp(st, mesh)
+
+    def check(restored):
+        # Layer leaves keep their pipe sharding after restore.
+        leaf = restored.params["layers"]["block"]["attn"]["q_proj"]["kernel"]
+        assert leaf.sharding.spec[0] == "pipe", leaf.sharding
+
+    _resume_matches_uninterrupted(
+        tmp_path, "pp", step, fresh, batches, jax.random.PRNGKey(1),
+        check_restored=check,
     )
