@@ -25,8 +25,9 @@ The schedule is plain GPipe inside ``shard_map``:
   over the pipe axis completes them.  Layer-slice gradients are local by
   construction.  The data axis then applies the ordinary DDP mean.
 
-Restrictions (v1): ``scan_layers=True`` configs without dropout; the
-sequence axis is not also sharded (no PP x CP).  DP x PP composes; the
+Restrictions: ``scan_layers=True`` configs without dropout.  DP, TP
+(``cfg.tp_axis``), and CP (``cfg.cp_axis``, ring attention with
+host-side input/target split) all compose with the pipeline; the
 microbatch loop is itself the gradient-accumulation analog.
 """
 
@@ -130,14 +131,22 @@ def _stage_stack(cfg, n_stages: int):
     return scanned_layer_cls(cfg, cfg.num_layers // n_stages)(cfg)
 
 
-def _embed(cfg, params, tokens):
+def _embed(cfg, params, tokens, positions=None):
     """Token (+ learned positional) embedding from raw params — mirrors
-    TransformerLM's input block (models/transformer.py) without dropout."""
+    TransformerLM's input block (models/transformer.py) without dropout.
+
+    ``positions``: global token positions of this shard (context
+    parallelism); defaults to ``arange(S)``.
+    """
     emb = params["token_embed"]["embedding"]  # (V, d) f32
     x = emb[tokens].astype(cfg.dtype)
     if cfg.positional == "learned":
-        S = tokens.shape[1]
-        x = x + params["pos_embed"][:S].astype(cfg.dtype)
+        if positions is None:
+            # Static slice (cheaper than a gather-by-iota in the tick loop).
+            pos = params["pos_embed"][: tokens.shape[1]]
+        else:
+            pos = params["pos_embed"][positions]
+        x = x + pos.astype(cfg.dtype)
     return x
 
 
@@ -185,6 +194,14 @@ def make_pp_train_step(
     and head are computed replicated over the model axis (their grads
     complete through the blocks' copy/reduce operators), so only the
     pipe-axis psum below is needed for them.
+
+    PP x CP: when ``cfg.cp_axis`` is set, the batch arrives pre-split as
+    ``{"inputs", "targets"}`` sharded (rows → ``data_axis``, sequence →
+    the cp axis; see ``shard_lm_batch`` — the next-token shift crosses
+    seq shards so it must happen host-side), stage blocks run ring
+    attention with global positions, and gradients are pmean'd over the
+    cp axis after the pipe completion (the sequence-sharded loss's
+    missing reduction, exactly as in ``make_train_step``).
     """
     from distributeddataparallel_tpu.models.transformer import (
         rope_frequencies,
@@ -202,18 +219,30 @@ def make_pp_train_step(
     M = microbatches
     stack = _stage_stack(cfg, n_stages)
 
-    def pp_loss(params, tokens):
+    def pp_loss(params, inputs, targets):
+        """inputs/targets: (B_local, S_local) — the next-token shift
+        already applied (host-side under CP, trivially otherwise)."""
         s = lax.axis_index(pp_axis)
         n = n_stages
-        mb_rows = tokens.shape[0] // M
-        mbs = tokens.reshape(M, mb_rows, tokens.shape[1])
-        S = tokens.shape[1] - 1
-        if S > cfg.max_seq_len:
+        mb_rows = inputs.shape[0] // M
+        S = inputs.shape[1]
+        mbs_in = inputs.reshape(M, mb_rows, S)
+        mbs_tgt = targets.reshape(M, mb_rows, S)
+        positions = None
+        n_cp = 1
+        if cfg.cp_axis is not None:
+            from distributeddataparallel_tpu.parallel.context_parallel import (
+                cp_positions,
+            )
+
+            n_cp = int(lax.psum(1, cfg.cp_axis))
+            positions = cp_positions(S, cfg.cp_axis)
+        if S * n_cp > cfg.max_seq_len:
             # Same guard TransformerLM.__call__ enforces: past the table
             # bound, XLA silently CLAMPS RoPE/pos_embed gathers instead
             # of erroring — training would proceed on wrong positions.
             raise ValueError(
-                f"seq len {S} > max_seq_len {cfg.max_seq_len}"
+                f"global seq len {S * n_cp} > max_seq_len {cfg.max_seq_len}"
             )
         rope = (
             rope_frequencies(
@@ -225,7 +254,9 @@ def make_pp_train_step(
         layer_shard = params["layers"]
 
         def run_stage(x):
-            y, _ = stack.apply({"params": layer_shard}, x, None, rope, True)
+            y, _ = stack.apply(
+                {"params": layer_shard}, x, positions, rope, True
+            )
             return y
 
         perm = [(i, (i + 1) % n) for i in range(n)]
@@ -236,7 +267,7 @@ def make_pp_train_step(
         # so their gradients vanish and AD reconstructs the reverse
         # pipeline schedule on its own.
         for t in range(M + n - 1):
-            x0 = _embed(cfg, params, mbs[min(t, M - 1)][:, :-1])
+            x0 = _embed(cfg, params, mbs_in[min(t, M - 1)], positions)
             x = jnp.where(s == 0, x0, buf)
             y = run_stage(x)
             buf = lax.ppermute(y, pp_axis, perm)
@@ -244,13 +275,14 @@ def make_pp_train_step(
             if out_idx < 0:
                 continue  # pipe still filling: no stage has output yet
             logits = _head(cfg, params, y)
-            tgt = mbs[out_idx][:, 1:]
-            mb_loss = lm_cross_entropy(logits, tgt)
+            mb_loss = lm_cross_entropy(logits, mbs_tgt[out_idx])
             acc = acc + jnp.where(s == n - 1, mb_loss, 0.0)
         # Only the last stage accumulated; the psum replicates the total.
         # MUST be the custom-vjp reduce (psum fwd, identity bwd): under
         # check_vma=False, lax.psum's transpose psums the replicated
-        # cotangent again, scaling every gradient by n_stages.
+        # cotangent again, scaling every gradient by n_stages.  Under CP
+        # this is still the LOCAL (per-seq-shard) loss; the seq reduction
+        # happens outside the differentiated function.
         from distributeddataparallel_tpu.parallel.tensor_parallel import (
             reduce_from_tp,
         )
@@ -258,8 +290,13 @@ def make_pp_train_step(
         return reduce_from_tp(acc, pp_axis) / M
 
     def _step(state, batch, rng):
+        if cfg.cp_axis is not None:
+            inputs, targets = batch["inputs"], batch["targets"]
+        else:
+            toks = batch["tokens"]
+            inputs, targets = toks[:, :-1], toks[:, 1:]
         loss, grads = jax.value_and_grad(pp_loss)(
-            state.params, batch["tokens"]
+            state.params, inputs, targets
         )
         # Complete replicated-param grads over the pipe (only the stages
         # that use them contributed); layer-slice grads stay local.
@@ -269,6 +306,13 @@ def make_pp_train_step(
             grads,
             gspecs,
         )
+        if cfg.cp_axis is not None:
+            # Complete the sequence-sharded gradient (model math, exactly
+            # as in make_train_step's cp handling).
+            grads = jax.tree.map(
+                lambda g: lax.pmean(g, cfg.cp_axis), grads
+            )
+            loss = lax.pmean(loss, cfg.cp_axis)
         if grad_sync:
             grads = all_reduce_gradients(grads, data_axis, op="mean")
         new_state = state.apply_gradients(grads)
@@ -277,6 +321,14 @@ def make_pp_train_step(
     compiled = None
     jit_kwargs = {"donate_argnums": (0,)} if donate else {}
 
+    if cfg.cp_axis is not None:
+        batch_spec: Any = {
+            "inputs": P(data_axis, cfg.cp_axis),
+            "targets": P(data_axis, cfg.cp_axis),
+        }
+    else:
+        batch_spec = P(data_axis)
+
     def step(state, batch, rng):
         nonlocal compiled
         if compiled is None:
@@ -284,7 +336,7 @@ def make_pp_train_step(
             sharded = jax.shard_map(
                 _step,
                 mesh=mesh,
-                in_specs=(specs, P(data_axis), P()),
+                in_specs=(specs, batch_spec, P()),
                 out_specs=(specs, P()),
                 check_vma=False,
             )

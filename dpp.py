@@ -149,44 +149,29 @@ def setup(args):
         num_processes=args.num_processes,
         process_id=args.process_id,
     )
+    # One general mesh builder: whatever parallelism axes are requested,
+    # in canonical order (data outermost, then seq/pipe/expert/model) —
+    # unsupported combinations were already rejected by validate_args.
     n = ddp.global_device_count()
-    if n % (args.cp * args.tp * args.pp):
+    axes, sizes = ["data"], []
+    for degree, name in (
+        (args.cp, "seq"),
+        (args.pp, "pipe"),
+        (args.ep, "expert"),
+        (args.tp, "model"),
+    ):
+        if degree > 1:
+            axes.append(name)
+            sizes.append(degree)
+    denom = 1
+    for d in sizes:
+        denom *= d
+    if n % denom:
         raise SystemExit(
-            f"--cp {args.cp} x --tp {args.tp} x --pp {args.pp} does not "
-            f"divide {n} devices"
+            f"requested parallelism ({' x '.join(f'{a}={d}' for a, d in zip(axes[1:], sizes))}) "
+            f"does not divide {n} devices"
         )
-    if args.pp > 1 and args.tp > 1:
-        return ddp.make_mesh(
-            ("data", "pipe", "model"),
-            shape=(n // (args.pp * args.tp), args.pp, args.tp),
-        )
-    if args.pp > 1:
-        return ddp.make_mesh(("data", "pipe"), shape=(n // args.pp, args.pp))
-    if args.ep > 1 and args.tp > 1:
-        if n % (args.ep * args.tp):
-            raise SystemExit(
-                f"--ep {args.ep} x --tp {args.tp} does not divide {n} devices"
-            )
-        return ddp.make_mesh(
-            ("data", "expert", "model"),
-            shape=(n // (args.ep * args.tp), args.ep, args.tp),
-        )
-    if args.ep > 1:
-        if n % args.ep:
-            raise SystemExit(f"--ep {args.ep} does not divide {n} devices")
-        return ddp.make_mesh(
-            ("data", "expert"), shape=(n // args.ep, args.ep)
-        )
-    if args.cp > 1 and args.tp > 1:
-        return ddp.make_mesh(
-            ("data", "seq", "model"),
-            shape=(n // (args.cp * args.tp), args.cp, args.tp),
-        )
-    if args.cp > 1:
-        return ddp.make_mesh(("data", "seq"), shape=(n // args.cp, args.cp))
-    if args.tp > 1:
-        return ddp.make_mesh(("data", "model"), shape=(n // args.tp, args.tp))
-    return ddp.make_mesh(("data",))
+    return ddp.make_mesh(tuple(axes), shape=(n // denom, *sizes))
 
 
 def is_lm(args) -> bool:
@@ -220,10 +205,8 @@ def validate_args(args) -> None:
     if args.pp > 1:
         if not is_lm(args):
             raise SystemExit("--pp requires an LM model (--model gpt2|llama)")
-        if args.cp > 1 or args.zero:
-            raise SystemExit(
-                "--pp composes with DP and --tp (no --cp/--zero yet)"
-            )
+        if args.zero:
+            raise SystemExit("--pp does not compose with --zero")
         if args.eval:
             raise SystemExit("--pp does not support --eval yet")
         if args.accum_steps > 1:
