@@ -192,3 +192,42 @@ def test_moe_aux_loss_sown_and_differentiable(devices):
     assert float(aux) >= 1.0 - 1e-4
     router_g = g["layers"]["block"]["mlp"]["router"]["kernel"]
     assert float(jnp.abs(router_g).max()) > 0.0
+
+
+def test_ep_accum_matches_plain_ep(devices):
+    """EP x gradient accumulation: 2 microbatches == single EP step on
+    the same global batch."""
+    cfg = _moe_cfg()
+    cfg_ep = dataclasses.replace(cfg, ep_axis="expert")
+    mesh = ddp.make_mesh(("data", "expert"), shape=(2, 4))
+    model_ep = TransformerLM(cfg_ep)
+    rng = np.random.default_rng(9)
+    tokens = rng.integers(0, 256, size=(4, 33)).astype(np.int32)
+    params = TransformerLM(cfg).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 32), jnp.int32)
+    )["params"]
+
+    def loss_fn(p, batch, rng):
+        toks = batch["tokens"]
+        logits = model_ep.apply({"params": p}, toks[:, :-1])
+        return lm_cross_entropy(logits, toks[:, 1:]), {}
+
+    def run(accum):
+        state = ddp.TrainState.create(
+            apply_fn=model_ep.apply, params=params, tx=optax.sgd(0.1)
+        )
+        state = ddp.shard_state_ep(state, mesh)
+        step = ddp.make_train_step(
+            loss_fn, mesh=mesh, ep_axis="expert", accum_steps=accum,
+            donate=False,
+        )
+        state, m = step(
+            state, shard_batch({"tokens": tokens}, mesh), jax.random.PRNGKey(0)
+        )
+        return float(m["loss"]), state.params
+
+    l1, p1 = run(1)
+    l2, p2 = run(2)
+    assert l1 == pytest.approx(l2, rel=1e-6)
+    for a, b in zip(jax.tree.leaves(p1), jax.tree.leaves(p2)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)

@@ -211,3 +211,42 @@ def test_dp_cp_tp_train_step_matches_single_device(devices):
         jax.tree.leaves(state.params), jax.tree.leaves(params_ref)
     ):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
+
+
+def test_tp_accum_matches_plain_tp(devices):
+    """TP x gradient accumulation: 2 microbatches == single TP step on
+    the same global batch."""
+    mesh = ddp.make_mesh(("data", "model"), shape=(2, 4))
+    cfg, cfg_tp = _cfgs()
+    model_tp = TransformerLM(cfg_tp)
+    rng = np.random.default_rng(7)
+    tokens = rng.integers(0, 256, size=(4, 17)).astype(np.int32)
+    params = TransformerLM(cfg).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 16), jnp.int32)
+    )["params"]
+
+    def loss_fn(p, batch, rng):
+        toks = batch["tokens"]
+        logits = model_tp.apply({"params": p}, toks[:, :-1])
+        return lm_cross_entropy(logits, toks[:, 1:]), {}
+
+    def run(accum):
+        tx = optax.sgd(0.1)
+        state = ddp.TrainState.create(
+            apply_fn=model_tp.apply, params=params, tx=tx
+        )
+        state = ddp.shard_state_tp(state, mesh)
+        step = ddp.make_train_step(
+            loss_fn, mesh=mesh, tp_axis="model", accum_steps=accum,
+            donate=False,
+        )
+        state, m = step(
+            state, shard_batch({"tokens": tokens}, mesh), jax.random.PRNGKey(0)
+        )
+        return float(m["loss"]), state.params
+
+    l1, p1 = run(1)
+    l2, p2 = run(2)
+    assert l1 == pytest.approx(l2, rel=1e-6)
+    for a, b in zip(jax.tree.leaves(p1), jax.tree.leaves(p2)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
