@@ -45,19 +45,24 @@ Pytree = Any
 
 
 def pp_param_specs(
-    tree: Pytree, axis_name: str = "pipe", tp_axis: str | None = None
+    tree: Pytree,
+    axis_name: str = "pipe",
+    tp_axis: str | None = None,
+    ep_axis: str | None = None,
 ) -> Pytree:
     """Spec tree: any leaf under a ``layers`` path component shards its
     LEADING (stacked-layer) dim over the pipe axis; everything else is
     replicated.  Works for optimizer state too (optax trees embed the
     param paths).
 
-    With ``tp_axis`` the Megatron trailing-dim rules compose underneath:
-    a stacked q_proj kernel becomes e.g. ``P('pipe', None, 'model',
-    None)`` — stages over the pipe axis, heads over the model axis.
+    With ``tp_axis``/``ep_axis`` the Megatron / expert trailing-dim rules
+    compose underneath (disjoint leaf sets): a stacked q_proj kernel
+    becomes e.g. ``P('pipe', None, 'model', None)``, a stacked expert
+    weight ``P('pipe', 'expert', None, None)``.
     """
-    from distributeddataparallel_tpu.parallel.tensor_parallel import (
-        _spec_for_path,
+    from distributeddataparallel_tpu.parallel import (
+        expert_parallel,
+        tensor_parallel,
     )
 
     flat = jax.tree_util.tree_flatten_with_path(tree)[0]
@@ -69,12 +74,18 @@ def pp_param_specs(
         )
         if "layers" in names and getattr(leaf, "ndim", 0) >= 1:
             trailing = (None,) * (leaf.ndim - 1)
-            if tp_axis is not None:
-                tp = _spec_for_path(names, leaf, tp_axis)
-                if any(tp):
-                    # Right-aligned TP partition of the trailing dims
-                    # (the leading dim is the stacked layer axis).
-                    trailing = tuple(tp)[-(leaf.ndim - 1):]
+            for axis, rule in (
+                (tp_axis, tensor_parallel._spec_for_path),
+                (ep_axis, expert_parallel._spec_for_path),
+            ):
+                if axis is None:
+                    continue
+                inner = rule(names, leaf, axis)
+                if any(inner):
+                    # Right-aligned partition of the trailing dims (the
+                    # leading dim is the stacked layer axis).
+                    trailing = tuple(inner)[-(leaf.ndim - 1):]
+                    break
             specs.append(P(*((axis_name,) + trailing)))
         else:
             specs.append(P())
@@ -82,24 +93,33 @@ def pp_param_specs(
 
 
 def pp_state_specs(
-    state, axis_name: str = "pipe", tp_axis: str | None = None
+    state,
+    axis_name: str = "pipe",
+    tp_axis: str | None = None,
+    ep_axis: str | None = None,
 ) -> Pytree:
     """Spec tree for a whole TrainState under PP (single source for both
     placement and the step's shard_map in_specs)."""
     return state.replace(
         step=P(),
-        params=pp_param_specs(state.params, axis_name, tp_axis),
-        opt_state=pp_param_specs(state.opt_state, axis_name, tp_axis),
+        params=pp_param_specs(state.params, axis_name, tp_axis, ep_axis),
+        opt_state=pp_param_specs(state.opt_state, axis_name, tp_axis, ep_axis),
         model_state=jax.tree.map(lambda _: P(), state.model_state),
     )
 
 
 def shard_state_pp(
-    state, mesh: Mesh, axis_name: str = "pipe", tp_axis: str | None = None
+    state,
+    mesh: Mesh,
+    axis_name: str = "pipe",
+    tp_axis: str | None = None,
+    ep_axis: str | None = None,
 ):
     """Place a full TrainState with the stacked layer dim sharded over the
     pipe axis (the PP analog of ``broadcast_params``)."""
     n = mesh.shape[axis_name]
+    from distributeddataparallel_tpu.parallel import expert_parallel
+
     for path, leaf in jax.tree_util.tree_flatten_with_path(state.params)[0]:
         names = tuple(str(getattr(k, "key", k)) for k in path)
         if "layers" in names and leaf.shape[0] % n:
@@ -107,10 +127,21 @@ def shard_state_pp(
                 f"pipeline: stacked layer dim {leaf.shape[0]} of param "
                 f"{'/'.join(names)} is not divisible by {n} stages"
             )
+        if ep_axis is not None:
+            n_ep = mesh.shape[ep_axis]
+            spec = expert_parallel._spec_for_path(names, leaf, ep_axis)
+            for dim, name in enumerate(spec):
+                if name == ep_axis and leaf.shape[dim] % n_ep:
+                    raise ValueError(
+                        f"EP degree {n_ep} does not divide dim {dim} of "
+                        f"param {'/'.join(names)} (shape {leaf.shape}) — "
+                        f"moe_experts must be divisible by the expert-axis "
+                        f"size"
+                    )
     return jax.tree.map(
         lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
         state,
-        pp_state_specs(state, axis_name, tp_axis),
+        pp_state_specs(state, axis_name, tp_axis, ep_axis),
     )
 
 
@@ -180,6 +211,7 @@ def make_pp_train_step(
     pp_axis: str = "pipe",
     donate: bool = True,
     grad_sync: bool = True,
+    moe_aux_weight: float = 0.01,
 ):
     """Compiled DP x PP train step for a scanned TransformerLM config.
 
@@ -253,15 +285,26 @@ def make_pp_train_step(
         )
         layer_shard = params["layers"]
 
+        use_aux = cfg.moe_experts > 0 and moe_aux_weight > 0.0
+
         def run_stage(x):
+            if use_aux:
+                (y, _), col = stack.apply(
+                    {"params": layer_shard}, x, positions, rope, True,
+                    mutable=["intermediates"],
+                )
+                terms = jax.tree.leaves(col)
+                tick_aux = sum(jnp.mean(a) for a in terms) / max(len(terms), 1)
+                return y, tick_aux
             y, _ = stack.apply(
                 {"params": layer_shard}, x, positions, rope, True
             )
-            return y
+            return y, jnp.zeros((), jnp.float32)
 
         perm = [(i, (i + 1) % n) for i in range(n)]
         buf = jnp.zeros((mb_rows, S, cfg.d_model), cfg.dtype)
         acc = jnp.zeros((), jnp.float32)
+        aux_acc = jnp.zeros((), jnp.float32)
         # Static GPipe schedule: M + n - 1 ticks.  Every stage computes
         # every tick (SPMD); bubble results are masked out of the loss,
         # so their gradients vanish and AD reconstructs the reverse
@@ -269,7 +312,12 @@ def make_pp_train_step(
         for t in range(M + n - 1):
             x0 = _embed(cfg, params, mbs_in[min(t, M - 1)], positions)
             x = jnp.where(s == 0, x0, buf)
-            y = run_stage(x)
+            y, tick_aux = run_stage(x)
+            if use_aux:
+                # Count only ticks where this stage processed a REAL
+                # microbatch (stage s holds microbatch t - s).
+                valid = jnp.logical_and(t - s >= 0, t - s < M)
+                aux_acc = aux_acc + jnp.where(valid, tick_aux, 0.0)
             buf = lax.ppermute(y, pp_axis, perm)
             out_idx = t - (n - 1)
             if out_idx < 0:
@@ -287,7 +335,16 @@ def make_pp_train_step(
             reduce_from_tp,
         )
 
-        return reduce_from_tp(acc, pp_axis) / M
+        loss = reduce_from_tp(acc, pp_axis) / M
+        if use_aux:
+            # Each stage accumulated its own layer slice's aux over its M
+            # real ticks; the pipe psum completes the layer sum.  Mean
+            # over stages x microbatches keeps the weight comparable to
+            # the non-PP MoE loss.
+            loss = loss + moe_aux_weight * (
+                reduce_from_tp(aux_acc, pp_axis) / (n * M)
+            )
+        return loss
 
     def _step(state, batch, rng):
         if cfg.cp_axis is not None:
@@ -300,7 +357,7 @@ def make_pp_train_step(
         )
         # Complete replicated-param grads over the pipe (only the stages
         # that use them contributed); layer-slice grads stay local.
-        gspecs = pp_param_specs(grads, pp_axis, cfg.tp_axis)
+        gspecs = pp_param_specs(grads, pp_axis, cfg.tp_axis, cfg.ep_axis)
         grads = jax.tree.map(
             lambda g, sp: g if any(sp) else lax.psum(g, pp_axis),
             grads,
@@ -332,7 +389,7 @@ def make_pp_train_step(
     def step(state, batch, rng):
         nonlocal compiled
         if compiled is None:
-            specs = pp_state_specs(state, pp_axis, cfg.tp_axis)
+            specs = pp_state_specs(state, pp_axis, cfg.tp_axis, cfg.ep_axis)
             sharded = jax.shard_map(
                 _step,
                 mesh=mesh,

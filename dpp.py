@@ -230,10 +230,12 @@ def validate_args(args) -> None:
                 f"--moe-experts {args.moe_experts} must be divisible by "
                 f"--ep {args.ep}"
             )
-        if args.cp > 1 or args.pp > 1 or args.zero:
+        if args.cp > 1 or args.zero:
             raise SystemExit(
-                "--ep composes with DP and --tp (no --cp/--pp/--zero yet)"
+                "--ep composes with DP, --tp, and --pp (no --cp/--zero yet)"
             )
+        if args.pp > 1 and args.tp > 1:
+            raise SystemExit("--ep with BOTH --pp and --tp is untested")
 
 
 def build_model(args, num_classes: int = 10, vocab_size: int | None = None):
@@ -407,9 +409,11 @@ def train(args) -> float:
             apply_fn=model.apply, params=params, tx=tx, model_state=model_state
         )
         # PP layout: the stacked layer dim sharded over the 'pipe' axis
-        # (plus Megatron trailing-dim sharding under --tp).
+        # (plus Megatron / expert trailing-dim sharding under --tp/--ep).
         state = ddp.shard_state_pp(
-            state, mesh, tp_axis="model" if args.tp > 1 else None
+            state, mesh,
+            tp_axis="model" if args.tp > 1 else None,
+            ep_axis="expert" if args.ep > 1 else None,
         )
     elif args.ep > 1:
         state = ddp.TrainState.create(
@@ -507,6 +511,7 @@ def train(args) -> float:
             )
         step_fn = ddp.make_pp_train_step(
             model.cfg, mesh=mesh, microbatches=M,
+            moe_aux_weight=args.moe_aux_weight if args.moe_experts else 0.0,
         )
     else:
         # One factory for the other compositions: DP × {accum, buckets,

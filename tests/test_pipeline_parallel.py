@@ -231,3 +231,50 @@ def test_cp_pp_tp_four_axis_mesh(devices):
         jax.tree.leaves(state.params), jax.tree.leaves(ref_params)
     ):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=3e-5)
+
+
+def test_dp_pp_ep_moe_matches_single_device(devices):
+    """DP(2) x PP(2) x EP(2): MoE blocks inside pipeline stages with the
+    expert dim sharded over its own axis — equal to single-device."""
+    cfg = _scan_cfg(moe_experts=4)
+    cfg_x = dataclasses.replace(cfg, ep_axis="expert")
+    mesh = ddp.make_mesh(("data", "pipe", "expert"), shape=(2, 2, 2))
+    params = TransformerLM(cfg).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 32), jnp.int32)
+    )["params"]
+    tx = optax.sgd(0.1)
+    rng = np.random.default_rng(8)
+    tokens = rng.integers(0, 256, size=(8, 33)).astype(np.int32)
+
+    ref_loss, ref_params = _reference_step(cfg, params, tokens, tx)
+
+    # aux weight 0: the reference is pure CE (aux equivalence is pinned
+    # separately below and in test_expert_parallel).
+    step = make_pp_train_step(
+        cfg_x, mesh=mesh, microbatches=2, donate=False, moe_aux_weight=0.0
+    )
+    state = ddp.TrainState.create(apply_fn=None, params=params, tx=tx)
+    state = shard_state_pp(state, mesh, ep_axis="expert")
+    batch = shard_batch({"tokens": tokens}, mesh)
+    state, metrics = step(state, batch, jax.random.PRNGKey(0))
+
+    assert float(metrics["loss"]) == pytest.approx(ref_loss, rel=1e-5)
+    for (path, a), b in zip(
+        jax.tree_util.tree_flatten_with_path(state.params)[0],
+        jax.tree.leaves(ref_params),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=2e-5,
+            err_msg="/".join(str(getattr(k, "key", k)) for k in path),
+        )
+
+    # With the aux ON, the loss gains a positive load-balance term (the
+    # switch aux is >= 1 at any routing) and still trains.
+    step_aux = make_pp_train_step(
+        cfg_x, mesh=mesh, microbatches=2, donate=False, moe_aux_weight=0.01
+    )
+    state2 = ddp.TrainState.create(apply_fn=None, params=params, tx=tx)
+    state2 = shard_state_pp(state2, mesh, ep_axis="expert")
+    state2, m2 = step_aux(state2, batch, jax.random.PRNGKey(0))
+    assert float(m2["loss"]) > ref_loss
+    assert float(m2["loss"]) == pytest.approx(ref_loss + 0.01 * 1.0, abs=0.05)
