@@ -137,6 +137,14 @@ def tiny_lm(**overrides) -> TransformerConfig:
     return TransformerConfig(**base)
 
 
+def moe_aux_from_intermediates(col) -> Any:
+    """Mean of the per-layer sown switch load-balance terms (sow wraps
+    each in a tuple; scan stacks them) — layer-count independent.  ONE
+    definition shared by every loss path (CP / plain LM / pipeline)."""
+    terms = jax.tree.leaves(col)
+    return sum(jnp.mean(t) for t in terms) / max(len(terms), 1)
+
+
 class RMSNorm(nn.Module):
     """Llama-style RMS normalization; stats in f32, scale param f32."""
 

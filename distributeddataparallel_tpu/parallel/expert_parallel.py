@@ -67,11 +67,11 @@ def ep_state_specs(state, axis_name: str = "expert") -> Pytree:
     )
 
 
-def shard_state_ep(state, mesh: Mesh, axis_name: str = "expert"):
-    """Place a full TrainState with expert stacks sharded over the expert
-    axis (the EP analog of ``broadcast_params``)."""
+def check_ep_divisibility(params: Pytree, mesh: Mesh, axis_name: str) -> None:
+    """Clear error when the expert-axis size does not divide an expert
+    stack — shared by every EP-aware placement (plain EP and PP x EP)."""
     n = mesh.shape[axis_name]
-    for path, leaf in jax.tree_util.tree_flatten_with_path(state.params)[0]:
+    for path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
         names = tuple(str(getattr(k, "key", k)) for k in path)
         spec = _spec_for_path(names, leaf, axis_name)
         for dim, name in enumerate(spec):
@@ -81,6 +81,12 @@ def shard_state_ep(state, mesh: Mesh, axis_name: str = "expert"):
                     f"{'/'.join(names)} (shape {leaf.shape}) — "
                     f"moe_experts must be divisible by the expert-axis size"
                 )
+
+
+def shard_state_ep(state, mesh: Mesh, axis_name: str = "expert"):
+    """Place a full TrainState with expert stacks sharded over the expert
+    axis (the EP analog of ``broadcast_params``)."""
+    check_ep_divisibility(state.params, mesh, axis_name)
     return jax.tree.map(
         lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
         state,

@@ -118,8 +118,6 @@ def shard_state_pp(
     """Place a full TrainState with the stacked layer dim sharded over the
     pipe axis (the PP analog of ``broadcast_params``)."""
     n = mesh.shape[axis_name]
-    from distributeddataparallel_tpu.parallel import expert_parallel
-
     for path, leaf in jax.tree_util.tree_flatten_with_path(state.params)[0]:
         names = tuple(str(getattr(k, "key", k)) for k in path)
         if "layers" in names and leaf.shape[0] % n:
@@ -127,17 +125,12 @@ def shard_state_pp(
                 f"pipeline: stacked layer dim {leaf.shape[0]} of param "
                 f"{'/'.join(names)} is not divisible by {n} stages"
             )
-        if ep_axis is not None:
-            n_ep = mesh.shape[ep_axis]
-            spec = expert_parallel._spec_for_path(names, leaf, ep_axis)
-            for dim, name in enumerate(spec):
-                if name == ep_axis and leaf.shape[dim] % n_ep:
-                    raise ValueError(
-                        f"EP degree {n_ep} does not divide dim {dim} of "
-                        f"param {'/'.join(names)} (shape {leaf.shape}) — "
-                        f"moe_experts must be divisible by the expert-axis "
-                        f"size"
-                    )
+    if ep_axis is not None:
+        from distributeddataparallel_tpu.parallel.expert_parallel import (
+            check_ep_divisibility,
+        )
+
+        check_ep_divisibility(state.params, mesh, ep_axis)
     return jax.tree.map(
         lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
         state,
@@ -293,9 +286,11 @@ def make_pp_train_step(
                     {"params": layer_shard}, x, positions, rope, True,
                     mutable=["intermediates"],
                 )
-                terms = jax.tree.leaves(col)
-                tick_aux = sum(jnp.mean(a) for a in terms) / max(len(terms), 1)
-                return y, tick_aux
+                from distributeddataparallel_tpu.models.transformer import (
+                    moe_aux_from_intermediates,
+                )
+
+                return y, moe_aux_from_intermediates(col)
             y, _ = stack.apply(
                 {"params": layer_shard}, x, positions, rope, True
             )

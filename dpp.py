@@ -230,12 +230,14 @@ def validate_args(args) -> None:
                 f"--moe-experts {args.moe_experts} must be divisible by "
                 f"--ep {args.ep}"
             )
-        if args.cp > 1 or args.zero:
-            raise SystemExit(
-                "--ep composes with DP, --tp, and --pp (no --cp/--zero yet)"
-            )
+        if args.zero:
+            raise SystemExit("--ep does not compose with --zero")
         if args.pp > 1 and args.tp > 1:
             raise SystemExit("--ep with BOTH --pp and --tp is untested")
+        if args.cp > 1 and (args.pp > 1 or args.tp > 1):
+            raise SystemExit(
+                "--ep with --cp composes pairwise only (no extra --pp/--tp)"
+            )
 
 
 def build_model(args, num_classes: int = 10, vocab_size: int | None = None):
@@ -447,24 +449,47 @@ def train(args) -> float:
     if cp:
         from distributeddataparallel_tpu.ops import lm_cross_entropy
 
-        def loss_fn(params, batch, rng):
-            logits = model.apply({"params": params}, batch["inputs"])
-            loss = lm_cross_entropy(logits, batch["targets"])
-            return loss, {"accuracy": accuracy(logits, batch["targets"])}
+        if args.moe_experts and args.moe_aux_weight > 0:
+            from distributeddataparallel_tpu.models.transformer import (
+                moe_aux_from_intermediates,
+            )
+
+            def loss_fn(params, batch, rng):
+                logits, col = model.apply(
+                    {"params": params}, batch["inputs"],
+                    mutable=["intermediates"],
+                )
+                aux = moe_aux_from_intermediates(col)
+                loss = (
+                    lm_cross_entropy(logits, batch["targets"])
+                    + args.moe_aux_weight * aux
+                )
+                return loss, {
+                    "accuracy": accuracy(logits, batch["targets"]),
+                    "moe_aux": aux,
+                }
+        else:
+            def loss_fn(params, batch, rng):
+                logits = model.apply({"params": params}, batch["inputs"])
+                loss = lm_cross_entropy(logits, batch["targets"])
+                return loss, {
+                    "accuracy": accuracy(logits, batch["targets"])
+                }
     elif lm:
         from distributeddataparallel_tpu.ops import lm_cross_entropy
 
-        if args.moe_experts:
+        if args.moe_experts and args.moe_aux_weight > 0:
+            from distributeddataparallel_tpu.models.transformer import (
+                moe_aux_from_intermediates,
+            )
+
             def loss_fn(params, batch, rng):
                 toks = batch["tokens"]
                 logits, col = model.apply(
                     {"params": params}, toks[:, :-1],
                     mutable=["intermediates"],
                 )
-                # Mean of the per-layer sown aux terms (sow wraps each in
-                # a tuple; scan stacks them) — layer-count independent.
-                terms = jax.tree.leaves(col)
-                aux = sum(jnp.mean(t) for t in terms) / max(len(terms), 1)
+                aux = moe_aux_from_intermediates(col)
                 loss = (
                     lm_cross_entropy(logits, toks[:, 1:])
                     + args.moe_aux_weight * aux
