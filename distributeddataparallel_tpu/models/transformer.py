@@ -73,9 +73,14 @@ class TransformerConfig:
     use_bias: bool = True            # proj biases: GPT-2 yes, Llama no
     # Context parallelism: name of the mesh axis the sequence dimension is
     # sharded over.  When set, the model must run inside shard_map with
-    # that axis bound; attention becomes ring attention over the axis and
-    # positions default to each shard's global offsets.
+    # that axis bound; attention becomes collective over the axis and
+    # positions default to each shard's global offsets.  ``cp_impl``
+    # picks the collective: "ring" (blockwise ppermute ring — memory
+    # O(S/N), scales past the head count) or "ulysses" (two all_to_alls
+    # to a head-sharded layout — local attention sees the full sequence
+    # and can use the Pallas flash kernel; requires num_heads % N == 0).
     cp_axis: str | None = None
+    cp_impl: str = "ring"            # "ring" | "ulysses"
     # Tensor parallelism: name of the mesh axis attention heads and MLP
     # hidden units are sharded over (Megatron column/row split, see
     # parallel.tensor_parallel).  When set, the model must run inside
@@ -237,7 +242,20 @@ class Attention(nn.Module):
             )
             q = apply_rope(q, cos, sin, positions=positions)
             k = apply_rope(k, cos, sin, positions=positions)
-        if cfg.cp_axis is not None:
+        if cfg.cp_axis is not None and cfg.cp_impl == "ulysses":
+            from distributeddataparallel_tpu.parallel.context_parallel import (
+                ulysses_attention,
+            )
+
+            # GQA-native: ulysses exchanges kv at its own head count when
+            # the axis divides it, expanding internally otherwise.
+            out = ulysses_attention(
+                q, k, v, axis_name=cfg.cp_axis, causal=True,
+                impl=cfg.attn_impl,
+            )
+        elif cfg.cp_axis is not None:
+            if cfg.cp_impl != "ring":
+                raise ValueError(f"unknown cp_impl {cfg.cp_impl!r}")
             from distributeddataparallel_tpu.parallel.context_parallel import (
                 ring_attention,
             )

@@ -10,6 +10,7 @@ from distributeddataparallel_tpu.parallel.context_parallel import (  # noqa: F40
     make_cp_eval_step,
     make_cp_train_step,
     ring_attention,
+    ulysses_attention,
 )
 from distributeddataparallel_tpu.parallel.zero import zero_state  # noqa: F401
 from distributeddataparallel_tpu.parallel.tensor_parallel import (  # noqa: F401

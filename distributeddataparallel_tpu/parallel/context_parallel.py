@@ -110,6 +110,79 @@ def ring_attention(
     return out.astype(q.dtype)
 
 
+def ulysses_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    axis_name: str,
+    causal: bool = True,
+    impl: str = "auto",
+) -> jnp.ndarray:
+    """All-to-all (DeepSpeed-Ulysses-style) sequence-parallel attention.
+
+    The dual of ``ring_attention`` over the same sequence-sharded layout
+    (local shards (B, S/N, H, D), global sequence = shards in axis
+    order), trading N-1 ``ppermute`` hops for two ``all_to_all``s:
+
+    1. all-to-all scatters the HEAD dim and gathers the SEQUENCE dim —
+       each device now holds ALL tokens for H/N of the heads;
+    2. ordinary full-sequence attention runs locally per head group —
+       on TPU this is the framework's own Pallas flash kernel
+       (``ops.attention.attention``), which the ring path cannot use
+       because no device ever sees the whole sequence;
+    3. the inverse all-to-all restores the sequence-sharded layout.
+
+    Trade-offs vs the ring: communication is 2 all-to-alls of the
+    activations regardless of N (the ring moves the whole KV cache N-1
+    times, overlapped), but parallelism is capped at the head count
+    (H % N == 0).  GQA: when the kv head count divides N too, kv travels
+    at its own (smaller) head count and the local attention consumes it
+    natively; otherwise kv heads are expanded before the exchange.
+
+    Must run inside ``shard_map`` with ``axis_name`` bound.  RoPE /
+    positional lookups happen BEFORE this op with global positions
+    (``cp_positions``), exactly as for the ring path.
+    """
+    n = lax.psum(1, axis_name)
+    B, S, H, D = q.shape
+    Hkv = k.shape[2]
+    if H % n:
+        raise ValueError(
+            f"ulysses requires num_heads % axis size == 0, got {H} % {n}"
+        )
+    if Hkv % n:
+        # GQA with a kv head count the axis doesn't divide: replicate kv
+        # heads to lcm(Hkv, n) — the smallest count the all_to_all can
+        # split — not all the way to H.  rep always divides the GQA group
+        # size (H % n == 0 forces it), so the local attention still sees
+        # a valid grouped layout, and q-head j keeps mapping to its
+        # original kv head j // (H/Hkv).
+        import math
+
+        from distributeddataparallel_tpu.ops.attention import repeat_kv
+
+        rep = n // math.gcd(Hkv, n)
+        assert H % (Hkv * rep) == 0, (H, Hkv, n)
+        k = repeat_kv(k, rep)
+        v = repeat_kv(v, rep)
+    # Scatter heads / gather sequence: (B, S/N, H, D) -> (B, S, H/N, D).
+    # Received shards concatenate in axis order, so the gathered sequence
+    # is in global order and q-head block j pairs with kv-head block j
+    # (head groups stay contiguous because H/N is a multiple of the GQA
+    # group size whenever Hkv % N == 0).
+    from distributeddataparallel_tpu.ops.attention import attention
+
+    a2a = lambda x: lax.all_to_all(
+        x, axis_name, split_axis=2, concat_axis=1, tiled=True
+    )
+    out = attention(a2a(q), a2a(k), a2a(v), causal=causal, impl=impl)
+    # Inverse: scatter sequence / gather heads -> (B, S/N, H, D).
+    return lax.all_to_all(
+        out, axis_name, split_axis=1, concat_axis=2, tiled=True
+    )
+
+
 def cp_positions(seq_len_local: int, axis_name: str) -> jnp.ndarray:
     """Global token positions of this device's sequence shard (for RoPE /
     learned positional lookups inside shard_map)."""

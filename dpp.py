@@ -59,7 +59,13 @@ def parse_args(argv=None):
                    help="background input-pipeline threads (0 = inline)")
     p.add_argument("--cp", type=int, default=1,
                    help="context-parallel degree: shard the sequence over "
-                        "a 'seq' mesh axis with ring attention (LM only)")
+                        "a 'seq' mesh axis with collective attention (LM only)")
+    p.add_argument("--cp-impl", choices=["ring", "ulysses"], default="ring",
+                   help="sequence-parallel attention collective: 'ring' "
+                        "(blockwise ppermute ring, O(S/N) memory) or "
+                        "'ulysses' (all_to_all to head-sharded layout; "
+                        "local flash attention over the full sequence, "
+                        "needs num_heads %% cp == 0)")
     p.add_argument("--tp", type=int, default=1,
                    help="tensor-parallel degree: Megatron column/row "
                         "sharding of attention heads + MLP hidden over a "
@@ -263,6 +269,7 @@ def build_model(args, num_classes: int = 10, vocab_size: int | None = None):
         )
         if args.cp > 1:
             overrides["cp_axis"] = "seq"
+            overrides["cp_impl"] = args.cp_impl
         if args.tp > 1:
             overrides["tp_axis"] = "model"
         if args.pp > 1:
@@ -446,8 +453,18 @@ def train(args) -> float:
         )
         state = ddp.broadcast_params(state, mesh)   # DDP ctor broadcast analog
 
-    if cp:
+    if lm:
         from distributeddataparallel_tpu.ops import lm_cross_entropy
+
+        # CP batches arrive pre-split (the next-token shift crosses shard
+        # boundaries, so the host does it — see shard_lm_batch); plain LM
+        # batches carry raw tokens and shift here.
+        if cp:
+            extract = lambda batch: (batch["inputs"], batch["targets"])
+        else:
+            extract = lambda batch: (
+                batch["tokens"][:, :-1], batch["tokens"][:, 1:]
+            )
 
         if args.moe_experts and args.moe_aux_weight > 0:
             from distributeddataparallel_tpu.models.transformer import (
@@ -455,55 +472,25 @@ def train(args) -> float:
             )
 
             def loss_fn(params, batch, rng):
+                inputs, targets = extract(batch)
                 logits, col = model.apply(
-                    {"params": params}, batch["inputs"],
-                    mutable=["intermediates"],
+                    {"params": params}, inputs, mutable=["intermediates"],
                 )
                 aux = moe_aux_from_intermediates(col)
                 loss = (
-                    lm_cross_entropy(logits, batch["targets"])
+                    lm_cross_entropy(logits, targets)
                     + args.moe_aux_weight * aux
                 )
                 return loss, {
-                    "accuracy": accuracy(logits, batch["targets"]),
+                    "accuracy": accuracy(logits, targets),
                     "moe_aux": aux,
                 }
         else:
             def loss_fn(params, batch, rng):
-                logits = model.apply({"params": params}, batch["inputs"])
-                loss = lm_cross_entropy(logits, batch["targets"])
-                return loss, {
-                    "accuracy": accuracy(logits, batch["targets"])
-                }
-    elif lm:
-        from distributeddataparallel_tpu.ops import lm_cross_entropy
-
-        if args.moe_experts and args.moe_aux_weight > 0:
-            from distributeddataparallel_tpu.models.transformer import (
-                moe_aux_from_intermediates,
-            )
-
-            def loss_fn(params, batch, rng):
-                toks = batch["tokens"]
-                logits, col = model.apply(
-                    {"params": params}, toks[:, :-1],
-                    mutable=["intermediates"],
-                )
-                aux = moe_aux_from_intermediates(col)
-                loss = (
-                    lm_cross_entropy(logits, toks[:, 1:])
-                    + args.moe_aux_weight * aux
-                )
-                return loss, {
-                    "accuracy": accuracy(logits, toks[:, 1:]),
-                    "moe_aux": aux,
-                }
-        else:
-            def loss_fn(params, batch, rng):
-                toks = batch["tokens"]
-                logits = model.apply({"params": params}, toks[:, :-1])
-                loss = lm_cross_entropy(logits, toks[:, 1:])
-                return loss, {"accuracy": accuracy(logits, toks[:, 1:])}
+                inputs, targets = extract(batch)
+                logits = model.apply({"params": params}, inputs)
+                loss = lm_cross_entropy(logits, targets)
+                return loss, {"accuracy": accuracy(logits, targets)}
     elif has_ms:
         def loss_fn(params, ms, batch, rng):
             logits, new_vars = model.apply(

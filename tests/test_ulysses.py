@@ -1,0 +1,165 @@
+"""Ulysses (all-to-all head-sharded) sequence parallelism tests: numerics
+vs full attention (MHA + both GQA paths), LM forward parity, the DP×CP
+train-step equivalence with cp_impl="ulysses", and the head-divisibility
+guard.  Mirrors tests/test_context_parallel.py for the ring path."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import distributeddataparallel_tpu as ddp
+from distributeddataparallel_tpu.data import shard_lm_batch
+from distributeddataparallel_tpu.models import TransformerLM, tiny_lm
+from distributeddataparallel_tpu.ops import lm_cross_entropy
+from distributeddataparallel_tpu.ops.attention import (
+    dot_product_attention,
+    repeat_kv,
+)
+from distributeddataparallel_tpu.parallel import (
+    make_cp_train_step,
+    ulysses_attention,
+)
+
+
+def _ulysses_on_mesh(q, k, v, mesh, causal):
+    fn = jax.shard_map(
+        functools.partial(
+            ulysses_attention, axis_name="seq", causal=causal, impl="xla"
+        ),
+        mesh=mesh,
+        in_specs=(P(None, "seq"), P(None, "seq"), P(None, "seq")),
+        out_specs=P(None, "seq"),
+        check_vma=False,
+    )
+    return jax.jit(fn)(q, k, v)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ulysses_matches_full(causal, devices):
+    mesh = ddp.make_mesh(("seq",))  # 8-way: needs H % 8 == 0
+    B, S, H, D = 2, 64, 8, 8
+    key = jax.random.PRNGKey(0)
+    q, k, v = (
+        jax.random.normal(kk, (B, S, H, D))
+        for kk in jax.random.split(key, 3)
+    )
+    ref = dot_product_attention(q, k, v, causal=causal)
+    out = _ulysses_on_mesh(q, k, v, mesh, causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+@pytest.mark.parametrize("n_seq", [8, 4])
+def test_ulysses_gqa_expand_path(n_seq, devices):
+    """Hkv=2 does not divide the axis: kv heads are expanded to
+    lcm(Hkv, n) before the all_to_all — full expansion to H at n=8,
+    PARTIAL expansion (4 of 8 heads) at n=4."""
+    mesh = ddp.make_mesh(
+        ("seq",), shape=(n_seq,), devices=jax.devices()[:n_seq]
+    )
+    B, S, H, Hkv, D = 2, 64, 8, 2, 8
+    key = jax.random.PRNGKey(1)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (B, S, H, D))
+    k = jax.random.normal(kk, (B, S, Hkv, D))
+    v = jax.random.normal(kv, (B, S, Hkv, D))
+    ref = dot_product_attention(
+        q, repeat_kv(k, H // Hkv), repeat_kv(v, H // Hkv), causal=True
+    )
+    out = _ulysses_on_mesh(q, k, v, mesh, True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_ulysses_gqa_native_path(devices):
+    """Hkv=2 divides the 2-way axis: kv travels at its own head count and
+    the local attention consumes GQA natively."""
+    mesh = ddp.make_mesh(("seq",), shape=(2,), devices=jax.devices()[:2])
+    B, S, H, Hkv, D = 2, 32, 4, 2, 8
+    key = jax.random.PRNGKey(2)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (B, S, H, D))
+    k = jax.random.normal(kk, (B, S, Hkv, D))
+    v = jax.random.normal(kv, (B, S, Hkv, D))
+    ref = dot_product_attention(
+        q, repeat_kv(k, H // Hkv), repeat_kv(v, H // Hkv), causal=True
+    )
+    out = _ulysses_on_mesh(q, k, v, mesh, True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_ulysses_head_divisibility_guard(devices):
+    """num_heads % axis size != 0 must raise at trace time, not silently
+    misshard."""
+    mesh = ddp.make_mesh(("seq",))  # 8-way
+    B, S, H, D = 1, 64, 6, 8
+    x = jnp.zeros((B, S, H, D))
+    with pytest.raises(ValueError, match="num_heads"):
+        _ulysses_on_mesh(x, x, x, mesh, True)
+
+
+def test_ulysses_lm_forward_matches_single_device(devices):
+    """Sequence-sharded forward with cp_impl='ulysses' (all_to_all + global
+    RoPE positions) must reproduce the unsharded model's logits."""
+    mesh = ddp.make_mesh(("seq",), shape=(2,), devices=jax.devices()[:2])
+    cfg = tiny_lm(max_seq_len=64)
+    cfg_u = tiny_lm(max_seq_len=64, cp_axis="seq", cp_impl="ulysses")
+    model = TransformerLM(cfg)
+    model_u = TransformerLM(cfg_u)
+    toks = jax.random.randint(jax.random.PRNGKey(1), (2, 64), 0, 256)
+    params = model.init(jax.random.PRNGKey(0), toks)["params"]
+
+    ref = model.apply({"params": params}, toks)
+
+    fn = jax.shard_map(
+        lambda p, t: model_u.apply({"params": p}, t),
+        mesh=mesh,
+        in_specs=(P(), P(None, "seq")),
+        out_specs=P(None, "seq"),
+        check_vma=False,
+    )
+    out = jax.jit(fn)(params, toks)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-4)
+
+
+def test_ulysses_train_step_matches_single_device(devices):
+    """DP×CP(ulysses) (4 data × 2 seq) one train step == single-device
+    step on the same global batch: same loss, same updated params."""
+    mesh = ddp.make_mesh(("data", "seq"), shape=(4, 2))
+    cfg = tiny_lm(max_seq_len=32)
+    cfg_u = tiny_lm(max_seq_len=32, cp_axis="seq", cp_impl="ulysses")
+    model = TransformerLM(cfg)
+    model_u = TransformerLM(cfg_u)
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, 256, size=(8, 33)).astype(np.int32)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 32), jnp.int32)
+    )["params"]
+    tx = optax.sgd(0.1)
+
+    def ref_loss(p):
+        logits = model.apply({"params": p}, jnp.asarray(tokens[:, :-1]))
+        return lm_cross_entropy(logits, jnp.asarray(tokens[:, 1:]))
+
+    loss_ref, grads_ref = jax.value_and_grad(ref_loss)(params)
+    updates, _ = tx.update(grads_ref, tx.init(params), params)
+    params_ref = optax.apply_updates(params, updates)
+
+    def loss_fn(p, batch, rng):
+        logits = model_u.apply({"params": p}, batch["inputs"])
+        return lm_cross_entropy(logits, batch["targets"]), {}
+
+    state = ddp.TrainState.create(apply_fn=model_u.apply, params=params, tx=tx)
+    state = ddp.broadcast_params(state, mesh)
+    step = make_cp_train_step(loss_fn, mesh=mesh)
+    batch = shard_lm_batch(tokens, mesh)
+    state, metrics = step(state, batch, jax.random.PRNGKey(0))
+
+    assert float(metrics["loss"]) == pytest.approx(float(loss_ref), rel=1e-5)
+    for a, b in zip(
+        jax.tree.leaves(state.params), jax.tree.leaves(params_ref)
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
