@@ -71,21 +71,65 @@ def unflatten(flat: jnp.ndarray, like: Pytree) -> Pytree:
     return jax.tree.unflatten(treedef, out)
 
 
-def _leaf_spec(leaf, axis_name: str):
+def _leaf_spec(leaf, axis_name: str, tp_axis: str | None = None):
     """The ZeRO layout rule, in one place: vector state (flat momentum,
-    mu/nu chunks) is sharded along the data axis; scalars (step counts)
-    stay replicated."""
-    return P(axis_name) if getattr(leaf, "ndim", 0) >= 1 else P()
+    mu/nu chunks) is sharded along the data axis — jointly with the TP
+    axis when params are Megatron-sharded, since each model position
+    flattens a DIFFERENT local param shard; scalars (step counts) stay
+    replicated."""
+    if getattr(leaf, "ndim", 0) < 1:
+        return P()
+    if tp_axis is not None:
+        return P((axis_name, tp_axis))
+    return P(axis_name)
 
 
 def opt_state_specs(
-    tx: optax.GradientTransformation, chunk: int, axis_name: str = "data"
+    tx: optax.GradientTransformation,
+    chunk: int,
+    axis_name: str = "data",
+    tp_axis: str | None = None,
 ) -> Pytree:
     """PartitionSpec tree for a tx.init over a flat chunk."""
     shapes = jax.eval_shape(
         tx.init, jax.ShapeDtypeStruct((chunk,), jnp.float32)
     )
-    return jax.tree.map(lambda s: _leaf_spec(s, axis_name), shapes)
+    return jax.tree.map(lambda s: _leaf_spec(s, axis_name, tp_axis), shapes)
+
+
+def _param_specs(params: Pytree, tp_axis: str | None) -> Pytree:
+    """Param layout for the ZeRO machinery: replicated, or Megatron
+    (``tp_param_specs``) when composing with tensor parallelism — the ONE
+    spec source shared by init, state build, and the train step's
+    in_specs."""
+    if tp_axis is None:
+        return jax.tree.map(lambda _: P(), params)
+    from distributeddataparallel_tpu.parallel.tensor_parallel import (
+        tp_param_specs,
+    )
+
+    return tp_param_specs(params, tp_axis)
+
+
+def _local_chunk(
+    params: Pytree, param_specs: Pytree, mesh: Mesh, num_shards: int
+) -> int:
+    """Per-position flat chunk length when params are sharded by
+    ``param_specs`` (host-side mirror of what ``flat_size`` sees on local
+    shapes inside shard_map).  ``shard_shape`` raises on non-divisible
+    dims, so a bad layout fails here, loudly, not as a downstream
+    out_specs mismatch."""
+    import math
+
+    from jax.sharding import NamedSharding
+
+    total = sum(
+        math.prod(NamedSharding(mesh, spec).shard_shape(leaf.shape))
+        for leaf, spec in zip(
+            jax.tree.leaves(params), jax.tree.leaves(param_specs)
+        )
+    )
+    return -(-total // num_shards)
 
 
 def shard_opt_state(
@@ -93,30 +137,37 @@ def shard_opt_state(
     tx: optax.GradientTransformation,
     mesh: Mesh,
     axis_name: str = "data",
+    tp_axis: str | None = None,
 ) -> Pytree:
     """Initialize optimizer state sharded 1/N per mesh position.
 
     Each position runs ``tx.init`` on its own flat param chunk; vector
     state (momentum, mu/nu) therefore never exists fully replicated.
+    Under ``tp_axis`` the flattened vector is each position's LOCAL
+    Megatron shard, so the flat state is additionally sharded over the
+    model axis (ZeRO-1 composes with TP: state memory drops by
+    n_data × n_tp per chip).
     """
     n = mesh.shape[axis_name]
-    padded, chunk = flat_size(params, n)
+    pspecs = _param_specs(params, tp_axis)
+    chunk = _local_chunk(params, pspecs, mesh, n)
 
-    def init_shard(flat):
+    def init_shard(p):
+        padded_l, chunk_l = flat_size(p, n)  # local (traced) shapes
+        flat = flatten_f32(p, padded_l)
         idx = lax.axis_index(axis_name)
-        shard = lax.dynamic_slice(flat, (idx * chunk,), (chunk,))
-        return tx.init(shard)
+        return tx.init(lax.dynamic_slice(flat, (idx * chunk_l,), (chunk_l,)))
 
     fn = jax.jit(
         jax.shard_map(
             init_shard,
             mesh=mesh,
-            in_specs=P(),
-            out_specs=opt_state_specs(tx, chunk, axis_name),
+            in_specs=(pspecs,),
+            out_specs=opt_state_specs(tx, chunk, axis_name, tp_axis),
             check_vma=False,
         )
     )
-    return fn(flatten_f32(params, padded))
+    return fn(params)
 
 
 def zero_state(
@@ -126,19 +177,37 @@ def zero_state(
     tx: optax.GradientTransformation,
     mesh: Mesh,
     axis_name: str = "data",
+    tp_axis: str | None = None,
     model_state: Pytree | None = None,
 ):
     """Build a TrainState whose optimizer state is ZeRO-sharded.
 
     Drop-in replacement for ``TrainState.create`` when using
-    ``make_train_step(..., zero=True)``.
+    ``make_train_step(..., zero=True)``.  With ``tp_axis``, params are
+    placed in the Megatron layout (``tp_param_specs``) and the flat
+    optimizer state shards over BOTH axes — pass the same ``tp_axis`` to
+    ``make_train_step``.
     """
     from distributeddataparallel_tpu.training.state import TrainState
 
+    step = jnp.zeros((), jnp.int32)
+    if tp_axis is not None:
+        from jax.sharding import NamedSharding
+
+        params = jax.tree.map(
+            lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+            params,
+            _param_specs(params, tp_axis),
+        )
+        # Scalars ride the mesh replicated too: a checkpoint restore uses
+        # the template's shardings leaf-for-leaf, and a single-device
+        # committed step counter next to mesh-committed params would make
+        # the restored state unsteppable.
+        step = jax.device_put(step, NamedSharding(mesh, P()))
     return TrainState(
-        step=jnp.zeros((), jnp.int32),
+        step=step,
         params=params,
-        opt_state=shard_opt_state(params, tx, mesh, axis_name),
+        opt_state=shard_opt_state(params, tx, mesh, axis_name, tp_axis),
         model_state=model_state if model_state is not None else {},
         apply_fn=apply_fn,
         tx=tx,
@@ -180,15 +249,18 @@ def zero_update(
     return new_params, new_opt_state
 
 
-def state_specs(state, axis_name: str = "data") -> Pytree:
+def state_specs(
+    state, axis_name: str = "data", tp_axis: str | None = None
+) -> Pytree:
     """Per-leaf PartitionSpec tree for a ZeRO TrainState: everything
-    replicated except the flat (ndim>=1) optimizer-state vectors."""
+    replicated except the flat (ndim>=1) optimizer-state vectors — and,
+    under ``tp_axis``, the Megatron-sharded params."""
     opt_specs = jax.tree.map(
-        lambda l: _leaf_spec(l, axis_name), state.opt_state
+        lambda l: _leaf_spec(l, axis_name, tp_axis), state.opt_state
     )
     return state.replace(
         step=P(),
-        params=jax.tree.map(lambda _: P(), state.params),
+        params=_param_specs(state.params, tp_axis),
         opt_state=opt_specs,
         model_state=jax.tree.map(lambda _: P(), state.model_state),
     )

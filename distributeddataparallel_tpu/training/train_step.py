@@ -112,8 +112,12 @@ def make_train_step(
     to the copy/reduce operator pair inside the model, every gradient
     leaf comes out complete per position — sharded leaves as their local
     shard, replicated leaves identically everywhere — so the data-axis
-    sync needs no TP-awareness.  ``zero=True`` with TP is not supported
-    (the flat-chunk layout assumes replicated params).
+    sync needs no TP-awareness.  ``zero=True`` composes: the flat-chunk
+    machinery operates on each position's LOCAL param shard (uniform
+    along the data axis, identical flat offsets across model positions),
+    so elementwise updates keep replicated leaves in lockstep while
+    optimizer state shards n_data × n_tp ways; build the state with
+    ``zero_state(..., tp_axis=...)``.
 
     ``ep_axis`` adds expert parallelism for MoE configs
     (``parallel.expert_parallel``): expert weight stacks shard over the
@@ -126,10 +130,10 @@ def make_train_step(
     if not grad_sync and (zero or bucket_bytes is not None):
         raise ValueError("grad_sync=False skips the reduction entirely; "
                          "it does not compose with zero/bucket_bytes")
-    if zero and (tp_axis is not None or ep_axis is not None):
+    if zero and ep_axis is not None:
         raise ValueError(
-            "zero=True with tp_axis/ep_axis is not supported: ZeRO's "
-            "flat-chunk layout assumes replicated params"
+            "zero=True with ep_axis is not supported: the expert-stack "
+            "layout has not been validated against the flat-chunk update"
         )
     if buffer_sync not in ("mean", "broadcast"):
         # No "local" mode: model state is declared replicated (out_specs
@@ -312,7 +316,7 @@ def make_train_step(
                     state_specs,
                 )
 
-                specs = state_specs(state, axis_name)
+                specs = state_specs(state, axis_name, tp_axis)
             else:
                 from distributeddataparallel_tpu.parallel.expert_parallel import (
                     model_axes_state_specs,

@@ -203,11 +203,6 @@ def validate_args(args) -> None:
     if args.tp > 1:
         if not is_lm(args):
             raise SystemExit("--tp requires an LM model (--model gpt2|llama)")
-        if args.zero:
-            raise SystemExit(
-                "--tp with --zero is not supported (ZeRO assumes "
-                "replicated params)"
-            )
     if args.pp > 1:
         if not is_lm(args):
             raise SystemExit("--pp requires an LM model (--model gpt2|llama)")
@@ -407,10 +402,14 @@ def train(args) -> float:
 
     tx = optax.sgd(args.lr, momentum=args.momentum or None)  # ref dpp.py:41
     if args.zero:
-        params = ddp.broadcast_params(params, mesh)
+        # With --tp, zero_state places params in the Megatron layout
+        # itself and shards the flat opt state over BOTH axes.
+        if args.tp == 1:
+            params = ddp.broadcast_params(params, mesh)
         model_state = ddp.broadcast_params(model_state, mesh)
         state = ddp.zero_state(
             apply_fn=model.apply, params=params, tx=tx, mesh=mesh,
+            tp_axis="model" if args.tp > 1 else None,
             model_state=model_state,
         )
     elif args.pp > 1:

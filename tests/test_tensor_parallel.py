@@ -160,11 +160,13 @@ def test_dp_tp_scan_remat_gqa(devices):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
 
 
-def test_tp_zero_rejected(devices):
-    mesh = ddp.make_mesh(("data", "model"), shape=(4, 2))
-    with pytest.raises(ValueError, match="zero=True with tp_axis"):
+def test_ep_zero_rejected(devices):
+    """ZeRO now composes with TP (test_tp_zero_matches_plain_tp); the
+    expert-stack layout remains unvalidated and must still be refused."""
+    mesh = ddp.make_mesh(("data", "expert"), shape=(4, 2))
+    with pytest.raises(ValueError, match="zero=True with ep_axis"):
         ddp.make_train_step(
-            lambda p, b, r: (0.0, {}), mesh=mesh, tp_axis="model", zero=True
+            lambda p, b, r: (0.0, {}), mesh=mesh, ep_axis="expert", zero=True
         )
 
 
@@ -250,3 +252,113 @@ def test_tp_accum_matches_plain_tp(devices):
     assert l1 == pytest.approx(l2, rel=1e-6)
     for a, b in zip(jax.tree.leaves(p1), jax.tree.leaves(p2)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+
+def test_tp_zero_matches_plain_tp(devices):
+    """TP × ZeRO-1: the flat-chunk sharded update on each position's
+    LOCAL Megatron shard must reproduce the replicated-optimizer DP×TP
+    step exactly over two adam steps (params stay in lockstep because
+    flat offsets are identical across model positions)."""
+    mesh = ddp.make_mesh(("data", "model"), shape=(4, 2))
+    cfg, cfg_tp = _cfgs(num_kv_heads=2)
+    model_tp = TransformerLM(cfg_tp)
+    rng = np.random.default_rng(3)
+    batches = [
+        {"tokens": rng.integers(0, 256, size=(8, 17)).astype(np.int32)}
+        for _ in range(2)
+    ]
+    params = TransformerLM(cfg).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 16), jnp.int32)
+    )["params"]
+    tx = optax.adam(1e-2)
+
+    def loss_fn(p, batch, rng):
+        toks = batch["tokens"]
+        logits = model_tp.apply({"params": p}, toks[:, :-1])
+        return lm_cross_entropy(logits, toks[:, 1:]), {}
+
+    # Replicated-optimizer DP×TP baseline, two steps.
+    state = ddp.TrainState.create(apply_fn=model_tp.apply, params=params, tx=tx)
+    state = ddp.shard_state_tp(state, mesh)
+    step = ddp.make_train_step(
+        loss_fn, mesh=mesh, tp_axis="model", donate=False
+    )
+    for b in batches:
+        state, _ = step(state, shard_batch(b, mesh), jax.random.PRNGKey(0))
+
+    # ZeRO-1 × TP, same two steps.
+    zstate = ddp.zero_state(
+        apply_fn=model_tp.apply, params=params, tx=tx, mesh=mesh,
+        tp_axis="model",
+    )
+    zstep = ddp.make_train_step(
+        loss_fn, mesh=mesh, tp_axis="model", zero=True, donate=False
+    )
+    for b in batches:
+        zstate, _ = zstep(zstate, shard_batch(b, mesh), jax.random.PRNGKey(0))
+
+    # Flat opt state is sharded over BOTH axes: 8 positions × distinct
+    # chunks, none replicated.
+    mu = jax.tree.leaves(zstate.opt_state)
+    assert any(
+        l.sharding.spec == P(("data", "model")) for l in mu if l.ndim >= 1
+    ), [getattr(l, "sharding", None) for l in mu]
+
+    for (path, a), b in zip(
+        jax.tree_util.tree_flatten_with_path(state.params)[0],
+        jax.tree.leaves(zstate.params),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=2e-6,
+            err_msg="/".join(str(getattr(k, "key", k)) for k in path),
+        )
+
+
+def test_cp_tp_zero_matches_replicated(devices):
+    """DP(2) x CP(2) x TP(2) with ZeRO-1: the flat-chunk update on local
+    Megatron shards under sequence sharding must reproduce the
+    replicated-optimizer 3-D step exactly (adam, two steps)."""
+    from distributeddataparallel_tpu.data import shard_lm_batch
+    from distributeddataparallel_tpu.parallel import make_cp_train_step
+
+    mesh = ddp.make_mesh(("data", "seq", "model"), shape=(2, 2, 2))
+    cfg, _ = _cfgs(num_kv_heads=2)
+    cfg_xp = dataclasses.replace(cfg, cp_axis="seq", tp_axis="model")
+    model_xp = TransformerLM(cfg_xp)
+    rng = np.random.default_rng(7)
+    batches = [
+        rng.integers(0, 256, size=(4, 33)).astype(np.int32) for _ in range(2)
+    ]
+    params = TransformerLM(cfg).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 32), jnp.int32)
+    )["params"]
+    tx = optax.adam(1e-2)
+
+    def loss_fn(p, batch, rng):
+        logits = model_xp.apply({"params": p}, batch["inputs"])
+        return lm_cross_entropy(logits, batch["targets"]), {}
+
+    state = ddp.TrainState.create(apply_fn=model_xp.apply, params=params, tx=tx)
+    state = ddp.shard_state_tp(state, mesh)
+    step = make_cp_train_step(
+        loss_fn, mesh=mesh, tp_axis="model", donate=False
+    )
+    for t in batches:
+        state, _ = step(state, shard_lm_batch(t, mesh), jax.random.PRNGKey(0))
+
+    zstate = ddp.zero_state(
+        apply_fn=model_xp.apply, params=params, tx=tx, mesh=mesh,
+        tp_axis="model",
+    )
+    zstep = make_cp_train_step(
+        loss_fn, mesh=mesh, tp_axis="model", zero=True, donate=False
+    )
+    for t in batches:
+        zstate, _ = zstep(
+            zstate, shard_lm_batch(t, mesh), jax.random.PRNGKey(0)
+        )
+
+    for a, b in zip(
+        jax.tree.leaves(state.params), jax.tree.leaves(zstate.params)
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-6)
