@@ -13,3 +13,4 @@ from distributeddataparallel_tpu.models.transformer import (  # noqa: F401
     llama3_8b,
     tiny_lm,
 )
+from distributeddataparallel_tpu.models.generate import generate  # noqa: F401

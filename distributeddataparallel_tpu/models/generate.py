@@ -1,0 +1,154 @@
+"""Autoregressive generation with a KV cache (greedy / temperature /
+top-k sampling).
+
+The reference is a trainer only (ref dpp.py:27-57 — no inference path
+exists); this module completes the LM family's serving story the TPU
+way:
+
+- **Static shapes everywhere**: the per-layer KV caches are allocated at
+  ``max_seq_len`` up front (``TransformerConfig.decode`` attention), the
+  prompt is consumed in ONE prefill call (a big MXU-friendly batched
+  matmul, not token-by-token), and the decode loop is a ``lax.scan`` of
+  single-token applies — one compiled program for prefill, one for the
+  whole decode scan, no per-step retracing.
+- Positions are explicit: prefill passes ``arange(P)``, decode step t
+  passes the single global position ``P + t``; RoPE / learned positional
+  lookups and the cache-insert offset all derive from them.
+- Sampling runs in f32 on the final-position logits: greedy argmax when
+  ``temperature == 0``, else softmax sampling with optional top-k
+  truncation (``jax.random.categorical``).
+
+Works for both LM families (GPT-2 learned-positional MHA, Llama-style
+RoPE GQA — the cache stores kv heads at their own count) and for
+scanned-layer configs (caches stack along the scan dim).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def _sample(logits, rng, temperature: float, top_k: int | None):
+    """Next-token ids (B,) from final-position logits (B, V)."""
+    logits = logits.astype(jnp.float32)
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / temperature
+    if top_k is not None:
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]  # (B, 1)
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+
+
+def decode_model(model):
+    """The decode twin of a TransformerLM: same params, KV-cache
+    attention, remat off (the cache is mutable state remat can't replay).
+
+    Sharded-layout configs are rejected: TP/EP params are in the
+    Megatron/expert layout, which the (unsharded) decode apply cannot
+    consume — gather them to the replicated layout first.
+    """
+    from distributeddataparallel_tpu.models.transformer import TransformerLM
+
+    if model.cfg.tp_axis is not None or model.cfg.ep_axis is not None:
+        raise ValueError(
+            "generate() needs replicated params: tp_axis/ep_axis configs "
+            "hold sharded layouts the decode apply cannot consume"
+        )
+    cfg = dataclasses.replace(
+        model.cfg, decode=True, remat=False, cp_axis=None, dropout_rate=0.0
+    )
+    return TransformerLM(cfg)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnums=(0, 3),
+    static_argnames=("temperature", "top_k"),
+)
+def _generate_jit(
+    model, params, prompt, max_new_tokens, rng, *, temperature, top_k
+):
+    cfg = model.cfg
+    B, P = prompt.shape
+
+    # Cache allocation: init on a 1-token input (shapes depend only on B
+    # and cfg.max_seq_len), params discarded — the caller's are used.
+    cache = model.init(
+        jax.random.PRNGKey(0), prompt[:, :1],
+        positions=jnp.arange(1),
+    )["cache"]
+
+    # Prefill: the whole prompt in one apply; take the last position.
+    logits, upd = model.apply(
+        {"params": params, "cache": cache}, prompt,
+        positions=jnp.arange(P), mutable=["cache"],
+    )
+    rng, sub = jax.random.split(rng)
+    next_tok = _sample(
+        logits[:, -1], sub, temperature, top_k
+    )
+
+    def body(carry, t):
+        cache, tok, rng = carry
+        logits, upd = model.apply(
+            {"params": params, "cache": cache}, tok[:, None],
+            positions=t[None], mutable=["cache"],
+        )
+        rng, sub = jax.random.split(rng)
+        nxt = _sample(logits[:, -1], sub, temperature, top_k)
+        return (upd["cache"], nxt, rng), tok
+
+    # N - 1 decode steps: each emits its incoming carried token (step i's
+    # is the token at global position P + i) and samples the next; the
+    # final carry is token P + N - 1, so no apply is ever wasted.
+    (_, last, _), toks = jax.lax.scan(
+        body,
+        (upd["cache"], next_tok, rng),
+        P + jnp.arange(max_new_tokens - 1),
+    )
+    return jnp.concatenate([prompt, toks.T, last[:, None]], axis=1)
+
+
+def generate(
+    model,
+    params,
+    prompt: jnp.ndarray,
+    max_new_tokens: int,
+    *,
+    rng: jax.Array | None = None,
+    temperature: float = 0.0,
+    top_k: int | None = None,
+) -> jnp.ndarray:
+    """Generate ``max_new_tokens`` continuations of ``prompt`` (B, P).
+
+    ``model`` is any TransformerLM (training config is fine — its decode
+    twin is built internally); ``params`` are unchanged training params.
+    Returns (B, P + max_new_tokens) int32.  ``temperature=0`` is greedy;
+    otherwise pass ``rng`` for sampling (``top_k`` truncates first).
+
+    Total length must fit the positional tables:
+    ``P + max_new_tokens <= cfg.max_seq_len``.
+    """
+    B, P = prompt.shape
+    if max_new_tokens < 1:
+        raise ValueError("max_new_tokens must be >= 1")
+    if P + max_new_tokens > model.cfg.max_seq_len:
+        raise ValueError(
+            f"prompt {P} + max_new_tokens {max_new_tokens} exceeds "
+            f"max_seq_len {model.cfg.max_seq_len}"
+        )
+    if temperature < 0.0:
+        raise ValueError("temperature must be >= 0")
+    if temperature > 0.0 and rng is None:
+        raise ValueError("sampling (temperature > 0) requires rng")
+    dm = decode_model(model)
+    return _generate_jit(
+        dm, params, prompt.astype(jnp.int32), int(max_new_tokens),
+        rng if rng is not None else jax.random.PRNGKey(0),
+        temperature=float(temperature), top_k=top_k,
+    )

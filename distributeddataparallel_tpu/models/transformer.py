@@ -88,6 +88,13 @@ class TransformerConfig:
     # ``tp_param_specs``; unbound (init / direct apply) it degrades to
     # the full unsharded shapes.
     tp_axis: str | None = None
+    # Autoregressive decoding: attention layers keep a KV cache sized
+    # max_seq_len in the "cache" variable collection and attend against
+    # it.  The caller passes explicit global ``positions`` per apply
+    # (prefill: arange(P); decode: the single next position) and makes
+    # the collection mutable — see ``models.generate``.  Mutually
+    # exclusive with cp_axis (sequence-sharded training) and remat.
+    decode: bool = False
     # Mixture-of-experts: replace every block's MLP with `moe_experts`
     # switch-routed (top-1) expert MLPs.  `ep_axis` shards the expert
     # dimension over a mesh axis (parallel.expert_parallel) — each
@@ -242,7 +249,47 @@ class Attention(nn.Module):
             )
             q = apply_rope(q, cos, sin, positions=positions)
             k = apply_rope(k, cos, sin, positions=positions)
-        if cfg.cp_axis is not None and cfg.cp_impl == "ulysses":
+        if cfg.decode:
+            # KV-cache attention: insert this call's k/v at the caller's
+            # global positions, attend q against the whole cache with a
+            # positional mask (static shapes: the cache is always
+            # max_seq_len long; future slots sit behind NEG_INF).
+            if positions is None:
+                raise ValueError(
+                    "decode=True requires explicit positions "
+                    "(models.generate passes them)"
+                )
+            from distributeddataparallel_tpu.ops.attention import (
+                causal_mask_bias,
+                dot_product_attention,
+            )
+
+            pos = positions.reshape(-1)  # (S,) global token positions
+            ck = self.variable(
+                "cache", "cached_key", jnp.zeros,
+                (B, cfg.max_seq_len, Hkvl, D), k.dtype,
+            )
+            cv = self.variable(
+                "cache", "cached_value", jnp.zeros,
+                (B, cfg.max_seq_len, Hkvl, D), v.dtype,
+            )
+            ck.value = jax.lax.dynamic_update_slice(
+                ck.value, k, (0, pos[0], 0, 0)
+            )
+            cv.value = jax.lax.dynamic_update_slice(
+                cv.value, v, (0, pos[0], 0, 0)
+            )
+            kf = repeat_kv(ck.value, Hl // Hkvl)
+            vf = repeat_kv(cv.value, Hl // Hkvl)
+            # Positions are contiguous from pos[0] (the insert offset), so
+            # the cache mask is the ordinary causal bias at that q offset.
+            bias = causal_mask_bias(
+                S, cfg.max_seq_len, q_offset=pos[0]
+            )
+            out = dot_product_attention(
+                q, kf, vf, causal=False, bias=bias[None, None]
+            )
+        elif cfg.cp_axis is not None and cfg.cp_impl == "ulysses":
             from distributeddataparallel_tpu.parallel.context_parallel import (
                 ulysses_attention,
             )
@@ -470,8 +517,9 @@ def scanned_layer_cls(cfg: TransformerConfig, length: int | None = None):
         scan_block,
         # intermediates: MoE blocks sow their load-balance aux per layer;
         # stacked along the scan dim when the caller makes it mutable
-        # (a no-op for dense models / immutable applies).
-        variable_axes={"params": 0, "intermediates": 0},
+        # (a no-op for dense models / immutable applies).  cache: per-layer
+        # KV caches under decode, stacked the same way.
+        variable_axes={"params": 0, "intermediates": 0, "cache": 0},
         split_rngs={"params": True, "dropout": True},
         in_axes=(nn.broadcast, nn.broadcast, nn.broadcast),
         length=length if length is not None else cfg.num_layers,
@@ -510,6 +558,11 @@ class TransformerLM(nn.Module):
     def __call__(self, tokens, *, positions=None, deterministic=True):
         cfg = self.cfg
         B, S = tokens.shape
+        if cfg.decode and (cfg.cp_axis is not None or cfg.remat):
+            # The KV cache is a mutable collection: remat can't replay it
+            # and sequence sharding has no cache layout; generate() builds
+            # a decode twin config with both off.
+            raise ValueError("decode does not compose with cp_axis/remat")
         # Under CP the model sees a local shard: the bound check must use
         # the GLOBAL length, or out-of-range RoPE/pos_embed lookups get
         # silently clamped by XLA's gather semantics instead of erroring.

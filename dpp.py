@@ -104,6 +104,10 @@ def parse_args(argv=None):
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--resume", action="store_true")
     p.add_argument("--eval", action="store_true", help="run eval after each epoch")
+    p.add_argument("--generate", type=int, default=0,
+                   help="after training, greedily generate N tokens from a "
+                        "training prompt via the KV-cache decode path "
+                        "(LM models with replicated params: plain DP/ZeRO)")
     p.add_argument("--profile-dir", default=None,
                    help="write a jax.profiler trace for epoch 0 here")
     p.add_argument("--bw-probe", action="store_true",
@@ -220,6 +224,13 @@ def validate_args(args) -> None:
         if args.layers and args.layers % args.pp:
             raise SystemExit(
                 f"--layers {args.layers} must be divisible by --pp {args.pp}"
+            )
+    if args.generate:
+        if not is_lm(args):
+            raise SystemExit("--generate requires an LM model")
+        if args.tp > 1 or args.pp > 1 or args.ep > 1:
+            raise SystemExit(
+                "--generate needs replicated params (no --tp/--pp/--ep)"
             )
     if args.moe_experts and not is_lm(args):
         raise SystemExit("--moe-experts requires an LM model")
@@ -710,6 +721,23 @@ def train(args) -> float:
         if eval_step is not None or ckpt is not None:
             # Don't let eval/checkpoint wall time pollute throughput.
             timer.reset()
+
+    if args.generate:
+        # Demo of the KV-cache decode path: greedily continue a training
+        # prompt with the trained params (models.generate).  Replicated
+        # params only (plain DP / ZeRO) — sharded-layout serving is not
+        # wired into the CLI.
+        import numpy as np
+
+        from distributeddataparallel_tpu.models import generate as _gen
+
+        prompt = jnp.asarray(
+            dataset.tokens[:2, : max(args.seq_len // 4, 1)], jnp.int32
+        )
+        n_new = min(args.generate, model.cfg.max_seq_len - prompt.shape[1])
+        out = _gen(model, state.params, prompt, n_new)
+        log0("generate: prompt %s -> %s (last 8 tokens: %s)",
+             prompt.shape, out.shape, np.asarray(out[0, -8:]).tolist())
 
     if ckpt is not None:
         ckpt.wait()
