@@ -52,6 +52,20 @@ def parse_args(argv=None):
                    help="per-replica batch (global = batch × replicas)")
     p.add_argument("--lr", type=float, default=0.01)         # ref dpp.py:41
     p.add_argument("--momentum", type=float, default=0.0)
+    p.add_argument("--optimizer", choices=["sgd", "adam", "adamw"],
+                   default="sgd",
+                   help="sgd mirrors the reference (ref dpp.py:41); "
+                        "adam/adamw for the LM configs")
+    p.add_argument("--weight-decay", type=float, default=0.0,
+                   help="decoupled weight decay (adamw; ignored otherwise)")
+    p.add_argument("--lr-schedule", choices=["constant", "cosine", "linear"],
+                   default="constant",
+                   help="learning-rate schedule over the whole run "
+                        "(optional --warmup-steps linear warmup first)")
+    p.add_argument("--warmup-steps", type=int, default=0,
+                   help="linear LR warmup steps before the schedule")
+    p.add_argument("--min-lr", type=float, default=0.0,
+                   help="floor the cosine/linear decay at this LR")
     p.add_argument("--seed", type=int, default=0)            # ref dpp.py:29
     p.add_argument("--accum-steps", type=int, default=1,
                    help="gradient accumulation (DDP no_sync analog)")
@@ -341,6 +355,42 @@ def build_dataset(args, train=True):
     )
 
 
+def build_optimizer(args, total_steps: int):
+    """Optimizer + LR schedule from flags.
+
+    The reference hardcodes ``optim.SGD(lr=0.01)`` (ref dpp.py:41,
+    SURVEY §2b optimizer row); ``--optimizer sgd`` with the default
+    constant schedule reproduces that.  adam/adamw + warmup-cosine are
+    the standard LM-config surface.  Schedule state is one scalar step
+    count, so every composition (ZeRO flat chunks included) carries it
+    unchanged.
+    """
+    import optax
+
+    if args.lr_schedule == "constant" and not args.warmup_steps:
+        lr = args.lr
+    else:
+        decay = max(total_steps - args.warmup_steps, 1)
+        if args.lr_schedule == "cosine":
+            sched = optax.cosine_decay_schedule(
+                args.lr, decay,
+                alpha=(args.min_lr / args.lr) if args.lr else 0.0,
+            )
+        elif args.lr_schedule == "linear":
+            sched = optax.linear_schedule(args.lr, args.min_lr, decay)
+        else:
+            sched = optax.constant_schedule(args.lr)
+        if args.warmup_steps:
+            warm = optax.linear_schedule(0.0, args.lr, args.warmup_steps)
+            sched = optax.join_schedules([warm, sched], [args.warmup_steps])
+        lr = sched
+    if args.optimizer == "sgd":
+        return optax.sgd(lr, momentum=args.momentum or None)
+    if args.optimizer == "adam":
+        return optax.adam(lr)
+    return optax.adamw(lr, weight_decay=args.weight_decay)
+
+
 def train(args) -> float:
     """Per-job trainer (analog of ref dpp.py:27-57). Returns final loss."""
     import jax
@@ -411,7 +461,10 @@ def train(args) -> float:
     model_state = {k: v for k, v in variables.items() if k != "params"}
     has_ms = bool(model_state)
 
-    tx = optax.sgd(args.lr, momentum=args.momentum or None)  # ref dpp.py:41
+    spe = loader.steps_per_epoch                         # ref dpp.py:41
+    if args.steps_per_epoch:
+        spe = min(spe, args.steps_per_epoch)
+    tx = build_optimizer(args, total_steps=max(spe * args.epochs, 1))
     if args.zero:
         # With --tp, zero_state places params in the Megatron layout
         # itself and shards the flat opt state over BOTH axes.
