@@ -71,17 +71,23 @@ def unflatten(flat: jnp.ndarray, like: Pytree) -> Pytree:
     return jax.tree.unflatten(treedef, out)
 
 
-def _leaf_spec(leaf, axis_name: str, tp_axis: str | None = None):
+def _leaf_spec(
+    leaf,
+    axis_name: str,
+    tp_axis: str | None = None,
+    ep_axis: str | None = None,
+):
     """The ZeRO layout rule, in one place: vector state (flat momentum,
-    mu/nu chunks) is sharded along the data axis — jointly with the TP
-    axis when params are Megatron-sharded, since each model position
-    flattens a DIFFERENT local param shard; scalars (step counts) stay
-    replicated."""
+    mu/nu chunks) is sharded along the data axis — jointly with any
+    model axes (Megatron TP / expert EP) when params are sharded over
+    them, since each model position flattens a DIFFERENT local param
+    shard; scalars (step counts) stay replicated."""
     if getattr(leaf, "ndim", 0) < 1:
         return P()
-    if tp_axis is not None:
-        return P((axis_name, tp_axis))
-    return P(axis_name)
+    axes = (axis_name,) + tuple(
+        a for a in (tp_axis, ep_axis) if a is not None
+    )
+    return P(axes if len(axes) > 1 else axis_name)
 
 
 def opt_state_specs(
@@ -89,26 +95,28 @@ def opt_state_specs(
     chunk: int,
     axis_name: str = "data",
     tp_axis: str | None = None,
+    ep_axis: str | None = None,
 ) -> Pytree:
     """PartitionSpec tree for a tx.init over a flat chunk."""
     shapes = jax.eval_shape(
         tx.init, jax.ShapeDtypeStruct((chunk,), jnp.float32)
     )
-    return jax.tree.map(lambda s: _leaf_spec(s, axis_name, tp_axis), shapes)
-
-
-def _param_specs(params: Pytree, tp_axis: str | None) -> Pytree:
-    """Param layout for the ZeRO machinery: replicated, or Megatron
-    (``tp_param_specs``) when composing with tensor parallelism — the ONE
-    spec source shared by init, state build, and the train step's
-    in_specs."""
-    if tp_axis is None:
-        return jax.tree.map(lambda _: P(), params)
-    from distributeddataparallel_tpu.parallel.tensor_parallel import (
-        tp_param_specs,
+    return jax.tree.map(
+        lambda s: _leaf_spec(s, axis_name, tp_axis, ep_axis), shapes
     )
 
-    return tp_param_specs(params, tp_axis)
+
+def _param_specs(
+    params: Pytree, tp_axis: str | None, ep_axis: str | None = None
+) -> Pytree:
+    """Param layout for the ZeRO machinery: replicated, or the combined
+    Megatron/expert layout when composing with TP/EP — the ONE spec
+    source shared by init, state build, and the train step's in_specs."""
+    from distributeddataparallel_tpu.parallel.expert_parallel import (
+        model_axes_param_specs,
+    )
+
+    return model_axes_param_specs(params, tp_axis, ep_axis)
 
 
 def _local_chunk(
@@ -138,18 +146,19 @@ def shard_opt_state(
     mesh: Mesh,
     axis_name: str = "data",
     tp_axis: str | None = None,
+    ep_axis: str | None = None,
 ) -> Pytree:
     """Initialize optimizer state sharded 1/N per mesh position.
 
     Each position runs ``tx.init`` on its own flat param chunk; vector
     state (momentum, mu/nu) therefore never exists fully replicated.
-    Under ``tp_axis`` the flattened vector is each position's LOCAL
-    Megatron shard, so the flat state is additionally sharded over the
-    model axis (ZeRO-1 composes with TP: state memory drops by
-    n_data × n_tp per chip).
+    Under ``tp_axis``/``ep_axis`` the flattened vector is each position's
+    LOCAL Megatron/expert shard, so the flat state is additionally
+    sharded over those model axes (state memory drops by the product of
+    all the axis sizes per chip).
     """
     n = mesh.shape[axis_name]
-    pspecs = _param_specs(params, tp_axis)
+    pspecs = _param_specs(params, tp_axis, ep_axis)
     chunk = _local_chunk(params, pspecs, mesh, n)
 
     def init_shard(p):
@@ -163,7 +172,7 @@ def shard_opt_state(
             init_shard,
             mesh=mesh,
             in_specs=(pspecs,),
-            out_specs=opt_state_specs(tx, chunk, axis_name, tp_axis),
+            out_specs=opt_state_specs(tx, chunk, axis_name, tp_axis, ep_axis),
             check_vma=False,
         )
     )
@@ -178,26 +187,27 @@ def zero_state(
     mesh: Mesh,
     axis_name: str = "data",
     tp_axis: str | None = None,
+    ep_axis: str | None = None,
     model_state: Pytree | None = None,
 ):
     """Build a TrainState whose optimizer state is ZeRO-sharded.
 
     Drop-in replacement for ``TrainState.create`` when using
-    ``make_train_step(..., zero=True)``.  With ``tp_axis``, params are
-    placed in the Megatron layout (``tp_param_specs``) and the flat
-    optimizer state shards over BOTH axes — pass the same ``tp_axis`` to
+    ``make_train_step(..., zero=True)``.  With ``tp_axis``/``ep_axis``,
+    params are placed in the Megatron/expert layout and the flat
+    optimizer state shards over ALL the axes — pass the same axes to
     ``make_train_step``.
     """
     from distributeddataparallel_tpu.training.state import TrainState
 
     step = jnp.zeros((), jnp.int32)
-    if tp_axis is not None:
+    if tp_axis is not None or ep_axis is not None:
         from jax.sharding import NamedSharding
 
         params = jax.tree.map(
             lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
             params,
-            _param_specs(params, tp_axis),
+            _param_specs(params, tp_axis, ep_axis),
         )
         # Scalars ride the mesh replicated too: a checkpoint restore uses
         # the template's shardings leaf-for-leaf, and a single-device
@@ -207,7 +217,9 @@ def zero_state(
     return TrainState(
         step=step,
         params=params,
-        opt_state=shard_opt_state(params, tx, mesh, axis_name, tp_axis),
+        opt_state=shard_opt_state(
+            params, tx, mesh, axis_name, tp_axis, ep_axis
+        ),
         model_state=model_state if model_state is not None else {},
         apply_fn=apply_fn,
         tx=tx,
@@ -250,17 +262,21 @@ def zero_update(
 
 
 def state_specs(
-    state, axis_name: str = "data", tp_axis: str | None = None
+    state,
+    axis_name: str = "data",
+    tp_axis: str | None = None,
+    ep_axis: str | None = None,
 ) -> Pytree:
     """Per-leaf PartitionSpec tree for a ZeRO TrainState: everything
     replicated except the flat (ndim>=1) optimizer-state vectors — and,
-    under ``tp_axis``, the Megatron-sharded params."""
+    under ``tp_axis``/``ep_axis``, the Megatron/expert-sharded params."""
     opt_specs = jax.tree.map(
-        lambda l: _leaf_spec(l, axis_name, tp_axis), state.opt_state
+        lambda l: _leaf_spec(l, axis_name, tp_axis, ep_axis),
+        state.opt_state,
     )
     return state.replace(
         step=P(),
-        params=_param_specs(state.params, tp_axis),
+        params=_param_specs(state.params, tp_axis, ep_axis),
         opt_state=opt_specs,
         model_state=jax.tree.map(lambda _: P(), state.model_state),
     )

@@ -123,18 +123,15 @@ def make_train_step(
     (``parallel.expert_parallel``): expert weight stacks shard over the
     axis, the batch replicates, and — as with TP — the MoE module's
     copy/reduce operators complete every gradient, so no extra sync is
-    needed here.  TP and EP compose (disjoint parameter sets).
+    needed here.  TP and EP compose (disjoint parameter sets), and
+    ``zero=True`` composes with both by the same local-flat-shard
+    argument (build the state with ``zero_state(..., ep_axis=...)``).
     """
     if zero and bucket_bytes is not None:
         raise ValueError("zero=True does its own reduction; drop bucket_bytes")
     if not grad_sync and (zero or bucket_bytes is not None):
         raise ValueError("grad_sync=False skips the reduction entirely; "
                          "it does not compose with zero/bucket_bytes")
-    if zero and ep_axis is not None:
-        raise ValueError(
-            "zero=True with ep_axis is not supported: the expert-stack "
-            "layout has not been validated against the flat-chunk update"
-        )
     if buffer_sync not in ("mean", "broadcast"):
         # No "local" mode: model state is declared replicated (out_specs
         # P()), so per-replica divergent buffers would be silently
@@ -316,7 +313,7 @@ def make_train_step(
                     state_specs,
                 )
 
-                specs = state_specs(state, axis_name, tp_axis)
+                specs = state_specs(state, axis_name, tp_axis, ep_axis)
             else:
                 from distributeddataparallel_tpu.parallel.expert_parallel import (
                     model_axes_state_specs,

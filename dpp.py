@@ -256,8 +256,6 @@ def validate_args(args) -> None:
                 f"--moe-experts {args.moe_experts} must be divisible by "
                 f"--ep {args.ep}"
             )
-        if args.zero:
-            raise SystemExit("--ep does not compose with --zero")
         if args.pp > 1 and args.tp > 1:
             raise SystemExit("--ep with BOTH --pp and --tp is untested")
         if args.cp > 1 and (args.pp > 1 or args.tp > 1):
@@ -466,14 +464,15 @@ def train(args) -> float:
         spe = min(spe, args.steps_per_epoch)
     tx = build_optimizer(args, total_steps=max(spe * args.epochs, 1))
     if args.zero:
-        # With --tp, zero_state places params in the Megatron layout
-        # itself and shards the flat opt state over BOTH axes.
-        if args.tp == 1:
+        # With --tp/--ep, zero_state places params in the Megatron/expert
+        # layout itself and shards the flat opt state over ALL the axes.
+        if args.tp == 1 and args.ep == 1:
             params = ddp.broadcast_params(params, mesh)
         model_state = ddp.broadcast_params(model_state, mesh)
         state = ddp.zero_state(
             apply_fn=model.apply, params=params, tx=tx, mesh=mesh,
             tp_axis="model" if args.tp > 1 else None,
+            ep_axis="expert" if args.ep > 1 else None,
             model_state=model_state,
         )
     elif args.pp > 1:

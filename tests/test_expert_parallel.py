@@ -312,3 +312,178 @@ def test_entrypoint_cp_ep_moe_aux(devices):
     )
     loss = dpp.train(args)
     assert loss == loss  # not NaN: aux plumbing intact under CP x EP
+
+
+def test_ep_zero_matches_plain_ep(devices):
+    """EP × ZeRO-1: the flat-chunk sharded update on each position's
+    LOCAL expert shard must reproduce the replicated-optimizer DP×EP
+    step exactly over two adam steps (expert stacks are uniform across
+    the expert axis, so flat offsets are position-invariant and the
+    replicated leaves — router included — stay in lockstep)."""
+    mesh = ddp.make_mesh(("data", "expert"), shape=(4, 2))
+    cfg_x = _moe_cfg(ep_axis="expert")
+    model_x = TransformerLM(cfg_x)
+    rng = np.random.default_rng(11)
+    batches = [
+        shard_batch(
+            {"tokens": rng.integers(0, 256, size=(8, 17)).astype(np.int32)},
+            mesh,
+        )
+        for _ in range(2)
+    ]
+    params = TransformerLM(_moe_cfg()).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 16), jnp.int32)
+    )["params"]
+    tx = optax.adam(1e-2)
+
+    def loss_fn(p, batch, rng):
+        toks = batch["tokens"]
+        logits = model_x.apply({"params": p}, toks[:, :-1])
+        return lm_cross_entropy(logits, toks[:, 1:]), {}
+
+    state = ddp.TrainState.create(apply_fn=model_x.apply, params=params, tx=tx)
+    state = ddp.shard_state_ep(state, mesh)
+    step = ddp.make_train_step(
+        loss_fn, mesh=mesh, ep_axis="expert", donate=False
+    )
+    for t in batches:
+        state, _ = step(state, t, jax.random.PRNGKey(0))
+
+    zstate = ddp.zero_state(
+        apply_fn=model_x.apply, params=params, tx=tx, mesh=mesh,
+        ep_axis="expert",
+    )
+    zstep = ddp.make_train_step(
+        loss_fn, mesh=mesh, ep_axis="expert", zero=True, donate=False
+    )
+    for t in batches:
+        zstate, _ = zstep(zstate, t, jax.random.PRNGKey(0))
+
+    # Flat opt vectors sharded over BOTH axes.
+    assert any(
+        l.sharding.spec == P(("data", "expert"))
+        for l in jax.tree.leaves(zstate.opt_state) if l.ndim >= 1
+    )
+    for (path, a), b in zip(
+        jax.tree_util.tree_flatten_with_path(state.params)[0],
+        jax.tree.leaves(zstate.params),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=2e-6,
+            err_msg="/".join(str(getattr(k, "key", k)) for k in path),
+        )
+
+
+def test_ep_tp_zero_matches_replicated(devices):
+    """DP(2) x TP(2) x EP(2) with ZeRO-1: flat chunks of the combined
+    Megatron+expert local shard (opt vectors P(('data','model','expert')))
+    must reproduce the replicated-optimizer 3-axis step exactly."""
+    mesh = ddp.make_mesh(("data", "model", "expert"), shape=(2, 2, 2))
+    cfg_x = _moe_cfg(num_heads=4, num_kv_heads=2, tp_axis="model",
+                     ep_axis="expert")
+    model_x = TransformerLM(cfg_x)
+    rng = np.random.default_rng(13)
+    batches = [
+        shard_batch(
+            {"tokens": rng.integers(0, 256, size=(8, 17)).astype(np.int32)},
+            mesh,
+        )
+        for _ in range(2)
+    ]
+    params = TransformerLM(
+        _moe_cfg(num_heads=4, num_kv_heads=2)
+    ).init(jax.random.PRNGKey(0), jnp.zeros((1, 16), jnp.int32))["params"]
+    tx = optax.adam(1e-2)
+
+    def loss_fn(p, batch, rng):
+        toks = batch["tokens"]
+        logits = model_x.apply({"params": p}, toks[:, :-1])
+        return lm_cross_entropy(logits, toks[:, 1:]), {}
+
+    from distributeddataparallel_tpu.parallel.expert_parallel import (
+        shard_state_model_axes,
+    )
+
+    state = ddp.TrainState.create(apply_fn=model_x.apply, params=params, tx=tx)
+    state = shard_state_model_axes(
+        state, mesh, tp_axis="model", ep_axis="expert"
+    )
+    step = ddp.make_train_step(
+        loss_fn, mesh=mesh, tp_axis="model", ep_axis="expert", donate=False
+    )
+    for t in batches:
+        state, _ = step(state, t, jax.random.PRNGKey(0))
+
+    zstate = ddp.zero_state(
+        apply_fn=model_x.apply, params=params, tx=tx, mesh=mesh,
+        tp_axis="model", ep_axis="expert",
+    )
+    zstep = ddp.make_train_step(
+        loss_fn, mesh=mesh, tp_axis="model", ep_axis="expert", zero=True,
+        donate=False,
+    )
+    for t in batches:
+        zstate, _ = zstep(zstate, t, jax.random.PRNGKey(0))
+
+    assert any(
+        l.sharding.spec == P(("data", "model", "expert"))
+        for l in jax.tree.leaves(zstate.opt_state) if l.ndim >= 1
+    )
+    for (path, a), b in zip(
+        jax.tree_util.tree_flatten_with_path(state.params)[0],
+        jax.tree.leaves(zstate.params),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=2e-6,
+            err_msg="/".join(str(getattr(k, "key", k)) for k in path),
+        )
+
+
+def test_cp_ep_zero_matches_replicated(devices):
+    """DP(2) x CP(2) x EP(2) with ZeRO-1 == the replicated-optimizer
+    sequence-sharded MoE step (the CP pmean completes gradients before
+    the data-axis reduce_scatter)."""
+    from distributeddataparallel_tpu.data import shard_lm_batch
+
+    mesh = ddp.make_mesh(("data", "seq", "expert"), shape=(2, 2, 2))
+    cfg_x = _moe_cfg(cp_axis="seq", ep_axis="expert")
+    model_x = TransformerLM(cfg_x)
+    rng = np.random.default_rng(17)
+    batches = [
+        shard_lm_batch(
+            rng.integers(0, 256, size=(4, 33)).astype(np.int32), mesh
+        )
+        for _ in range(2)
+    ]
+    params = TransformerLM(_moe_cfg(max_seq_len=32)).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 32), jnp.int32)
+    )["params"]
+    tx = optax.adam(1e-2)
+
+    def loss_fn(p, batch, rng):
+        logits = model_x.apply({"params": p}, batch["inputs"])
+        return lm_cross_entropy(logits, batch["targets"]), {}
+
+    state = ddp.TrainState.create(apply_fn=model_x.apply, params=params, tx=tx)
+    state = ddp.shard_state_ep(state, mesh)
+    step = ddp.make_train_step(
+        loss_fn, mesh=mesh, cp_axis="seq", ep_axis="expert", donate=False
+    )
+    for t in batches:
+        state, _ = step(state, t, jax.random.PRNGKey(0))
+
+    zstate = ddp.zero_state(
+        apply_fn=model_x.apply, params=params, tx=tx, mesh=mesh,
+        ep_axis="expert",
+    )
+    zstep = ddp.make_train_step(
+        loss_fn, mesh=mesh, cp_axis="seq", ep_axis="expert", zero=True,
+        donate=False,
+    )
+    for t in batches:
+        zstate, _ = zstep(zstate, t, jax.random.PRNGKey(0))
+
+    for a, b in zip(
+        jax.tree.leaves(state.params), jax.tree.leaves(zstate.params)
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-6)

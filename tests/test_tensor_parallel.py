@@ -160,16 +160,6 @@ def test_dp_tp_scan_remat_gqa(devices):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
 
 
-def test_ep_zero_rejected(devices):
-    """ZeRO now composes with TP (test_tp_zero_matches_plain_tp); the
-    expert-stack layout remains unvalidated and must still be refused."""
-    mesh = ddp.make_mesh(("data", "expert"), shape=(4, 2))
-    with pytest.raises(ValueError, match="zero=True with ep_axis"):
-        ddp.make_train_step(
-            lambda p, b, r: (0.0, {}), mesh=mesh, ep_axis="expert", zero=True
-        )
-
-
 def test_dp_cp_tp_train_step_matches_single_device(devices):
     """The full 3-D composition: DP(2) x CP(2) x TP(2) on 8 devices must
     reproduce the single-device step — data rows sharded over 'data',
