@@ -21,6 +21,7 @@ from distributeddataparallel_tpu.parallel.tensor_parallel import (  # noqa: F401
     tp_state_specs,
 )
 from distributeddataparallel_tpu.parallel.pipeline_parallel import (  # noqa: F401
+    make_pp_eval_step,
     make_pp_train_step,
     pp_param_specs,
     pp_state_specs,

@@ -195,6 +195,161 @@ def _head(cfg, params, x):
     )
 
 
+def _check_seq_bound(cfg, S: int, n_cp: int = 1) -> None:
+    """Same guard TransformerLM.__call__ enforces: past the positional
+    table bound, XLA silently CLAMPS RoPE/pos_embed gathers instead of
+    erroring — training/eval would proceed on wrong positions."""
+    if S * n_cp > cfg.max_seq_len:
+        raise ValueError(
+            f"global seq len {S * n_cp} > max_seq_len {cfg.max_seq_len}"
+        )
+
+
+def _pipeline_ticks(
+    cfg,
+    params,
+    mbs_in,
+    *,
+    pp_axis: str,
+    n: int,
+    microbatches: int,
+    run_stage,
+    on_output,
+    positions=None,
+):
+    """THE GPipe schedule, shared by the train and eval steps: M + n - 1
+    ticks; each tick embeds the next microbatch at stage 0, applies this
+    stage's layer slice (``run_stage(x, t, s) -> y``), rotates activations
+    one hop, and hands each completed microbatch's last-stage activations
+    to ``on_output(mb_index, y, s)``.  Callers accumulate through
+    closures; bubble outputs are don't-care values the callers mask on
+    ``s == n - 1``, which is what lets AD reconstruct the reverse
+    pipeline on its own.
+    """
+    M = microbatches
+    s = lax.axis_index(pp_axis)
+    _, mb_rows, S = mbs_in.shape
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    buf = jnp.zeros((mb_rows, S, cfg.d_model), cfg.dtype)
+    for t in range(M + n - 1):
+        x0 = _embed(cfg, params, mbs_in[min(t, M - 1)], positions)
+        x = jnp.where(s == 0, x0, buf)
+        y = run_stage(x, t, s)
+        buf = lax.ppermute(y, pp_axis, perm)
+        oi = t - (n - 1)
+        if oi >= 0:
+            on_output(oi, y, s)
+
+
+def make_pp_eval_step(
+    cfg,
+    *,
+    mesh: Mesh,
+    microbatches: int,
+    data_axis: str = "data",
+    pp_axis: str = "pipe",
+):
+    """Forward-only pipelined evaluation for a scanned TransformerLM.
+
+    ``eval_step(params, batch) -> (metrics, count)`` with the same
+    contract as ``make_eval_step(masked=True)``: ``batch = {"tokens":
+    (B_local, S+1), "valid": (B_local,)}`` sharded over ``data_axis``,
+    per-row metrics weighted by the valid mask so sampler-padded
+    duplicate rows contribute nothing, and the returned count is the
+    global number of valid rows.  The microbatch ticks reuse the same
+    embed/stack/head pieces as the train pipeline; only the last stage's
+    outputs reach the metric sums (masked per position, completed with
+    one psum over the pipe).  TP composes exactly as in training.
+    """
+    from distributeddataparallel_tpu.models.transformer import (
+        rope_frequencies,
+    )
+    from distributeddataparallel_tpu.ops.losses import (
+        per_example_accuracy,
+        per_example_cross_entropy,
+    )
+
+    if not cfg.scan_layers:
+        raise ValueError("pipeline parallelism requires scan_layers=True")
+    if cfg.cp_axis is not None:
+        raise ValueError("pipelined eval does not support cp_axis")
+    n_stages = mesh.shape[pp_axis]
+    M = microbatches
+    stack = _stage_stack(cfg, n_stages)
+
+    def _eval(params, batch):
+        toks, valid = batch["tokens"], batch["valid"]
+        inputs, targets = toks[:, :-1], toks[:, 1:]
+        n = n_stages
+        mb_rows = inputs.shape[0] // M
+        S = inputs.shape[1]
+        _check_seq_bound(cfg, S)
+        mbs_in = inputs.reshape(M, mb_rows, S)
+        mbs_tgt = targets.reshape(M, mb_rows, S)
+        mbs_val = valid.reshape(M, mb_rows).astype(jnp.float32)
+        rope = (
+            rope_frequencies(
+                cfg.dims_per_head, cfg.max_seq_len, theta=cfg.rope_theta
+            )
+            if cfg.positional == "rope"
+            else None
+        )
+        layer_shard = params["layers"]
+        loss_sum = acc_sum = cnt = jnp.zeros((), jnp.float32)
+
+        def run_stage(x, t, s):
+            y, _ = stack.apply({"params": layer_shard}, x, None, rope, True)
+            return y
+
+        def on_output(oi, y, s):
+            nonlocal loss_sum, acc_sum, cnt
+            logits = _head(cfg, params, y)
+            v = mbs_val[oi]
+            on_last = (s == n - 1).astype(jnp.float32)
+            loss_sum = loss_sum + on_last * jnp.sum(
+                per_example_cross_entropy(logits, mbs_tgt[oi]) * v
+            )
+            acc_sum = acc_sum + on_last * jnp.sum(
+                per_example_accuracy(logits, mbs_tgt[oi]) * v
+            )
+            cnt = cnt + on_last * jnp.sum(v)
+
+        _pipeline_ticks(
+            cfg, params, mbs_in, pp_axis=pp_axis, n=n, microbatches=M,
+            run_stage=run_stage, on_output=on_output,
+        )
+        # Only stage n-1 accumulated: the pipe psum replicates the sums;
+        # the data psum then makes them global.
+        sums = [
+            lax.psum(lax.psum(x, pp_axis), data_axis)
+            for x in (loss_sum, acc_sum, cnt)
+        ]
+        loss_sum, acc_sum, cnt = sums
+        denom = jnp.maximum(cnt, 1.0)
+        return {"loss": loss_sum / denom, "accuracy": acc_sum / denom}, cnt
+
+    compiled = None
+
+    def eval_step(params, batch):
+        nonlocal compiled
+        if compiled is None:
+            pspecs = pp_param_specs(params, pp_axis, cfg.tp_axis, cfg.ep_axis)
+            sharded = jax.shard_map(
+                _eval,
+                mesh=mesh,
+                in_specs=(
+                    pspecs,
+                    {"tokens": P(data_axis), "valid": P(data_axis)},
+                ),
+                out_specs=(P(), P()),
+                check_vma=False,
+            )
+            compiled = jax.jit(sharded)
+        return compiled(params, batch)
+
+    return eval_step
+
+
 def make_pp_train_step(
     cfg,
     *,
@@ -247,7 +402,6 @@ def make_pp_train_step(
     def pp_loss(params, inputs, targets):
         """inputs/targets: (B_local, S_local) — the next-token shift
         already applied (host-side under CP, trivially otherwise)."""
-        s = lax.axis_index(pp_axis)
         n = n_stages
         mb_rows = inputs.shape[0] // M
         S = inputs.shape[1]
@@ -262,13 +416,7 @@ def make_pp_train_step(
 
             n_cp = int(lax.psum(1, cfg.cp_axis))
             positions = cp_positions(S, cfg.cp_axis)
-        if S * n_cp > cfg.max_seq_len:
-            # Same guard TransformerLM.__call__ enforces: past the table
-            # bound, XLA silently CLAMPS RoPE/pos_embed gathers instead
-            # of erroring — training would proceed on wrong positions.
-            raise ValueError(
-                f"global seq len {S * n_cp} > max_seq_len {cfg.max_seq_len}"
-            )
+        _check_seq_bound(cfg, S, n_cp)
         rope = (
             rope_frequencies(
                 cfg.dims_per_head, cfg.max_seq_len, theta=cfg.rope_theta
@@ -279,47 +427,42 @@ def make_pp_train_step(
         layer_shard = params["layers"]
 
         use_aux = cfg.moe_experts > 0 and moe_aux_weight > 0.0
-
-        def run_stage(x):
-            if use_aux:
-                (y, _), col = stack.apply(
-                    {"params": layer_shard}, x, positions, rope, True,
-                    mutable=["intermediates"],
-                )
-                from distributeddataparallel_tpu.models.transformer import (
-                    moe_aux_from_intermediates,
-                )
-
-                return y, moe_aux_from_intermediates(col)
-            y, _ = stack.apply(
-                {"params": layer_shard}, x, positions, rope, True
-            )
-            return y, jnp.zeros((), jnp.float32)
-
-        perm = [(i, (i + 1) % n) for i in range(n)]
-        buf = jnp.zeros((mb_rows, S, cfg.d_model), cfg.dtype)
         acc = jnp.zeros((), jnp.float32)
         aux_acc = jnp.zeros((), jnp.float32)
-        # Static GPipe schedule: M + n - 1 ticks.  Every stage computes
-        # every tick (SPMD); bubble results are masked out of the loss,
-        # so their gradients vanish and AD reconstructs the reverse
-        # pipeline schedule on its own.
-        for t in range(M + n - 1):
-            x0 = _embed(cfg, params, mbs_in[min(t, M - 1)], positions)
-            x = jnp.where(s == 0, x0, buf)
-            y, tick_aux = run_stage(x)
-            if use_aux:
-                # Count only ticks where this stage processed a REAL
-                # microbatch (stage s holds microbatch t - s).
-                valid = jnp.logical_and(t - s >= 0, t - s < M)
-                aux_acc = aux_acc + jnp.where(valid, tick_aux, 0.0)
-            buf = lax.ppermute(y, pp_axis, perm)
-            out_idx = t - (n - 1)
-            if out_idx < 0:
-                continue  # pipe still filling: no stage has output yet
+
+        def run_stage(x, t, s):
+            nonlocal aux_acc
+            if not use_aux:
+                y, _ = stack.apply(
+                    {"params": layer_shard}, x, positions, rope, True
+                )
+                return y
+            (y, _), col = stack.apply(
+                {"params": layer_shard}, x, positions, rope, True,
+                mutable=["intermediates"],
+            )
+            from distributeddataparallel_tpu.models.transformer import (
+                moe_aux_from_intermediates,
+            )
+
+            # Count only ticks where this stage processed a REAL
+            # microbatch (stage s holds microbatch t - s).
+            valid = jnp.logical_and(t - s >= 0, t - s < M)
+            aux_acc = aux_acc + jnp.where(
+                valid, moe_aux_from_intermediates(col), 0.0
+            )
+            return y
+
+        def on_output(oi, y, s):
+            nonlocal acc
             logits = _head(cfg, params, y)
-            mb_loss = lm_cross_entropy(logits, mbs_tgt[out_idx])
+            mb_loss = lm_cross_entropy(logits, mbs_tgt[oi])
             acc = acc + jnp.where(s == n - 1, mb_loss, 0.0)
+
+        _pipeline_ticks(
+            cfg, params, mbs_in, pp_axis=pp_axis, n=n, microbatches=M,
+            run_stage=run_stage, on_output=on_output, positions=positions,
+        )
         # Only the last stage accumulated; the psum replicates the total.
         # MUST be the custom-vjp reduce (psum fwd, identity bwd): under
         # check_vma=False, lax.psum's transpose psums the replicated

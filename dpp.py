@@ -226,8 +226,8 @@ def validate_args(args) -> None:
             raise SystemExit("--pp requires an LM model (--model gpt2|llama)")
         if args.zero:
             raise SystemExit("--pp does not compose with --zero")
-        if args.eval:
-            raise SystemExit("--pp does not support --eval yet")
+        if args.eval and args.cp > 1:
+            raise SystemExit("--pp --eval does not support --cp")
         if args.accum_steps > 1:
             raise SystemExit(
                 "--pp's microbatch loop IS the accumulation; use "
@@ -627,7 +627,21 @@ def train(args) -> float:
             ep_axis="expert" if args.ep > 1 else None,
         )
     eval_step = None
-    if args.eval and cp:
+    if args.eval and args.pp > 1:
+        # Pipelined forward-only eval: same microbatch ticks as training,
+        # masked exactly over the sampler-padded tail.
+        from distributeddataparallel_tpu.parallel import make_pp_eval_step
+
+        eval_step = make_pp_eval_step(
+            model.cfg, mesh=mesh,
+            microbatches=args.pp_microbatches or args.pp,
+        )
+        eval_loader = DataLoader(
+            build_dataset(args, train=False), per_replica_batch=args.batch_size,
+            mesh=mesh, shuffle=False, seed=args.seed, drop_last=False,
+            with_mask=True,
+        )
+    elif args.eval and cp:
         from distributeddataparallel_tpu.data import shard_lm_batch
         from distributeddataparallel_tpu.ops import (
             per_example_accuracy,
