@@ -352,6 +352,48 @@ def bench_llama() -> dict:
     }
 
 
+def bench_decode() -> dict:
+    """KV-cache decode throughput (models.generate): batched greedy
+    generation on GPT-2 124M, bf16.  tokens/s/chip counts GENERATED
+    tokens across the batch; the timed region includes the prefill (one
+    compiled full-prompt apply) and the lax.scan of single-token steps.
+    Decode is memory-bandwidth-bound (the whole weight matrix streams
+    from HBM per token), so this is the framework's HBM-bound surface
+    next to the MXU-bound training numbers."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributeddataparallel_tpu.models import (
+        TransformerLM,
+        generate,
+        gpt2_124m,
+    )
+
+    B, P, N = 8, 128, 128
+    cfg = gpt2_124m(max_seq_len=P + N, dtype=jnp.bfloat16)
+    model = TransformerLM(cfg)
+    rng = jax.random.PRNGKey(0)
+    prompt = jax.random.randint(rng, (B, P), 0, cfg.vocab_size)
+    params = model.init(rng, prompt)["params"]
+
+    out = generate(model, params, prompt, N)  # compile (prefill + scan)
+    assert int(jnp.sum(out)) >= 0  # fence
+
+    iters = 3
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = generate(model, params, prompt, N)
+    assert int(jnp.sum(out)) >= 0  # fence
+    dt = (time.perf_counter() - t0) / iters
+    return {
+        "decode_tokens_s_chip": round(B * N / dt, 1),
+        "batch": B,
+        "prompt_len": P,
+        "new_tokens": N,
+        "gen_wall_ms": round(dt * 1e3, 1),
+    }
+
+
 def bench_overlap() -> dict:
     """Comm/compute overlap on the GPT-2 124M DP step (BASELINE config 5's
     "overlap demonstrated"): full step vs compute-only (grad_sync=False,
@@ -408,6 +450,7 @@ def main() -> None:
     resnet = _run(bench_resnet50, "resnet50")
     gpt2 = _run(bench_gpt2, "gpt2")
     llama = _run(bench_llama, "llama")
+    decode = _run(bench_decode, "decode")
     overlap = _run(bench_overlap, "overlap")
 
     img_s_chip = resnet.get("img_s_chip", 0.0)
@@ -426,6 +469,7 @@ def main() -> None:
                     "resnet50": resnet,
                     "gpt2_124m": gpt2,
                     "llama_0p6b": llama,
+                    "decode_gpt2": decode,
                     "overlap_gpt2_dp": overlap,
                 },
             }

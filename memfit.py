@@ -209,10 +209,17 @@ def analyze(seq_len: int, microbatches=(1, 2)) -> dict:
         fixed = model_fixed + opt_bytes
         # params + grads each drop their sharded fraction (N-1)/N; opt
         # state drops its own sharded fraction.
+        sharded_opt = sharded_bytes(ast.opt_state)
         tp_saving = (
-            2 * sharded_bytes(ast.params) + sharded_bytes(ast.opt_state)
+            2 * sharded_bytes(ast.params) + sharded_opt
         ) * (TPN - 1) / TPN
         tp_fixed = fixed - tp_saving
+        # TP-8 x ZeRO-1x8 (a DP(8) x TP(8) pod slice): params/grads keep
+        # the TP fractions; the flat opt state is built from each
+        # position's LOCAL Megatron shard and then 1/8-sharded again over
+        # the data axis (parallel/zero.py zero_state(tp_axis=...)).
+        tp_local_opt = (opt_bytes - sharded_opt) + sharded_opt / TPN
+        tp_zero_fixed = tp_fixed - tp_local_opt + tp_local_opt / 8
         rows.append({
             "optimizer": name,
             "opt_state_gb": gb(opt_bytes),
@@ -229,6 +236,8 @@ def analyze(seq_len: int, microbatches=(1, 2)) -> dict:
             "tp8_fixed_gb": gb(tp_fixed),
             "tp8_max_mb_v5p": max_mb(V5P_HBM_BYTES, tp_fixed),
             "tp8_max_mb_v5e": max_mb(hbm, tp_fixed),
+            "tp8_zero8_fixed_gb": gb(tp_zero_fixed),
+            "tp8_zero8_max_mb_v5p": max_mb(V5P_HBM_BYTES, tp_zero_fixed),
         })
 
     return {
@@ -280,8 +289,9 @@ def main() -> None:
     print()
     print("| optimizer | opt state | 8B peak @mb=1 | 8B peak @mb=2 | "
           "max mb (v5e 16G) | max mb (v5p 95G) | ZeRO-1x8 fixed | "
-          "ZeRO-1x8 max mb (v5p) | TP-8 fixed | TP-8 max mb (v5p) |")
-    print("|---|---|---|---|---|---|---|---|---|---|")
+          "ZeRO-1x8 max mb (v5p) | TP-8 fixed | TP-8 max mb (v5p) | "
+          "TP-8 x ZeRO-1x8 fixed | TP-8 x ZeRO max mb (v5p) |")
+    print("|---|---|---|---|---|---|---|---|---|---|---|---|")
     for row in r["optimizers"]:
         mbs = sorted(row["peak8b_gb"])
         print(
@@ -289,7 +299,9 @@ def main() -> None:
             f"| {row['peak8b_gb'][mbs[0]]} GB | {row['peak8b_gb'][mbs[1]]} GB "
             f"| {row['max_mb_v5e']} | {row['max_mb_v5p']} "
             f"| {row['zero1x8_fixed_gb']} GB | {row['zero1x8_max_mb_v5p']} "
-            f"| {row['tp8_fixed_gb']} GB | {row['tp8_max_mb_v5p']} |"
+            f"| {row['tp8_fixed_gb']} GB | {row['tp8_max_mb_v5p']} "
+            f"| {row['tp8_zero8_fixed_gb']} GB "
+            f"| {row['tp8_zero8_max_mb_v5p']} |"
         )
     import json
     print("\n```json")
