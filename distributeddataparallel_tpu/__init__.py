@@ -50,5 +50,10 @@ from distributeddataparallel_tpu.parallel.pipeline_parallel import (  # noqa: F4
     make_pp_train_step,
     shard_state_pp,
 )
+from distributeddataparallel_tpu.parallel.fsdp import (  # noqa: F401
+    fsdp_gather_params,
+    fsdp_state,
+    make_fsdp_train_step,
+)
 from distributeddataparallel_tpu.training.state import TrainState  # noqa: F401
 from distributeddataparallel_tpu.training.train_step import make_train_step  # noqa: F401

@@ -32,3 +32,8 @@ from distributeddataparallel_tpu.parallel.expert_parallel import (  # noqa: F401
     ep_state_specs,
     shard_state_ep,
 )
+from distributeddataparallel_tpu.parallel.fsdp import (  # noqa: F401
+    fsdp_gather_params,
+    fsdp_state,
+    make_fsdp_train_step,
+)

@@ -101,6 +101,11 @@ def parse_args(argv=None):
     p.add_argument("--zero", action="store_true",
                    help="ZeRO-1 optimizer-state sharding across the data "
                         "axis (reduce_scatter + sharded update + all_gather)")
+    p.add_argument("--fsdp", action="store_true",
+                   help="fully-sharded data parallelism (ZeRO-3): params, "
+                        "grads, and optimizer state all 1/N per device; "
+                        "weights gathered one layer at a time inside the "
+                        "step (scanned LM models, pure DP mesh)")
     p.add_argument("--bucket-mb", type=float, default=None,
                    help="explicit DDP-style gradient bucket size in MiB "
                         "(default: let XLA schedule the all-reduce)")
@@ -239,6 +244,22 @@ def validate_args(args) -> None:
             raise SystemExit(
                 f"--layers {args.layers} must be divisible by --pp {args.pp}"
             )
+    if args.fsdp:
+        if not is_lm(args):
+            raise SystemExit("--fsdp requires an LM model (--model gpt2|llama)")
+        bad = [
+            f for f, on in (
+                ("--zero", args.zero), ("--tp", args.tp > 1),
+                ("--pp", args.pp > 1), ("--cp", args.cp > 1),
+                ("--ep", args.ep > 1), ("--moe-experts", bool(args.moe_experts)),
+                ("--accum-steps", args.accum_steps > 1),
+                ("--bucket-mb", bool(args.bucket_mb)), ("--eval", args.eval),
+            ) if on
+        ]
+        if bad:
+            raise SystemExit(
+                f"--fsdp v1 is pure data parallelism; drop {', '.join(bad)}"
+            )
     if args.generate:
         if not is_lm(args):
             raise SystemExit("--generate requires an LM model")
@@ -290,8 +311,8 @@ def build_model(args, num_classes: int = 10, vocab_size: int | None = None):
             overrides["cp_impl"] = args.cp_impl
         if args.tp > 1:
             overrides["tp_axis"] = "model"
-        if args.pp > 1:
-            # GPipe shards the scanned layer stack's leading dim.
+        if args.pp > 1 or args.fsdp:
+            # GPipe/FSDP operate on the scanned layer stack's leading dim.
             overrides["scan_layers"] = True
         if args.moe_experts:
             overrides["moe_experts"] = args.moe_experts
@@ -463,7 +484,13 @@ def train(args) -> float:
     if args.steps_per_epoch:
         spe = min(spe, args.steps_per_epoch)
     tx = build_optimizer(args, total_steps=max(spe * args.epochs, 1))
-    if args.zero:
+    if args.fsdp:
+        # Fully-sharded: params/grads/opt state 1/N per device; the step
+        # gathers one layer at a time (parallel/fsdp.py).
+        state = ddp.fsdp_state(
+            model.cfg, params, tx, mesh, apply_fn=model.apply
+        )
+    elif args.zero:
         # With --tp/--ep, zero_state places params in the Megatron/expert
         # layout itself and shards the flat opt state over ALL the axes.
         if args.tp == 1 and args.ep == 1:
@@ -568,7 +595,12 @@ def train(args) -> float:
             loss = cross_entropy_loss(logits, batch["label"])  # ref dpp.py:40
             return loss, {"accuracy": accuracy(logits, batch["label"])}
 
-    if args.pp > 1:
+    if args.fsdp:
+        # FSDP: the step factory takes the model CONFIG (it decomposes
+        # the transformer into embed / layer scan / head around the
+        # per-layer weight gathers).
+        step_fn = ddp.make_fsdp_train_step(model.cfg, mesh=mesh)
+    elif args.pp > 1:
         # GPipe: the step factory takes the model CONFIG (it decomposes
         # the transformer into embed / stage stack / head itself); the
         # microbatch loop is the accumulation.
@@ -801,7 +833,11 @@ def train(args) -> float:
             dataset.tokens[:2, : max(args.seq_len // 4, 1)], jnp.int32
         )
         n_new = min(args.generate, model.cfg.max_seq_len - prompt.shape[1])
-        out = _gen(model, state.params, prompt, n_new)
+        gen_params = (
+            ddp.fsdp_gather_params(model.cfg, state, mesh)
+            if args.fsdp else state.params
+        )
+        out = _gen(model, gen_params, prompt, n_new)
         log0("generate: prompt %s -> %s (last 8 tokens: %s)",
              prompt.shape, out.shape, np.asarray(out[0, -8:]).tolist())
 
