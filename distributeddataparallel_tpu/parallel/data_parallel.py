@@ -107,6 +107,28 @@ def bucket_gradients(
     return jax.tree.unflatten(treedef, reduced)
 
 
+def sumsq_f32(tree: Pytree):
+    """Sum of squares of every leaf, accumulated in float32 (bf16 grads
+    would lose the norm to ~8 mantissa bits) — the building block for
+    global-norm clipping in every layout (replicated, ZeRO chunks, FSDP
+    flats: sharded layouts psum this across their axis, which is exact
+    because the shards partition the gradient vector)."""
+    import jax.numpy as jnp
+
+    return sum(
+        jnp.sum(l.astype(jnp.float32) ** 2) for l in jax.tree.leaves(tree)
+    )
+
+
+def clip_scale(gnorm, clip_norm: float):
+    """min(1, clip/norm): the torch clip_grad_norm_ scale factor — ONE
+    definition (epsilon included) shared by the replicated, ZeRO, and
+    FSDP clip paths."""
+    import jax.numpy as jnp
+
+    return jnp.minimum(1.0, clip_norm / (gnorm + 1e-12))
+
+
 def broadcast_params(params: Pytree, mesh: Mesh) -> Pytree:
     """Replicate params across every device of the mesh.
 

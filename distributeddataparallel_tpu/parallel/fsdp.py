@@ -222,6 +222,7 @@ def make_fsdp_train_step(
     mesh: Mesh,
     data_axis: str = "data",
     donate: bool = True,
+    grad_clip: float | None = None,
 ):
     """Compiled FSDP train step for a scanned TransformerLM config.
 
@@ -286,6 +287,18 @@ def make_fsdp_train_step(
         # each shard; divide for DDP mean semantics (global loss is the
         # mean of per-replica means).
         gflat = jax.tree.map(lambda g: g / n, gflat)
+        if grad_clip is not None:
+            # The flat shards partition the gradient vector: global
+            # norm² is one psum of local sum-of-squares — exact.
+            from distributeddataparallel_tpu.parallel.data_parallel import (
+                clip_scale,
+                sumsq_f32,
+            )
+
+            gnorm = jnp.sqrt(lax.psum(sumsq_f32(gflat), data_axis))
+            gflat = jax.tree.map(
+                lambda g: g * clip_scale(gnorm, grad_clip), gflat
+            )
         new_state = state.apply_gradients(gflat)
         return new_state, {"loss": lax.pmean(loss, data_axis)}
 

@@ -231,6 +231,7 @@ def zero_update(
     state,
     axis_name: str,
     num_shards: int,
+    clip_norm: float | None = None,
 ):
     """The sharded-update step body (runs inside shard_map).
 
@@ -238,6 +239,11 @@ def zero_update(
     (new_params, new_opt_state) with params fully replicated again.
     ``num_shards`` is the static data-axis size (chunk sizes must be
     known at trace time).
+
+    ``clip_norm``: clip the (synced) gradient to this global L2 norm —
+    EXACT despite the sharded layout: the chunks partition the full
+    gradient vector, so the global norm² is one psum of local chunk
+    norm²s.
     """
     n = num_shards
     idx = lax.axis_index(axis_name)
@@ -249,6 +255,14 @@ def zero_update(
     g_shard = lax.psum_scatter(
         flat_g, axis_name, scatter_dimension=0, tiled=True
     ) / n
+    if clip_norm is not None:
+        from distributeddataparallel_tpu.parallel.data_parallel import (
+            clip_scale,
+            sumsq_f32,
+        )
+
+        gnorm = jnp.sqrt(lax.psum(sumsq_f32(g_shard), axis_name))
+        g_shard = g_shard * clip_scale(gnorm, clip_norm)
 
     flat_p = flatten_f32(state.params, padded)
     p_shard = lax.dynamic_slice(flat_p, (idx * chunk,), (chunk,))

@@ -58,6 +58,7 @@ def make_train_step(
     cp_axis: str | None = None,
     tp_axis: str | None = None,
     ep_axis: str | None = None,
+    grad_clip: float | None = None,
 ):
     """Build the jit'd DP train step.
 
@@ -119,6 +120,13 @@ def make_train_step(
     optimizer state shards n_data × n_tp ways; build the state with
     ``zero_state(..., tp_axis=...)``.
 
+    ``grad_clip`` clips the synced gradient to a global L2 norm (the
+    ``torch.nn.utils.clip_grad_norm_`` analog, applied after the
+    all-reduce exactly as DDP users do).  Under ``zero=True`` the norm
+    is computed psum-exactly over the flat chunks.  Rejected with
+    tp/ep_axis: each position's local-shard norm would differ and scale
+    replicated leaves divergently.
+
     ``ep_axis`` adds expert parallelism for MoE configs
     (``parallel.expert_parallel``): expert weight stacks shard over the
     axis, the batch replicates, and — as with TP — the MoE module's
@@ -132,6 +140,19 @@ def make_train_step(
     if not grad_sync and (zero or bucket_bytes is not None):
         raise ValueError("grad_sync=False skips the reduction entirely; "
                          "it does not compose with zero/bucket_bytes")
+    if grad_clip is not None and (tp_axis is not None or ep_axis is not None):
+        # Local Megatron/expert shards would each compute a DIFFERENT
+        # "global" norm and scale the replicated leaves divergently —
+        # reject rather than silently corrupt training.
+        raise ValueError(
+            "grad_clip under tp_axis/ep_axis needs an axis-aware norm; "
+            "not supported"
+        )
+    if grad_clip is not None and not grad_sync:
+        # Unsynced per-replica grads have per-replica norms: clipping
+        # would scale each replica differently (same divergence as the
+        # tp/ep case).  Clip in the manual scheme instead.
+        raise ValueError("grad_clip requires grad_sync=True")
     if buffer_sync not in ("mean", "broadcast"):
         # No "local" mode: model state is declared replicated (out_specs
         # P()), so per-replica divergent buffers would be silently
@@ -225,7 +246,8 @@ def make_train_step(
             from distributeddataparallel_tpu.parallel.zero import zero_update
 
             new_params, new_opt_state = zero_update(
-                grads, state, axis_name, mesh.shape[axis_name]
+                grads, state, axis_name, mesh.shape[axis_name],
+                clip_norm=grad_clip,
             )
             new_state = state.replace(
                 step=state.step + 1, params=new_params,
@@ -237,6 +259,16 @@ def make_train_step(
                 grads = all_reduce_gradients(
                     grads, axis_name, op="mean", bucket_bytes=bucket_bytes
                 )
+            if grad_clip is not None:
+                # Grads are complete per position here (post sync / cp
+                # pmean), so the local norm IS the global norm.
+                from distributeddataparallel_tpu.parallel.data_parallel import (
+                    clip_scale,
+                    sumsq_f32,
+                )
+
+                scale = clip_scale(jnp.sqrt(sumsq_f32(grads)), grad_clip)
+                grads = jax.tree.map(lambda g: g * scale, grads)
             new_state = state.apply_gradients(grads)
         if with_model_state:
             sync_axes = (axis_name,) + (

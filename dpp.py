@@ -66,6 +66,10 @@ def parse_args(argv=None):
                    help="linear LR warmup steps before the schedule")
     p.add_argument("--min-lr", type=float, default=0.0,
                    help="floor the cosine/linear decay at this LR")
+    p.add_argument("--grad-clip", type=float, default=None,
+                   help="clip the synced gradient to this global L2 norm "
+                        "(torch clip_grad_norm_ analog; psum-exact under "
+                        "--zero/--fsdp; not with --tp/--ep/--pp)")
     p.add_argument("--seed", type=int, default=0)            # ref dpp.py:29
     p.add_argument("--accum-steps", type=int, default=1,
                    help="gradient accumulation (DDP no_sync analog)")
@@ -259,6 +263,14 @@ def validate_args(args) -> None:
         if bad:
             raise SystemExit(
                 f"--fsdp v1 is pure data parallelism; drop {', '.join(bad)}"
+            )
+    if args.grad_clip is not None:
+        if args.grad_clip <= 0:
+            raise SystemExit("--grad-clip must be > 0")
+        if args.tp > 1 or args.ep > 1 or args.pp > 1:
+            raise SystemExit(
+                "--grad-clip needs complete per-position grads "
+                "(no --tp/--ep/--pp): local-shard norms would diverge"
             )
     if args.generate:
         if not is_lm(args):
@@ -599,7 +611,9 @@ def train(args) -> float:
         # FSDP: the step factory takes the model CONFIG (it decomposes
         # the transformer into embed / layer scan / head around the
         # per-layer weight gathers).
-        step_fn = ddp.make_fsdp_train_step(model.cfg, mesh=mesh)
+        step_fn = ddp.make_fsdp_train_step(
+            model.cfg, mesh=mesh, grad_clip=args.grad_clip
+        )
     elif args.pp > 1:
         # GPipe: the step factory takes the model CONFIG (it decomposes
         # the transformer into embed / stage stack / head itself); the
@@ -630,6 +644,7 @@ def train(args) -> float:
             cp_axis="seq" if cp else None,
             tp_axis="model" if args.tp > 1 else None,
             ep_axis="expert" if args.ep > 1 else None,
+            grad_clip=args.grad_clip,
         )
 
     ckpt = None

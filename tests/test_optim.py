@@ -84,3 +84,146 @@ def test_entrypoint_zero_with_schedule(devices):
          "--zero", "--fake-devices", "8"]
     ))
     assert loss == loss
+
+
+def test_grad_clip_matches_manual(devices):
+    """DP grad_clip == manually clipping the full-batch gradient before
+    the update (torch clip_grad_norm_ semantics)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    import distributeddataparallel_tpu as ddp
+    from distributeddataparallel_tpu.data.loader import shard_batch
+    from distributeddataparallel_tpu.models import TinyMLP
+    from distributeddataparallel_tpu.ops import cross_entropy_loss
+
+    mesh = ddp.make_mesh(("data",))
+    model = TinyMLP(features=(16,))
+    x = np.random.default_rng(0).normal(size=(8, 8, 8, 1)).astype(np.float32)
+    y = np.random.default_rng(1).integers(0, 10, size=(8,)).astype(np.int32)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8, 8, 1)))["params"]
+    tx = optax.sgd(0.5)
+    CLIP = 0.05  # far below the actual norm so clipping certainly bites
+
+    def ref_loss(p):
+        return cross_entropy_loss(
+            model.apply({"params": p}, jnp.asarray(x)), jnp.asarray(y)
+        )
+
+    g = jax.grad(ref_loss)(params)
+    gnorm = float(optax.global_norm(g))
+    assert gnorm > CLIP
+    g = jax.tree.map(lambda t: t * CLIP / gnorm, g)
+    up, _ = tx.update(g, tx.init(params), params)
+    ref_p = optax.apply_updates(params, up)
+
+    def loss_fn(p, b, r):
+        return cross_entropy_loss(
+            model.apply({"params": p}, b["image"]), b["label"]
+        ), {}
+
+    state = ddp.TrainState.create(apply_fn=model.apply, params=params, tx=tx)
+    state = ddp.broadcast_params(state, mesh)
+    step = ddp.make_train_step(loss_fn, mesh=mesh, grad_clip=CLIP)
+    state, _ = step(
+        state, shard_batch({"image": x, "label": y}, mesh),
+        jax.random.PRNGKey(0),
+    )
+    for a, b in zip(jax.tree.leaves(state.params), jax.tree.leaves(ref_p)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+
+def test_grad_clip_zero_matches_replicated(devices):
+    """ZeRO's psum-exact chunk-norm clip == the replicated-path clip."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    import distributeddataparallel_tpu as ddp
+    from distributeddataparallel_tpu.data.loader import shard_batch
+    from distributeddataparallel_tpu.models import TinyMLP
+    from distributeddataparallel_tpu.ops import cross_entropy_loss
+
+    mesh = ddp.make_mesh(("data",))
+    model = TinyMLP(features=(16,))
+    x = np.random.default_rng(2).normal(size=(8, 8, 8, 1)).astype(np.float32)
+    y = np.random.default_rng(3).integers(0, 10, size=(8,)).astype(np.int32)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8, 8, 1)))["params"]
+    tx = optax.adam(1e-2)
+    batch = shard_batch({"image": x, "label": y}, mesh)
+
+    def loss_fn(p, b, r):
+        return cross_entropy_loss(
+            model.apply({"params": p}, b["image"]), b["label"]
+        ), {}
+
+    state = ddp.TrainState.create(apply_fn=model.apply, params=params, tx=tx)
+    state = ddp.broadcast_params(state, mesh)
+    step = ddp.make_train_step(
+        loss_fn, mesh=mesh, grad_clip=0.05, donate=False
+    )
+    state, _ = step(state, batch, jax.random.PRNGKey(0))
+
+    zstate = ddp.zero_state(
+        apply_fn=model.apply,
+        params=ddp.broadcast_params(params, mesh), tx=tx, mesh=mesh,
+    )
+    zstep = ddp.make_train_step(
+        loss_fn, mesh=mesh, zero=True, grad_clip=0.05, donate=False
+    )
+    zstate, _ = zstep(zstate, batch, jax.random.PRNGKey(0))
+
+    for a, b in zip(
+        jax.tree.leaves(state.params), jax.tree.leaves(zstate.params)
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-6)
+
+
+def test_grad_clip_fsdp_matches_replicated(devices):
+    """FSDP's sharded-flat clip == the replicated-path clip."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    import distributeddataparallel_tpu as ddp
+    from distributeddataparallel_tpu.data.loader import shard_batch
+    from distributeddataparallel_tpu.models import TransformerLM, tiny_lm
+    from distributeddataparallel_tpu.ops import lm_cross_entropy
+
+    cfg = tiny_lm(
+        num_layers=2, num_heads=2, d_model=32, d_ff=64, max_seq_len=32,
+        scan_layers=True,
+    )
+    mesh = ddp.make_mesh(("data",))
+    model = TransformerLM(cfg)
+    tokens = np.random.default_rng(4).integers(
+        0, 256, size=(8, 17)
+    ).astype(np.int32)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 16), jnp.int32)
+    )["params"]
+    tx = optax.sgd(0.5)
+    batch = shard_batch({"tokens": tokens}, mesh)
+
+    def loss_fn(p, b, r):
+        toks = b["tokens"]
+        logits = model.apply({"params": p}, toks[:, :-1])
+        return lm_cross_entropy(logits, toks[:, 1:]), {}
+
+    state = ddp.TrainState.create(apply_fn=model.apply, params=params, tx=tx)
+    state = ddp.broadcast_params(state, mesh)
+    step = ddp.make_train_step(
+        loss_fn, mesh=mesh, grad_clip=0.01, donate=False
+    )
+    state, _ = step(state, batch, jax.random.PRNGKey(0))
+
+    fstate = ddp.fsdp_state(cfg, params, tx, mesh)
+    fstep = ddp.make_fsdp_train_step(
+        cfg, mesh=mesh, grad_clip=0.01, donate=False
+    )
+    fstate, _ = fstep(fstate, batch, jax.random.PRNGKey(0))
+    got = ddp.fsdp_gather_params(cfg, fstate, mesh)
+
+    for a, b in zip(jax.tree.leaves(state.params), jax.tree.leaves(got)):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a), atol=1e-5)
