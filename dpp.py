@@ -649,11 +649,55 @@ def train(args) -> float:
 
     ckpt = None
     start_epoch = 0
+    preempted = {"signal": None}
     if args.checkpoint_dir:
         from distributeddataparallel_tpu.training.checkpoint import Checkpointer
         ckpt = Checkpointer(args.checkpoint_dir)
         if args.resume:
             state, start_epoch = ckpt.restore_latest(state)
+        # Preemption handling (TPU-VM maintenance events deliver SIGTERM):
+        # finish the in-flight step, checkpoint, exit cleanly.  Epoch
+        # granularity: --resume continues from the NEXT epoch — the
+        # interrupted epoch's remaining batches are skipped (the loader's
+        # position isn't part of the state; params stay monotone, no
+        # batch is ever applied twice).  The reference has no failure
+        # handling at all beyond fail-fast join (ref dpp.py:62; SURVEY §5).
+        import signal
+
+        def _on_term(signum, frame):
+            preempted["signal"] = signum
+            log0("signal %d: will checkpoint at the current epoch and exit",
+                 signum)
+
+        try:
+            signal.signal(signal.SIGTERM, _on_term)
+        except ValueError:
+            pass  # non-main thread (library use): no handler, no harm
+
+    # Multi-host agreement cadence: the host-level allgather below forces
+    # a cross-process sync, so it runs every k batches, not every batch
+    # (bounded k-step response to the signal, 1/k the sync cost).
+    PREEMPT_CHECK_EVERY = 8
+
+    def preempt_agreed(batch_idx: int) -> bool:
+        """Do ALL processes agree to stop?  SIGTERM delivery can straddle
+        a batch boundary across hosts; acting on the local flag alone
+        would send processes into mismatched collectives (a hang, and no
+        checkpoint).  Multi-host: agree via a host-level allgather on a
+        fixed batch cadence — every process calls it at the same batch
+        indices, so the collective order stays uniform; any one signaled
+        process stops everyone."""
+        if ddp.get_world_size() == 1:
+            return preempted["signal"] is not None
+        if batch_idx % PREEMPT_CHECK_EVERY:
+            return False
+        import numpy as np
+        from jax.experimental import multihost_utils
+
+        flags = multihost_utils.process_allgather(
+            np.array([preempted["signal"] is not None], np.int32)
+        )
+        return bool(flags.sum() > 0)
 
     # Evaluation is exact over the padded tail: the loader emits a per-row
     # "valid" mask (0 on sampler-padded duplicate rows) and the masked eval
@@ -809,6 +853,13 @@ def train(args) -> float:
                     last_loss = float(metrics["loss"])
                     log0("Epoch %d, Batch %d, Loss: %.4f",
                          epoch, batch_idx, last_loss)
+                if ckpt is not None and preempt_agreed(batch_idx):
+                    ckpt.save(state, epoch)
+                    ckpt.wait()
+                    log0("preempted: checkpoint saved mid-epoch %d; "
+                         "--resume continues from epoch %d", epoch, epoch + 1)
+                    ddp.destroy_process_group()
+                    return float(metrics["loss"])
         last_loss = float(metrics["loss"])
         if eval_step is not None:
             # Masked eval: each step returns (masked means, valid-row
