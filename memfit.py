@@ -198,6 +198,17 @@ def analyze(seq_len: int, microbatches=(1, 2)) -> dict:
         )
 
     TPN = 8
+    # FSDP-8 byte math (optimizer-independent parts, parallel/fsdp.py):
+    # stored = per-chip params shards; the gathered non-layer flat and
+    # ~2 gathered layers (current + backward regather) live full.
+    from distributeddataparallel_tpu.parallel.fsdp import _Meta
+
+    meta = _Meta(full_cfg, 8)
+    layer_full = 4 * sum(
+        l.size for l in jax.tree.leaves(meta.layer_template)
+    )
+    rest_full = 4 * meta.rest_chunk * 8
+    fsdp_stored = 4 * (meta.L * meta.layer_chunk + meta.rest_chunk)
     rows = []
     for name, tx in (
         ("sgd", sgd),
@@ -220,6 +231,17 @@ def analyze(seq_len: int, microbatches=(1, 2)) -> dict:
         # the data axis (parallel/zero.py zero_state(tp_axis=...)).
         tp_local_opt = (opt_bytes - sharded_opt) + sharded_opt / TPN
         tp_zero_fixed = tp_fixed - tp_local_opt + tp_local_opt / 8
+        # FSDP-8: params, grads, and opt state all 1/8 resident; plus the
+        # full gathered non-layer flat, ~2 gathered layers, AND the same
+        # measured non-param residual (model_fixed - params - grads, the
+        # XLA/framework overhead ~10 GB) every other column inherits —
+        # without it the FSDP column would not be comparable.
+        opt_mult = opt_bytes / max(params_bytes, 1)  # 0 sgd, 1 mom, 2 adamw
+        residual = max(model_fixed - 2 * params_bytes, 0)
+        fsdp_fixed = (
+            fsdp_stored * (2 + opt_mult) + rest_full + 2 * layer_full
+            + residual
+        )
         rows.append({
             "optimizer": name,
             "opt_state_gb": gb(opt_bytes),
@@ -238,6 +260,8 @@ def analyze(seq_len: int, microbatches=(1, 2)) -> dict:
             "tp8_max_mb_v5e": max_mb(hbm, tp_fixed),
             "tp8_zero8_fixed_gb": gb(tp_zero_fixed),
             "tp8_zero8_max_mb_v5p": max_mb(V5P_HBM_BYTES, tp_zero_fixed),
+            "fsdp8_fixed_gb": gb(fsdp_fixed),
+            "fsdp8_max_mb_v5p": max_mb(V5P_HBM_BYTES, fsdp_fixed),
         })
 
     return {
@@ -290,8 +314,9 @@ def main() -> None:
     print("| optimizer | opt state | 8B peak @mb=1 | 8B peak @mb=2 | "
           "max mb (v5e 16G) | max mb (v5p 95G) | ZeRO-1x8 fixed | "
           "ZeRO-1x8 max mb (v5p) | TP-8 fixed | TP-8 max mb (v5p) | "
-          "TP-8 x ZeRO-1x8 fixed | TP-8 x ZeRO max mb (v5p) |")
-    print("|---|---|---|---|---|---|---|---|---|---|---|---|")
+          "TP-8 x ZeRO-1x8 fixed | TP-8 x ZeRO max mb (v5p) | "
+          "FSDP-8 fixed | FSDP-8 max mb (v5p) |")
+    print("|---|---|---|---|---|---|---|---|---|---|---|---|---|---|")
     for row in r["optimizers"]:
         mbs = sorted(row["peak8b_gb"])
         print(
@@ -301,7 +326,8 @@ def main() -> None:
             f"| {row['zero1x8_fixed_gb']} GB | {row['zero1x8_max_mb_v5p']} "
             f"| {row['tp8_fixed_gb']} GB | {row['tp8_max_mb_v5p']} "
             f"| {row['tp8_zero8_fixed_gb']} GB "
-            f"| {row['tp8_zero8_max_mb_v5p']} |"
+            f"| {row['tp8_zero8_max_mb_v5p']} "
+            f"| {row['fsdp8_fixed_gb']} GB | {row['fsdp8_max_mb_v5p']} |"
         )
     import json
     print("\n```json")
