@@ -9,3 +9,8 @@ from distributeddataparallel_tpu.data.loader import (  # noqa: F401
     shard_batch,
     shard_lm_batch,
 )
+from distributeddataparallel_tpu.data.transforms import (  # noqa: F401
+    cifar_augment,
+    random_crop,
+    random_horizontal_flip,
+)

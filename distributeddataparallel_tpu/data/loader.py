@@ -114,6 +114,7 @@ class DataLoader:
         place_fn=None,
         workers: int = 0,
         with_mask: bool = False,
+        augment=None,
     ):
         """``place_fn(host_batch) -> device_batch`` overrides the default
         data-axis ``shard_batch`` placement (e.g. ``shard_lm_batch`` for
@@ -125,6 +126,11 @@ class DataLoader:
         overlaps input prep with the training loop.  Values > 1 are
         clamped to 1 (batch order is defined by a single producer) with
         a logged warning.
+
+        ``augment(batch, rng) -> batch`` applies training augmentation to
+        each host batch (``data.transforms``); its generator is derived
+        from (seed, epoch, step, host), so augmentation is deterministic
+        across reruns and --resume, and decorrelated across hosts.
 
         ``with_mask=True`` adds a ``"valid"`` key to every batch: a (rows,)
         float32 mask that is 0 exactly on sampler-padded duplicate rows
@@ -160,6 +166,7 @@ class DataLoader:
             workers = 1
         self.workers = workers
         self.with_mask = with_mask
+        self._augment = augment
         self._place_fn = place_fn or (
             lambda b: shard_batch(b, self.mesh, self.axis_name)
         )
@@ -238,6 +245,11 @@ class DataLoader:
                         smp.rank + p * smp.num_replicas < smp.dataset_len
                     )
             batch = self._gather(np.concatenate(rows))
+            if self._augment is not None:
+                rng = np.random.default_rng(
+                    (self.seed, 0xA06, self._epoch, step, self.host_id)
+                )
+                batch = self._augment(batch, rng)
             if self.with_mask:
                 batch["valid"] = np.concatenate(masks).astype(np.float32)
             yield batch

@@ -1,0 +1,73 @@
+"""Torchvision-style training augmentation, batch-vectorized.
+
+The reference composes only ToTensor + Normalize (ref dpp.py:32) — those
+live in ``datasets.normalize_images`` / the fused native u8 kernel.
+This module adds the standard CIFAR training recipe on top
+(``RandomCrop(32, padding=4)`` + ``RandomHorizontalFlip``), re-expressed
+for this loader's columnar batches: one vectorized NumPy op over the
+whole (B, H, W, C) batch instead of torchvision's per-sample PIL calls,
+driven by an explicit ``np.random.Generator`` so augmentation is a pure
+function of (seed, epoch, step) — deterministic across reruns AND across
+``--resume`` (the loader derives the generator the same way the per-step
+training RNG is derived).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def random_horizontal_flip(
+    images: np.ndarray, rng: np.random.Generator, p: float = 0.5
+) -> np.ndarray:
+    """Flip each sample's width axis with probability ``p``.
+    images: (B, H, W, C)."""
+    flip = rng.random(images.shape[0]) < p
+    out = images.copy()
+    out[flip] = out[flip, :, ::-1]
+    return out
+
+
+def random_crop(
+    images: np.ndarray,
+    rng: np.random.Generator,
+    padding: int = 4,
+    fill: float = -1.0,
+) -> np.ndarray:
+    """Pad by ``padding`` on each spatial side with ``fill``, then crop
+    back to the original size at a per-sample random offset.
+
+    ``fill=-1.0`` is black under the reference's Normalize((0.5,),(0.5,))
+    — torchvision pads the raw image with 0 BEFORE ToTensor/Normalize,
+    and this loader augments after normalization, so the fill must be
+    the normalized black, not 0 (mid-gray).
+    """
+    if padding == 0:
+        return images
+    B, H, W, C = images.shape
+    padded = np.pad(
+        images,
+        ((0, 0), (padding, padding), (padding, padding), (0, 0)),
+        constant_values=fill,
+    )
+    oy = rng.integers(0, 2 * padding + 1, B)
+    ox = rng.integers(0, 2 * padding + 1, B)
+    rows = oy[:, None] + np.arange(H)  # (B, H)
+    cols = ox[:, None] + np.arange(W)  # (B, W)
+    return padded[
+        np.arange(B)[:, None, None], rows[:, :, None], cols[:, None, :]
+    ]
+
+
+def cifar_augment(
+    batch: dict, rng: np.random.Generator, *,
+    crop_padding: int = 4, flip_p: float = 0.5, fill: float = -1.0,
+) -> dict:
+    """The standard CIFAR training recipe as a loader ``augment`` hook:
+    random crop (pad 4) + horizontal flip on the ``image`` column."""
+    out = dict(batch)
+    img = out["image"]
+    img = random_crop(img, rng, padding=crop_padding, fill=fill)
+    img = random_horizontal_flip(img, rng, p=flip_p)
+    out["image"] = img
+    return out

@@ -75,6 +75,10 @@ def parse_args(argv=None):
                    help="gradient accumulation (DDP no_sync analog)")
     p.add_argument("--workers", type=int, default=0,
                    help="background input-pipeline threads (0 = inline)")
+    p.add_argument("--augment", action="store_true",
+                   help="standard CIFAR training augmentation (random "
+                        "crop pad 4 + horizontal flip), deterministic per "
+                        "(seed, epoch, step); image datasets only")
     p.add_argument("--cp", type=int, default=1,
                    help="context-parallel degree: shard the sequence over "
                         "a 'seq' mesh axis with collective attention (LM only)")
@@ -264,6 +268,8 @@ def validate_args(args) -> None:
             raise SystemExit(
                 f"--fsdp v1 is pure data parallelism; drop {', '.join(bad)}"
             )
+    if args.augment and is_lm(args):
+        raise SystemExit("--augment is for image datasets only")
     if args.grad_clip is not None:
         if args.grad_clip <= 0:
             raise SystemExit("--grad-clip must be > 0")
@@ -457,10 +463,14 @@ def train(args) -> float:
     else:
         place_fn = None
     dataset = build_dataset(args, train=True)
+    augment = None
+    if args.augment:  # validated LM-free in validate_args
+        from distributeddataparallel_tpu.data import cifar_augment
+        augment = cifar_augment
     loader = DataLoader(
         dataset, per_replica_batch=args.batch_size, mesh=mesh,
         shuffle=True, seed=args.seed, place_fn=place_fn,
-        workers=args.workers,
+        workers=args.workers, augment=augment,
     )
 
     lm = is_lm(args)

@@ -198,16 +198,17 @@ def analyze(seq_len: int, microbatches=(1, 2)) -> dict:
         )
 
     TPN = 8
-    # FSDP-8 byte math (optimizer-independent parts, parallel/fsdp.py):
+    FSDPN = 8
+    # FSDP byte math (optimizer-independent parts, parallel/fsdp.py):
     # stored = per-chip params shards; the gathered non-layer flat and
     # ~2 gathered layers (current + backward regather) live full.
     from distributeddataparallel_tpu.parallel.fsdp import _Meta
 
-    meta = _Meta(full_cfg, 8)
+    meta = _Meta(full_cfg, FSDPN)
     layer_full = 4 * sum(
         l.size for l in jax.tree.leaves(meta.layer_template)
     )
-    rest_full = 4 * meta.rest_chunk * 8
+    rest_full = 4 * meta.rest_chunk * FSDPN
     fsdp_stored = 4 * (meta.L * meta.layer_chunk + meta.rest_chunk)
     rows = []
     for name, tx in (

@@ -1,0 +1,77 @@
+"""Augmentation tests: vectorized flip/crop semantics, determinism from
+the (seed, epoch, step) derivation, and the loader hook."""
+
+import numpy as np
+
+import distributeddataparallel_tpu as ddp
+from distributeddataparallel_tpu.data import (
+    ArrayDataset,
+    DataLoader,
+    cifar_augment,
+    random_crop,
+    random_horizontal_flip,
+)
+
+
+def _imgs(n=8, h=8, w=8, c=3, seed=0):
+    return np.random.default_rng(seed).normal(size=(n, h, w, c)).astype(
+        np.float32
+    )
+
+
+def test_flip_extremes_and_determinism(devices):
+    imgs = _imgs()
+    none = random_horizontal_flip(imgs, np.random.default_rng(0), p=0.0)
+    np.testing.assert_array_equal(none, imgs)
+    allf = random_horizontal_flip(imgs, np.random.default_rng(0), p=1.0)
+    np.testing.assert_array_equal(allf, imgs[:, :, ::-1])
+    a = random_horizontal_flip(imgs, np.random.default_rng(7), p=0.5)
+    b = random_horizontal_flip(imgs, np.random.default_rng(7), p=0.5)
+    np.testing.assert_array_equal(a, b)
+    assert not np.array_equal(a, imgs)  # 8 coin flips: all-heads ~0.4%
+
+
+def test_crop_offsets_and_fill(devices):
+    imgs = _imgs()
+    assert random_crop(imgs, np.random.default_rng(0), padding=0) is imgs
+    out = random_crop(imgs, np.random.default_rng(1), padding=2, fill=-1.0)
+    assert out.shape == imgs.shape
+    # Every output row is a contiguous window of the padded image: verify
+    # against a manual reconstruction with the same generator draws.
+    rng = np.random.default_rng(1)
+    oy = rng.integers(0, 5, 8)
+    ox = rng.integers(0, 5, 8)
+    padded = np.pad(
+        imgs, ((0, 0), (2, 2), (2, 2), (0, 0)), constant_values=-1.0
+    )
+    for i in range(8):
+        np.testing.assert_array_equal(
+            out[i], padded[i, oy[i] : oy[i] + 8, ox[i] : ox[i] + 8]
+        )
+
+
+def test_loader_augment_deterministic_and_epoch_varying(devices):
+    mesh = ddp.make_mesh(("data",))
+    ds = ArrayDataset(_imgs(64, seed=3), np.zeros(64, np.int32))
+
+    def batches(epoch):
+        loader = DataLoader(
+            ds, per_replica_batch=2, mesh=mesh, shuffle=False, seed=5,
+            augment=cifar_augment, device_feed=False,
+        )
+        loader.set_epoch(epoch)
+        return [b["image"].copy() for b in loader]
+
+    a0, b0 = batches(0), batches(0)
+    for x, y in zip(a0, b0):
+        np.testing.assert_array_equal(x, y)  # rerun-deterministic
+    a1 = batches(1)
+    assert any(not np.array_equal(x, y) for x, y in zip(a0, a1))
+
+    # Without augment, the same loader config yields the raw rows.
+    plain = DataLoader(
+        ds, per_replica_batch=2, mesh=mesh, shuffle=False, seed=5,
+        device_feed=False,
+    )
+    raw = next(iter(plain))["image"]
+    assert not np.array_equal(raw, a0[0])
