@@ -223,6 +223,7 @@ def make_fsdp_train_step(
     data_axis: str = "data",
     donate: bool = True,
     grad_clip: float | None = None,
+    accum_steps: int = 1,
 ):
     """Compiled FSDP train step for a scanned TransformerLM config.
 
@@ -232,7 +233,14 @@ def make_fsdp_train_step(
     forward gathers 1/N-sharded weights, computes, and discards; the
     backward re-gathers (``cfg.remat``) and reduce-scatters gradients —
     both directions emerge from AD of the all_gather, no hooks anywhere.
+
+    ``accum_steps`` accumulates microbatch gradients IN THE SHARDED
+    layout (each microbatch's reduce-scatter lands on the 1/N flats and
+    sums there) — like torch FSDP under no_sync, every microbatch still
+    re-gathers the weights; only the optimizer step is amortized.
     """
+    if accum_steps < 1:
+        raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
     from distributeddataparallel_tpu.models.transformer import (
         DecoderBlock,
         rope_frequencies,
@@ -261,7 +269,7 @@ def make_fsdp_train_step(
             else None
         )
 
-        def loss_fn(flat):
+        def loss_fn(flat, inputs, targets):
             rest_vec = lax.all_gather(
                 flat["rest"], data_axis, axis=0, tiled=True
             )
@@ -282,7 +290,39 @@ def make_fsdp_train_step(
             logits = _head(cfg, rest, x)
             return lm_cross_entropy(logits, targets)
 
-        loss, gflat = jax.value_and_grad(loss_fn)(state.params)
+        if accum_steps == 1:
+            loss, gflat = jax.value_and_grad(loss_fn)(
+                state.params, inputs, targets
+            )
+        else:
+            if inputs.shape[0] % accum_steps:
+                raise ValueError(
+                    f"per-replica batch {inputs.shape[0]} not divisible "
+                    f"by accum_steps={accum_steps}"
+                )
+            mb = inputs.shape[0] // accum_steps
+            mbs_in = inputs.reshape(accum_steps, mb, S)
+            mbs_tgt = targets.reshape(accum_steps, mb, S)
+
+            def acc_body(carry, xs):
+                acc_g, acc_l = carry
+                i, t = xs
+                l, g = jax.value_and_grad(loss_fn)(state.params, i, t)
+                return (
+                    jax.tree.map(jnp.add, acc_g, g), acc_l + l
+                ), None
+
+            # Grad shapes ARE the flat param shapes (no eval_shape trace).
+            zeros = jax.tree.map(
+                lambda p: jnp.zeros(p.shape, p.dtype), state.params
+            )
+            (gflat, loss), _ = lax.scan(
+                acc_body, (zeros, jnp.zeros((), jnp.float32)),
+                (mbs_in, mbs_tgt),
+            )
+            inv = 1.0 / accum_steps
+            gflat = jax.tree.map(lambda g: g * inv, gflat)
+            loss = loss * inv
         # The all_gather transpose SUMMED per-replica contributions into
         # each shard; divide for DDP mean semantics (global loss is the
         # mean of per-replica means).

@@ -260,7 +260,6 @@ def validate_args(args) -> None:
                 ("--zero", args.zero), ("--tp", args.tp > 1),
                 ("--pp", args.pp > 1), ("--cp", args.cp > 1),
                 ("--ep", args.ep > 1), ("--moe-experts", bool(args.moe_experts)),
-                ("--accum-steps", args.accum_steps > 1),
                 ("--bucket-mb", bool(args.bucket_mb)), ("--eval", args.eval),
             ) if on
         ]
@@ -622,7 +621,8 @@ def train(args) -> float:
         # the transformer into embed / layer scan / head around the
         # per-layer weight gathers).
         step_fn = ddp.make_fsdp_train_step(
-            model.cfg, mesh=mesh, grad_clip=args.grad_clip
+            model.cfg, mesh=mesh, grad_clip=args.grad_clip,
+            accum_steps=args.accum_steps,
         )
     elif args.pp > 1:
         # GPipe: the step factory takes the model CONFIG (it decomposes

@@ -149,3 +149,30 @@ def test_fsdp_guards(devices):
         _Meta(_cfg(scan_layers=False), 8)
     with pytest.raises(ValueError, match="pure data parallelism"):
         _Meta(dataclasses.replace(_cfg(), tp_axis="model"), 8)
+
+
+def test_fsdp_accum_matches_single_big_batch(devices):
+    """FSDP x gradient accumulation: 2 microbatches accumulated in the
+    sharded layout == the single big-batch FSDP step (and therefore the
+    single-device step, by test_fsdp_matches_single_device)."""
+    cfg = _cfg()
+    mesh = ddp.make_mesh(("data",))
+    rng = np.random.default_rng(5)
+    tokens = rng.integers(0, 256, size=(16, 17)).astype(np.int32)
+    params = _init_params(cfg)
+    tx = optax.sgd(0.1)
+    batch = shard_batch({"tokens": tokens}, mesh)
+
+    def run(accum):
+        state = fsdp_state(cfg, params, tx, mesh)
+        step = make_fsdp_train_step(
+            cfg, mesh=mesh, accum_steps=accum, donate=False
+        )
+        state, metrics = step(state, batch, jax.random.PRNGKey(0))
+        return float(metrics["loss"]), fsdp_gather_params(cfg, state, mesh)
+
+    loss1, p1 = run(1)
+    loss2, p2 = run(2)
+    assert loss1 == pytest.approx(loss2, rel=1e-6)
+    for a, b in zip(jax.tree.leaves(p1), jax.tree.leaves(p2)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)

@@ -163,3 +163,52 @@ def test_ulysses_train_step_matches_single_device(devices):
         jax.tree.leaves(state.params), jax.tree.leaves(params_ref)
     ):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_dp_ulysses_tp_matches_single_device(devices):
+    """DP(2) x CP(2, ulysses) x TP(2): the all_to_all operates on the
+    TP-local head shard (H/tp % n_seq must hold) — must equal the
+    single-device step."""
+    import dataclasses
+
+    mesh = ddp.make_mesh(("data", "seq", "model"), shape=(2, 2, 2))
+    cfg = tiny_lm(num_heads=4, num_kv_heads=2, d_model=32, d_ff=64,
+                  max_seq_len=32)
+    cfg_x = dataclasses.replace(
+        cfg, cp_axis="seq", cp_impl="ulysses", tp_axis="model"
+    )
+    model, model_x = TransformerLM(cfg), TransformerLM(cfg_x)
+    rng = np.random.default_rng(8)
+    tokens = rng.integers(0, 256, size=(4, 33)).astype(np.int32)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 32), jnp.int32)
+    )["params"]
+    tx = optax.sgd(0.1)
+
+    def ref_loss(p):
+        logits = model.apply({"params": p}, jnp.asarray(tokens[:, :-1]))
+        return lm_cross_entropy(logits, jnp.asarray(tokens[:, 1:]))
+
+    loss_ref, grads_ref = jax.value_and_grad(ref_loss)(params)
+    updates, _ = tx.update(grads_ref, tx.init(params), params)
+    params_ref = optax.apply_updates(params, updates)
+
+    def loss_fn(p, batch, rng):
+        logits = model_x.apply({"params": p}, batch["inputs"])
+        return lm_cross_entropy(logits, batch["targets"]), {}
+
+    state = ddp.TrainState.create(
+        apply_fn=model_x.apply, params=params, tx=tx
+    )
+    state = ddp.shard_state_tp(state, mesh)
+    step = ddp.make_train_step(
+        loss_fn, mesh=mesh, cp_axis="seq", tp_axis="model", donate=False
+    )
+    state, metrics = step(
+        state, shard_lm_batch(tokens, mesh), jax.random.PRNGKey(0)
+    )
+    assert float(metrics["loss"]) == pytest.approx(float(loss_ref), rel=1e-5)
+    for a, b in zip(
+        jax.tree.leaves(state.params), jax.tree.leaves(params_ref)
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
