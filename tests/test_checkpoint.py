@@ -431,3 +431,48 @@ def test_sigterm_preemption_checkpoint_and_resume(tmp_path, devices):
     assert res.returncode == 0, logs
     assert f"Epoch {saved_epoch + 1}," in logs, logs
     assert f"Epoch {saved_epoch}," not in logs, logs
+
+
+def test_checkpoint_resume_fsdp_sharded(tmp_path, devices):
+    """FSDP state (per-layer flat chunks + sharded opt state) survives
+    save -> restore with its 1/N layout intact, and resumed training
+    matches the uninterrupted run exactly."""
+    from jax.sharding import PartitionSpec as P
+
+    from distributeddataparallel_tpu.models import TransformerLM, tiny_lm
+    from distributeddataparallel_tpu.parallel.fsdp import (
+        fsdp_state,
+        make_fsdp_train_step,
+    )
+
+    cfg = tiny_lm(
+        num_layers=2, num_heads=2, d_model=32, d_ff=64, max_seq_len=32,
+        scan_layers=True,
+    )
+    mesh = ddp.make_mesh(("data",))
+    params = TransformerLM(cfg).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 16), jnp.int32)
+    )["params"]
+    rng = np.random.default_rng(23)
+    batches = [
+        shard_batch(
+            {"tokens": rng.integers(0, 256, size=(8, 17)).astype(np.int32)},
+            mesh,
+        )
+        for _ in range(4)
+    ]
+    tx = optax.adam(1e-2)
+
+    def fresh_state():
+        return fsdp_state(cfg, params, tx, mesh)
+
+    step = make_fsdp_train_step(cfg, mesh=mesh, donate=False)
+
+    def check(restored):
+        assert restored.params["layers"].sharding.spec == P(None, "data")
+        assert restored.params["rest"].sharding.spec == P("data")
+
+    _resume_matches_uninterrupted(
+        tmp_path, "fsdp", step, fresh_state, batches,
+        jax.random.PRNGKey(3), check_restored=check,
+    )
