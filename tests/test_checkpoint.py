@@ -471,6 +471,14 @@ def test_checkpoint_resume_fsdp_sharded(tmp_path, devices):
     def check(restored):
         assert restored.params["layers"].sharding.spec == P(None, "data")
         assert restored.params["rest"].sharding.spec == P("data")
+        # Opt state keeps its 1/N layout too — a silently-replicated
+        # restore would defeat the ZeRO-3 memory property while still
+        # matching leaf values.
+        for l in jax.tree.leaves(restored.opt_state):
+            if l.ndim == 2:
+                assert l.sharding.spec == P(None, "data"), l.sharding
+            elif l.ndim == 1:
+                assert l.sharding.spec == P("data"), l.sharding
 
     _resume_matches_uninterrupted(
         tmp_path, "fsdp", step, fresh_state, batches,
