@@ -267,3 +267,106 @@ def test_spawn_two_process_dp_tp_step(tmp_path, devices):
     logits = model.apply({"params": params}, jnp.asarray(tokens[:, :-1]))
     ref = float(lm_cross_entropy(logits, jnp.asarray(tokens[:, 1:])))
     assert results[0]["loss"] == pytest.approx(ref, rel=1e-5)
+
+
+def _mp_fsdp_worker(process_id, tmpdir):
+    """Child of test_spawn_two_process_fsdp_step: FSDP state built over a
+    GLOBAL 2-host mesh (device_put with a cross-process NamedSharding),
+    one step, gathered-param checksum written per rank."""
+    import json
+    import os
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 2)
+
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    import distributeddataparallel_tpu as ddp
+    from distributeddataparallel_tpu.data.loader import shard_batch
+    from distributeddataparallel_tpu.models import TransformerLM, tiny_lm
+
+    ddp.init_process_group("cpu")
+    mesh = ddp.make_mesh(("data",))  # global 4-way
+    cfg = tiny_lm(
+        num_layers=2, num_heads=2, d_model=32, d_ff=64, max_seq_len=32,
+        scan_layers=True,
+    )
+    params = TransformerLM(cfg).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 16), jnp.int32)
+    )["params"]
+    tokens = np.random.default_rng(0).integers(
+        0, 256, size=(8, 17)
+    ).astype(np.int32)
+
+    state = ddp.fsdp_state(cfg, params, optax.sgd(0.1), mesh)
+    step = ddp.make_fsdp_train_step(cfg, mesh=mesh, donate=False)
+    state, metrics = step(
+        state, shard_batch({"tokens": tokens}, mesh), jax.random.PRNGKey(1)
+    )
+    got = ddp.fsdp_gather_params(cfg, state, mesh)
+    checksum = sum(
+        float(jnp.sum(l.astype(jnp.float32))) for l in jax.tree.leaves(got)
+    )
+    with open(os.path.join(tmpdir, f"fsdp{process_id}.json"), "w") as f:
+        json.dump({"loss": float(metrics["loss"]), "checksum": checksum}, f)
+    ddp.destroy_process_group()
+
+
+def test_spawn_two_process_fsdp_step(tmp_path, devices):
+    """FSDP across real OS processes: the 1/N flats span BOTH hosts'
+    devices; one step must equal the single-device reference on the same
+    global batch (loss and gathered-params checksum, both ranks agreeing)."""
+    import json
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from distributeddataparallel_tpu.models import TransformerLM, tiny_lm
+    from distributeddataparallel_tpu.ops import lm_cross_entropy
+
+    procs = spawn(_mp_fsdp_worker, args=(str(tmp_path),), nprocs=2, join=False)
+    for p in procs:
+        p.join(timeout=240)
+    codes = [p.exitcode for p in procs]
+    for p in procs:
+        if p.is_alive():
+            p.terminate()  # don't let a hung rank wedge the pytest exit
+    assert codes == [0, 0], f"child exit codes {codes}"
+
+    results = [
+        json.load(open(tmp_path / f"fsdp{r}.json")) for r in range(2)
+    ]
+    assert results[0] == results[1], results
+
+    cfg = tiny_lm(
+        num_layers=2, num_heads=2, d_model=32, d_ff=64, max_seq_len=32,
+        scan_layers=True,
+    )
+    model = TransformerLM(cfg)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 16), jnp.int32)
+    )["params"]
+    tokens = np.random.default_rng(0).integers(
+        0, 256, size=(8, 17)
+    ).astype(np.int32)
+
+    def ref_loss(p):
+        logits = model.apply({"params": p}, jnp.asarray(tokens[:, :-1]))
+        return lm_cross_entropy(logits, jnp.asarray(tokens[:, 1:]))
+
+    loss_ref, grads = jax.value_and_grad(ref_loss)(params)
+    tx = optax.sgd(0.1)
+    updates, _ = tx.update(grads, tx.init(params), params)
+    ref_params = optax.apply_updates(params, updates)
+    ref_checksum = sum(
+        float(jnp.sum(l.astype(jnp.float32)))
+        for l in jax.tree.leaves(ref_params)
+    )
+    assert results[0]["loss"] == pytest.approx(float(loss_ref), rel=1e-5)
+    assert results[0]["checksum"] == pytest.approx(ref_checksum, rel=1e-5)
