@@ -360,8 +360,19 @@ def make_pp_train_step(
     donate: bool = True,
     grad_sync: bool = True,
     moe_aux_weight: float = 0.01,
+    zero: bool = False,
 ):
     """Compiled DP x PP train step for a scanned TransformerLM config.
+
+    ``zero=True``: ZeRO-1 over the data axis on the PIPE-LOCAL param
+    shards — after the pipe psum completes every gradient, each
+    position's local tree (its layer slice + the replicated leaves) is
+    flattened, reduce-scattered over ``data_axis``, updated on the 1/N
+    chunk, and gathered back.  Local sizes are uniform along the data
+    axis and flat offsets identical across pipe positions, so the
+    elementwise update keeps pipe-replicated leaves in lockstep — the
+    same argument as ZeRO x TP.  Build the state with
+    ``zero_state(..., pp_axis=...)``.
 
     ``step(state, batch, rng) -> (state, metrics)`` with
     ``batch = {"tokens": (B, S+1) int32}`` sharded over ``data_axis``
@@ -395,6 +406,10 @@ def make_pp_train_step(
         raise ValueError("pipeline parallelism requires scan_layers=True")
     if cfg.dropout_rate:
         raise ValueError("pipeline v1 does not support dropout")
+    if zero and not grad_sync:
+        # Same contract as make_train_step: the ZeRO reduce_scatter IS
+        # the sync — it cannot be skipped.
+        raise ValueError("grad_sync=False does not compose with zero=True")
     n_stages = mesh.shape[pp_axis]
     M = microbatches
     stack = _stage_stack(cfg, n_stages)
@@ -508,9 +523,19 @@ def make_pp_train_step(
                 lambda g: lax.pmean(g, cfg.cp_axis), grads
             )
             loss = lax.pmean(loss, cfg.cp_axis)
-        if grad_sync:
-            grads = all_reduce_gradients(grads, data_axis, op="mean")
-        new_state = state.apply_gradients(grads)
+        if zero:
+            from distributeddataparallel_tpu.parallel.zero import zero_update
+
+            new_params, new_opt = zero_update(
+                grads, state, data_axis, mesh.shape[data_axis]
+            )
+            new_state = state.replace(
+                step=state.step + 1, params=new_params, opt_state=new_opt
+            )
+        else:
+            if grad_sync:
+                grads = all_reduce_gradients(grads, data_axis, op="mean")
+            new_state = state.apply_gradients(grads)
         return new_state, {"loss": lax.pmean(loss, data_axis)}
 
     compiled = None
@@ -527,7 +552,18 @@ def make_pp_train_step(
     def step(state, batch, rng):
         nonlocal compiled
         if compiled is None:
-            specs = pp_state_specs(state, pp_axis, cfg.tp_axis, cfg.ep_axis)
+            if zero:
+                from distributeddataparallel_tpu.parallel.zero import (
+                    state_specs,
+                )
+
+                specs = state_specs(
+                    state, data_axis, cfg.tp_axis, cfg.ep_axis, pp_axis
+                )
+            else:
+                specs = pp_state_specs(
+                    state, pp_axis, cfg.tp_axis, cfg.ep_axis
+                )
             sharded = jax.shard_map(
                 _step,
                 mesh=mesh,

@@ -76,6 +76,7 @@ def _leaf_spec(
     axis_name: str,
     tp_axis: str | None = None,
     ep_axis: str | None = None,
+    pp_axis: str | None = None,
 ):
     """The ZeRO layout rule, in one place: vector state (flat momentum,
     mu/nu chunks) is sharded along the data axis — jointly with any
@@ -85,7 +86,7 @@ def _leaf_spec(
     if getattr(leaf, "ndim", 0) < 1:
         return P()
     axes = (axis_name,) + tuple(
-        a for a in (tp_axis, ep_axis) if a is not None
+        a for a in (tp_axis, ep_axis, pp_axis) if a is not None
     )
     return P(axes if len(axes) > 1 else axis_name)
 
@@ -96,22 +97,34 @@ def opt_state_specs(
     axis_name: str = "data",
     tp_axis: str | None = None,
     ep_axis: str | None = None,
+    pp_axis: str | None = None,
 ) -> Pytree:
     """PartitionSpec tree for a tx.init over a flat chunk."""
     shapes = jax.eval_shape(
         tx.init, jax.ShapeDtypeStruct((chunk,), jnp.float32)
     )
     return jax.tree.map(
-        lambda s: _leaf_spec(s, axis_name, tp_axis, ep_axis), shapes
+        lambda s: _leaf_spec(s, axis_name, tp_axis, ep_axis, pp_axis), shapes
     )
 
 
 def _param_specs(
-    params: Pytree, tp_axis: str | None, ep_axis: str | None = None
+    params: Pytree,
+    tp_axis: str | None,
+    ep_axis: str | None = None,
+    pp_axis: str | None = None,
 ) -> Pytree:
     """Param layout for the ZeRO machinery: replicated, or the combined
-    Megatron/expert layout when composing with TP/EP — the ONE spec
-    source shared by init, state build, and the train step's in_specs."""
+    Megatron/expert layout when composing with TP/EP — and the stacked
+    layer-dim pipeline layout (Megatron/expert rules composing
+    underneath) when composing with PP.  The ONE spec source shared by
+    init, state build, and the train step's in_specs."""
+    if pp_axis is not None:
+        from distributeddataparallel_tpu.parallel.pipeline_parallel import (
+            pp_param_specs,
+        )
+
+        return pp_param_specs(params, pp_axis, tp_axis, ep_axis)
     from distributeddataparallel_tpu.parallel.expert_parallel import (
         model_axes_param_specs,
     )
@@ -147,6 +160,7 @@ def shard_opt_state(
     axis_name: str = "data",
     tp_axis: str | None = None,
     ep_axis: str | None = None,
+    pp_axis: str | None = None,
 ) -> Pytree:
     """Initialize optimizer state sharded 1/N per mesh position.
 
@@ -158,7 +172,7 @@ def shard_opt_state(
     all the axis sizes per chip).
     """
     n = mesh.shape[axis_name]
-    pspecs = _param_specs(params, tp_axis, ep_axis)
+    pspecs = _param_specs(params, tp_axis, ep_axis, pp_axis)
     chunk = _local_chunk(params, pspecs, mesh, n)
 
     def init_shard(p):
@@ -172,7 +186,9 @@ def shard_opt_state(
             init_shard,
             mesh=mesh,
             in_specs=(pspecs,),
-            out_specs=opt_state_specs(tx, chunk, axis_name, tp_axis, ep_axis),
+            out_specs=opt_state_specs(
+                tx, chunk, axis_name, tp_axis, ep_axis, pp_axis
+            ),
             check_vma=False,
         )
     )
@@ -188,6 +204,7 @@ def zero_state(
     axis_name: str = "data",
     tp_axis: str | None = None,
     ep_axis: str | None = None,
+    pp_axis: str | None = None,
     model_state: Pytree | None = None,
 ):
     """Build a TrainState whose optimizer state is ZeRO-sharded.
@@ -201,13 +218,13 @@ def zero_state(
     from distributeddataparallel_tpu.training.state import TrainState
 
     step = jnp.zeros((), jnp.int32)
-    if tp_axis is not None or ep_axis is not None:
+    if tp_axis is not None or ep_axis is not None or pp_axis is not None:
         from jax.sharding import NamedSharding
 
         params = jax.tree.map(
             lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
             params,
-            _param_specs(params, tp_axis, ep_axis),
+            _param_specs(params, tp_axis, ep_axis, pp_axis),
         )
         # Scalars ride the mesh replicated too: a checkpoint restore uses
         # the template's shardings leaf-for-leaf, and a single-device
@@ -218,7 +235,7 @@ def zero_state(
         step=step,
         params=params,
         opt_state=shard_opt_state(
-            params, tx, mesh, axis_name, tp_axis, ep_axis
+            params, tx, mesh, axis_name, tp_axis, ep_axis, pp_axis
         ),
         model_state=model_state if model_state is not None else {},
         apply_fn=apply_fn,
@@ -280,17 +297,18 @@ def state_specs(
     axis_name: str = "data",
     tp_axis: str | None = None,
     ep_axis: str | None = None,
+    pp_axis: str | None = None,
 ) -> Pytree:
     """Per-leaf PartitionSpec tree for a ZeRO TrainState: everything
     replicated except the flat (ndim>=1) optimizer-state vectors — and,
-    under ``tp_axis``/``ep_axis``, the Megatron/expert-sharded params."""
+    under ``tp_axis``/``ep_axis``/``pp_axis``, the sharded params."""
     opt_specs = jax.tree.map(
-        lambda l: _leaf_spec(l, axis_name, tp_axis, ep_axis),
+        lambda l: _leaf_spec(l, axis_name, tp_axis, ep_axis, pp_axis),
         state.opt_state,
     )
     return state.replace(
         step=P(),
-        params=_param_specs(state.params, tp_axis, ep_axis),
+        params=_param_specs(state.params, tp_axis, ep_axis, pp_axis),
         opt_state=opt_specs,
         model_state=jax.tree.map(lambda _: P(), state.model_state),
     )

@@ -237,8 +237,6 @@ def validate_args(args) -> None:
     if args.pp > 1:
         if not is_lm(args):
             raise SystemExit("--pp requires an LM model (--model gpt2|llama)")
-        if args.zero:
-            raise SystemExit("--pp does not compose with --zero")
         if args.eval and args.cp > 1:
             raise SystemExit("--pp --eval does not support --cp")
         if args.accum_steps > 1:
@@ -512,15 +510,16 @@ def train(args) -> float:
             model.cfg, params, tx, mesh, apply_fn=model.apply
         )
     elif args.zero:
-        # With --tp/--ep, zero_state places params in the Megatron/expert
+        # With --tp/--ep/--pp, zero_state places params in the sharded
         # layout itself and shards the flat opt state over ALL the axes.
-        if args.tp == 1 and args.ep == 1:
+        if args.tp == 1 and args.ep == 1 and args.pp == 1:
             params = ddp.broadcast_params(params, mesh)
         model_state = ddp.broadcast_params(model_state, mesh)
         state = ddp.zero_state(
             apply_fn=model.apply, params=params, tx=tx, mesh=mesh,
             tp_axis="model" if args.tp > 1 else None,
             ep_axis="expert" if args.ep > 1 else None,
+            pp_axis="pipe" if args.pp > 1 else None,
             model_state=model_state,
         )
     elif args.pp > 1:
@@ -640,7 +639,7 @@ def train(args) -> float:
                 f"divisible by --pp {args.pp}"
             )
         step_fn = ddp.make_pp_train_step(
-            model.cfg, mesh=mesh, microbatches=M,
+            model.cfg, mesh=mesh, microbatches=M, zero=args.zero,
             moe_aux_weight=args.moe_aux_weight if args.moe_experts else 0.0,
         )
     else:
