@@ -96,11 +96,13 @@ class TransformerConfig:
     # exclusive with cp_axis (sequence-sharded training) and remat.
     decode: bool = False
     # Mixture-of-experts: replace every block's MLP with `moe_experts`
-    # switch-routed (top-1) expert MLPs.  `ep_axis` shards the expert
-    # dimension over a mesh axis (parallel.expert_parallel) — each
-    # position computes its E/n local experts over all tokens (dense
-    # einsum dispatch, MXU-friendly) and the combine is one psum.
+    # expert MLPs routed top-`moe_top_k` (1 = switch, 2 = Mixtral-style
+    # with renormalized gates).  `ep_axis` shards the expert dimension
+    # over a mesh axis (parallel.expert_parallel) — each position
+    # computes its E/n local experts over all tokens (dense einsum
+    # dispatch, MXU-friendly) and the combine is one psum.
     moe_experts: int = 0
+    moe_top_k: int = 1
     ep_axis: str | None = None
 
     @property
@@ -366,17 +368,24 @@ class MLP(nn.Module):
 
 
 class MoEMLP(nn.Module):
-    """Switch-style top-1 mixture-of-experts MLP with dense einsum
-    dispatch: every token's hidden state is pushed through each LOCAL
-    expert as one batched einsum (MXU-friendly — no gather/scatter), and
-    the router's one-hot gate selects the matching expert's output.
+    """Top-k-routed mixture-of-experts MLP with dense einsum dispatch:
+    every token's hidden state is pushed through each LOCAL expert as
+    one batched einsum (MXU-friendly — no gather/scatter), and a dense
+    (B, S, E) combine-weight tensor selects/blends the outputs.
+
+    Routing: ``cfg.moe_top_k == 1`` is the Switch convention (the raw
+    top probability gates the output — that dependence is what trains
+    the router); ``k > 1`` is Mixtral-style (probabilities renormalized
+    over the selected k, gradients flow through the renormalization).
 
     Under expert parallelism (``cfg.ep_axis``) each mesh position holds
-    ``moe_experts / ep`` experts; the masked combine is completed with
-    one psum (``reduce_from_tp``), and activations enter through the
-    copy operator so replicated-parameter gradients (the router's
-    included) come out complete — the same conjugate-operator pattern as
-    tensor parallelism.
+    ``moe_experts / ep`` experts and combines with ITS slice of the
+    weight tensor; the partial sum is completed with one psum
+    (``reduce_from_tp``).  Both the activations AND the combine weights
+    enter the expert region through the copy operator — the weights
+    carry the router's gradient path, and without the copy's backward
+    psum the replicated router grads would come out per-position
+    partial.
     """
 
     cfg: TransformerConfig
@@ -390,35 +399,37 @@ class MoEMLP(nn.Module):
         )
 
         cfg = self.cfg
-        E = cfg.moe_experts
+        E, K = cfg.moe_experts, cfg.moe_top_k
         n_ep = tp_size(cfg.ep_axis)
         if E % n_ep:
             raise ValueError(f"ep={n_ep} must divide moe_experts={E}")
+        if not 1 <= K <= E:
+            raise ValueError(f"moe_top_k={K} must be in [1, {E}]")
         El = E // n_ep
         d, f = cfg.d_model, cfg.d_ff
 
         # Router runs replicated (its params are tiny); f32 for a stable
-        # softmax.  Top-1 ("switch") routing: the gate probability
-        # multiplies the expert output, which is what lets gradients
-        # train the router.
+        # softmax.
         logits = nn.Dense(
             E, dtype=jnp.float32, use_bias=False, name="router",
             kernel_init=nn.initializers.normal(0.02),
         )(x.astype(jnp.float32))
         probs = jax.nn.softmax(logits, axis=-1)        # (B, S, E)
-        expert_idx = jnp.argmax(probs, axis=-1)        # (B, S)
-        gate = jnp.max(probs, axis=-1)                 # (B, S)
+        vals, idx = jax.lax.top_k(probs, K)            # (B, S, K)
+        if K > 1:
+            vals = vals / jnp.sum(vals, axis=-1, keepdims=True)
+        sel = jax.nn.one_hot(idx, E, dtype=jnp.float32)  # (B, S, K, E)
+        # Dense combine weights: w[b,s,e] = this token's gate for expert
+        # e (0 off the top-k).
+        w = jnp.sum(sel * vals[..., None], axis=2)     # (B, S, E)
 
-        # Switch load-balance auxiliary (Fedus et al.): E * sum_e f_e*P_e,
-        # f_e = fraction of tokens routed to expert e (stop-grad via
-        # argmax), P_e = mean router probability.  Minimized at uniform
-        # routing; without it top-1 routing can collapse onto one expert.
-        # Computed replicated (router side) and exposed through sow —
-        # loss_fns opt in with apply(..., mutable=["intermediates"]) and
-        # add moe_aux * weight to the loss (the dpp.py CLI does).
-        frac = jnp.mean(
-            jax.nn.one_hot(expert_idx, E, dtype=jnp.float32), axis=(0, 1)
-        )
+        # Load-balance auxiliary (Fedus et al. / GShard): E * sum f_e*P_e,
+        # f_e = fraction of routing slots assigned to expert e (stop-grad
+        # via top_k), P_e = mean router probability.  Minimized at
+        # uniform routing; exposed through sow — loss_fns opt in with
+        # apply(..., mutable=["intermediates"]) and add moe_aux * weight
+        # (the dpp.py CLI does).
+        frac = jnp.mean(sel, axis=(0, 1, 2))           # sums to 1/... per slot
         self.sow(
             "intermediates", "moe_aux",
             E * jnp.sum(frac * probs.mean(axis=(0, 1))),
@@ -426,6 +437,7 @@ class MoEMLP(nn.Module):
 
         if cfg.ep_axis is not None and n_ep > 1:
             x = copy_to_tp(x, cfg.ep_axis)
+            w = copy_to_tp(w, cfg.ep_axis)
         init = nn.initializers.normal(0.02)
         w_up = self.param("experts_up", init, (El, d, f), jnp.float32)
         w_down = self.param("experts_down", init, (El, f, d), jnp.float32)
@@ -445,23 +457,21 @@ class MoEMLP(nn.Module):
             "ebsf,efd->ebsd", h, w_down.astype(cfg.dtype)
         )  # (El, B, S, d)
 
-        # One-hot combine: local expert e is global expert ep_rank*El + e.
-        # Only the 0/1 mask lives inside the expert region; the gate
-        # multiply happens AFTER the psum, where the computation is
-        # replicated — otherwise the router's backward contribution
-        # (through d gate and d logits) would be per-position partial
-        # and the replicated router/attention grads would come out wrong.
+        # Local combine: this position's experts are global
+        # [ep_rank*El, (ep_rank+1)*El); slice the weight tensor to match
+        # and blend, then complete the partial sum over the expert axis.
         first = (
             jax.lax.axis_index(cfg.ep_axis) * El
             if cfg.ep_axis is not None and n_ep > 1
             else 0
         )
-        eid = first + jnp.arange(El)                   # (El,)
-        mask = (expert_idx[None] == eid[:, None, None]).astype(cfg.dtype)
-        out = jnp.einsum("ebsd,ebs->bsd", y, mask)
+        w_local = jax.lax.dynamic_slice_in_dim(w, first, El, axis=2)
+        out = jnp.einsum(
+            "ebsd,bse->bsd", y, w_local.astype(cfg.dtype)
+        )
         if cfg.ep_axis is not None and n_ep > 1:
             out = reduce_from_tp(out, cfg.ep_axis)
-        return out * gate[..., None].astype(cfg.dtype)
+        return out
 
 
 class DecoderBlock(nn.Module):

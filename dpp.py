@@ -99,8 +99,11 @@ def parse_args(argv=None):
     p.add_argument("--pp-microbatches", type=int, default=None,
                    help="GPipe microbatches per step (default: --pp)")
     p.add_argument("--moe-experts", type=int, default=0,
-                   help="replace every block's MLP with N switch-routed "
-                        "(top-1) experts (LM only)")
+                   help="replace every block's MLP with N routed experts "
+                        "(LM only)")
+    p.add_argument("--moe-top-k", type=int, default=1,
+                   help="experts per token: 1 = switch routing, "
+                        "2 = Mixtral-style renormalized top-2")
     p.add_argument("--ep", type=int, default=1,
                    help="expert-parallel degree: shard MoE experts over "
                         "an 'expert' mesh axis (requires --moe-experts)")
@@ -284,6 +287,12 @@ def validate_args(args) -> None:
             )
     if args.moe_experts and not is_lm(args):
         raise SystemExit("--moe-experts requires an LM model")
+    if args.moe_experts and not 1 <= args.moe_top_k <= args.moe_experts:
+        raise SystemExit(
+            f"--moe-top-k {args.moe_top_k} must be in [1, {args.moe_experts}]"
+        )
+    if args.moe_top_k != 1 and not args.moe_experts:
+        raise SystemExit("--moe-top-k requires --moe-experts")
     if args.ep > 1:
         if not args.moe_experts:
             raise SystemExit("--ep requires --moe-experts")
@@ -331,6 +340,7 @@ def build_model(args, num_classes: int = 10, vocab_size: int | None = None):
             overrides["scan_layers"] = True
         if args.moe_experts:
             overrides["moe_experts"] = args.moe_experts
+            overrides["moe_top_k"] = args.moe_top_k
         if args.ep > 1:
             overrides["ep_axis"] = "expert"
         if args.layers:

@@ -487,3 +487,87 @@ def test_cp_ep_zero_matches_replicated(devices):
         jax.tree.leaves(state.params), jax.tree.leaves(zstate.params)
     ):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-6)
+
+
+def test_dp_ep_top2_matches_single_device(devices):
+    """Mixtral-style top-2 routing (renormalized gates) under DP(2) x
+    EP(4) == the single-device top-2 computation, adam state included."""
+    cfg = _moe_cfg(moe_top_k=2)
+    cfg_ep = dataclasses.replace(cfg, ep_axis="expert")
+    mesh = ddp.make_mesh(("data", "expert"), shape=(2, 4))
+    model, model_ep = TransformerLM(cfg), TransformerLM(cfg_ep)
+    rng = np.random.default_rng(29)
+    tokens = rng.integers(0, 256, size=(4, 33)).astype(np.int32)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 32), jnp.int32)
+    )["params"]
+    tx = optax.adam(1e-2)
+
+    def ref_loss(p):
+        logits = model.apply({"params": p}, jnp.asarray(tokens[:, :-1]))
+        return lm_cross_entropy(logits, jnp.asarray(tokens[:, 1:]))
+
+    loss_ref, grads_ref = jax.value_and_grad(ref_loss)(params)
+    updates, _ = tx.update(grads_ref, tx.init(params), params)
+    params_ref = optax.apply_updates(params, updates)
+
+    def loss_fn(p, batch, rng):
+        toks = batch["tokens"]
+        logits = model_ep.apply({"params": p}, toks[:, :-1])
+        return lm_cross_entropy(logits, toks[:, 1:]), {}
+
+    state = ddp.TrainState.create(apply_fn=model_ep.apply, params=params, tx=tx)
+    state = ddp.shard_state_ep(state, mesh)
+    step = ddp.make_train_step(
+        loss_fn, mesh=mesh, ep_axis="expert", donate=False
+    )
+    state, metrics = step(
+        state, shard_batch({"tokens": tokens}, mesh), jax.random.PRNGKey(0)
+    )
+    assert float(metrics["loss"]) == pytest.approx(float(loss_ref), rel=1e-5)
+    for (path, a), b in zip(
+        jax.tree_util.tree_flatten_with_path(state.params)[0],
+        jax.tree.leaves(params_ref),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=2e-5,
+            err_msg="/".join(str(getattr(k, "key", k)) for k in path),
+        )
+
+
+def test_top2_output_is_renormalized_blend(devices):
+    """The module's OUTPUT equals the renormalized-top-2 blend of the
+    per-expert MLP outputs, computed independently from the raw params
+    (a K regression — e.g. silently reverting to top-1 or skipping the
+    renormalization — fails this)."""
+    import flax.linen as nn_
+
+    from distributeddataparallel_tpu.models.transformer import MoEMLP
+
+    cfg = _moe_cfg(moe_top_k=2, num_layers=1)
+    params = TransformerLM(cfg).init(
+        jax.random.PRNGKey(0),
+        jax.random.randint(jax.random.PRNGKey(3), (2, 8), 0, 256),
+    )["params"]
+    mp = params["layer_0"]["mlp"]
+    x = jax.random.normal(jax.random.PRNGKey(4), (2, 8, cfg.d_model))
+    got = MoEMLP(cfg).apply({"params": mp}, x)
+
+    # Independent reconstruction (tiny_lm default activation: swiglu).
+    logits = x.astype(jnp.float32) @ mp["router"]["kernel"]
+    probs = jax.nn.softmax(logits, axis=-1)
+    vals, idx = jax.lax.top_k(probs, 2)
+    vals = vals / vals.sum(-1, keepdims=True)
+    w = jnp.sum(
+        jax.nn.one_hot(idx, cfg.moe_experts) * vals[..., None], axis=2
+    )
+    h = jnp.einsum("bsd,edf->ebsf", x, mp["experts_up"])
+    g = jnp.einsum("bsd,edf->ebsf", x, mp["experts_gate"])
+    y = jnp.einsum("ebsf,efd->ebsd", nn_.silu(g) * h, mp["experts_down"])
+    want = jnp.einsum("ebsd,bse->bsd", y, w)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=1e-5
+    )
+    np.testing.assert_allclose(np.asarray(w.sum(-1)), 1.0, rtol=1e-6)
+    # Exactly two experts carry weight per token.
+    assert int((np.asarray(w) > 0).sum(-1).max()) == 2
