@@ -261,7 +261,7 @@ def validate_args(args) -> None:
                 ("--zero", args.zero), ("--tp", args.tp > 1),
                 ("--pp", args.pp > 1), ("--cp", args.cp > 1),
                 ("--ep", args.ep > 1), ("--moe-experts", bool(args.moe_experts)),
-                ("--bucket-mb", bool(args.bucket_mb)), ("--eval", args.eval),
+                ("--bucket-mb", bool(args.bucket_mb)),
             ) if on
         ]
         if bad:
@@ -666,6 +666,14 @@ def train(args) -> float:
             grad_clip=args.grad_clip,
         )
 
+    def full_params():
+        """The replicated param tree for eval/generate: under FSDP the
+        sharded flats are gathered back to the model layout (reads the
+        CURRENT state)."""
+        if args.fsdp:
+            return ddp.fsdp_gather_params(model.cfg, state, mesh)
+        return state.params
+
     ckpt = None
     start_epoch = 0
     preempted = {"signal": None}
@@ -884,14 +892,21 @@ def train(args) -> float:
             # Masked eval: each step returns (masked means, valid-row
             # count); weighting means by counts is exactly the mean over
             # unique samples — sampler pad duplicates contribute nothing.
+            # FSDP: gather the replicated param tree ONCE per epoch (the
+            # sharded flats are not the model layout the eval applies).
+            eval_params = full_params()
             evals = []
             for b in eval_loader:
                 m, cnt = (
-                    eval_step(state.params, state.model_state, b)
+                    eval_step(eval_params, state.model_state, b)
                     if has_ms and not cp
-                    else eval_step(state.params, b)
+                    else eval_step(eval_params, b)
                 )
                 evals.append((m, float(cnt)))
+            # Free the gathered copy NOW — keeping a full replicated
+            # param tree alive through the next training epoch would
+            # undo exactly the memory FSDP shards away.
+            del eval_params
             if evals:
                 total = sum(n for _, n in evals)
                 mean = {
@@ -918,11 +933,7 @@ def train(args) -> float:
             dataset.tokens[:2, : max(args.seq_len // 4, 1)], jnp.int32
         )
         n_new = min(args.generate, model.cfg.max_seq_len - prompt.shape[1])
-        gen_params = (
-            ddp.fsdp_gather_params(model.cfg, state, mesh)
-            if args.fsdp else state.params
-        )
-        out = _gen(model, gen_params, prompt, n_new)
+        out = _gen(model, full_params(), prompt, n_new)
         log0("generate: prompt %s -> %s (last 8 tokens: %s)",
              prompt.shape, out.shape, np.asarray(out[0, -8:]).tolist())
 
