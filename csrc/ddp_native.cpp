@@ -95,6 +95,52 @@ void ddp_chw_to_hwc_f32(const float* src, int64_t n, int64_t c, int64_t h,
   });
 }
 
+// Fused training-augmentation gather: for each output row i,
+//   out[i] = normalize(flip_i(crop_i(src[idx[i]])))
+// in ONE pass over the uint8 source — the gather, the RandomCrop(pad)
+// (virtual padding: out-of-bounds source pixels become `fill`, already
+// in normalized units), the optional horizontal flip, and the
+// ToTensor+Normalize transform never materialize intermediates.
+// Layout: src (N, H, W, C) u8; oy/ox in [0, 2*pad]; flip 0/1 per row.
+// Crop-then-flip order matches data/transforms.py: the flipped output
+// pixel (y, x) reads the crop at (y, w-1-x).
+void ddp_gather_augment_u8(const uint8_t* src, const int64_t* idx,
+                           int64_t n_idx, int64_t h, int64_t w, int64_t c,
+                           const int64_t* oy, const int64_t* ox,
+                           const uint8_t* flip, int64_t pad, float shift,
+                           float scale, float fill, float* out,
+                           int max_threads) {
+  const float inv255 = 1.0f / 255.0f;
+  const float inv_scale = 1.0f / scale;
+  parallel_for(n_idx, max_threads, [=](int64_t b, int64_t e) {
+    for (int64_t i = b; i < e; ++i) {
+      const uint8_t* img = src + idx[i] * h * w * c;
+      float* o = out + i * h * w * c;
+      const int64_t dy = oy[i] - pad;
+      const int64_t dx = ox[i] - pad;
+      const bool fl = flip[i] != 0;
+      for (int64_t y = 0; y < h; ++y) {
+        const int64_t sy = y + dy;
+        const bool row_ok = sy >= 0 && sy < h;
+        for (int64_t x = 0; x < w; ++x) {
+          const int64_t cx = fl ? (w - 1 - x) : x;
+          const int64_t sx = cx + dx;
+          float* op = o + (y * w + x) * c;
+          if (row_ok && sx >= 0 && sx < w) {
+            const uint8_t* sp = img + (sy * w + sx) * c;
+            for (int64_t ch = 0; ch < c; ++ch) {
+              op[ch] =
+                  (static_cast<float>(sp[ch]) * inv255 - shift) * inv_scale;
+            }
+          } else {
+            for (int64_t ch = 0; ch < c; ++ch) op[ch] = fill;
+          }
+        }
+      }
+    }
+  });
+}
+
 // DDP Reducer bucket planning: walk leaves in REVERSE order (last-produced
 // grads first), start a new bucket when adding a leaf would exceed
 // bucket_bytes (a leaf larger than bucket_bytes gets its own bucket).

@@ -10,6 +10,7 @@ from distributeddataparallel_tpu.data.loader import (  # noqa: F401
     shard_lm_batch,
 )
 from distributeddataparallel_tpu.data.transforms import (  # noqa: F401
+    CifarAugment,
     cifar_augment,
     random_crop,
     random_horizontal_flip,

@@ -244,12 +244,44 @@ class DataLoader:
                     masks.append(
                         smp.rank + p * smp.num_replicas < smp.dataset_len
                     )
-            batch = self._gather(np.concatenate(rows))
-            if self._augment is not None:
-                rng = np.random.default_rng(
+            idx_all = np.concatenate(rows)
+            rng = (
+                np.random.default_rng(
                     (self.seed, 0xA06, self._epoch, step, self.host_id)
                 )
-                batch = self._augment(batch, rng)
+                if self._augment is not None
+                else None
+            )
+            fused = (
+                self._augment is not None
+                and hasattr(self._augment, "gather_u8")
+                and getattr(self.dataset, "normalize_u8", False)
+                and callable(getattr(self.dataset, "arrays", None))
+            )
+            if fused:
+                # One native pass: gather + crop + flip + normalize over
+                # the raw uint8 store (transforms.CifarAugment.gather_u8,
+                # csrc/ddp_native.cpp) — rng-order-identical to the
+                # generic path below.
+                from distributeddataparallel_tpu import native
+
+                # Same normalization contract as _gather: EVERY uint8
+                # ndim>=2 column normalizes; only "image" additionally
+                # augments (fused).
+                batch = {
+                    k: (
+                        self._augment.gather_u8(v, idx_all, rng)
+                        if k == "image" and v.dtype == np.uint8
+                        else native.gather_normalize_u8(v, idx_all)
+                        if v.dtype == np.uint8 and v.ndim >= 2
+                        else v[idx_all]
+                    )
+                    for k, v in self.dataset.arrays().items()
+                }
+            else:
+                batch = self._gather(idx_all)
+                if self._augment is not None:
+                    batch = self._augment(batch, rng)
             if self.with_mask:
                 batch["valid"] = np.concatenate(masks).astype(np.float32)
             yield batch

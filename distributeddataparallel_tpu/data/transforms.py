@@ -10,6 +10,14 @@ driven by an explicit ``np.random.Generator`` so augmentation is a pure
 function of (seed, epoch, step) — deterministic across reruns AND across
 ``--resume`` (the loader derives the generator the same way the per-step
 training RNG is derived).
+
+``CifarAugment`` is the loader-facing hook.  On uint8-stored datasets it
+fuses the whole chain — batch gather, virtual-pad crop, flip, AND the
+ToTensor+Normalize transform — into ONE native C++ pass over the raw
+bytes (``native.gather_augment_u8``; csrc/ddp_native.cpp), so no
+intermediate float batch is ever materialized on the host.  Both paths
+draw from the generator in the same order, so native and NumPy produce
+identical batches.
 """
 
 from __future__ import annotations
@@ -28,6 +36,28 @@ def random_horizontal_flip(
     return out
 
 
+def _crop_at(
+    images: np.ndarray,
+    oy: np.ndarray,
+    ox: np.ndarray,
+    padding: int,
+    fill: float,
+) -> np.ndarray:
+    """Deterministic-offset crop: pad each side by ``padding`` with
+    ``fill``, crop back to the original size at per-sample (oy, ox)."""
+    B, H, W, C = images.shape
+    padded = np.pad(
+        images,
+        ((0, 0), (padding, padding), (padding, padding), (0, 0)),
+        constant_values=fill,
+    )
+    rows = oy[:, None] + np.arange(H)  # (B, H)
+    cols = ox[:, None] + np.arange(W)  # (B, W)
+    return padded[
+        np.arange(B)[:, None, None], rows[:, :, None], cols[:, None, :]
+    ]
+
+
 def random_crop(
     images: np.ndarray,
     rng: np.random.Generator,
@@ -44,19 +74,10 @@ def random_crop(
     """
     if padding == 0:
         return images
-    B, H, W, C = images.shape
-    padded = np.pad(
-        images,
-        ((0, 0), (padding, padding), (padding, padding), (0, 0)),
-        constant_values=fill,
-    )
+    B = images.shape[0]
     oy = rng.integers(0, 2 * padding + 1, B)
     ox = rng.integers(0, 2 * padding + 1, B)
-    rows = oy[:, None] + np.arange(H)  # (B, H)
-    cols = ox[:, None] + np.arange(W)  # (B, W)
-    return padded[
-        np.arange(B)[:, None, None], rows[:, :, None], cols[:, None, :]
-    ]
+    return _crop_at(images, oy, ox, padding, fill)
 
 
 def cifar_augment(
@@ -71,3 +92,47 @@ def cifar_augment(
     img = random_horizontal_flip(img, rng, p=flip_p)
     out["image"] = img
     return out
+
+
+class CifarAugment:
+    """Loader augment hook with a fused uint8 fast path.
+
+    ``__call__(batch, rng)`` augments an already-gathered float batch
+    (the generic path); ``gather_u8(src, idx, rng)`` replaces the
+    loader's gather+normalize+augment chain with one native pass over
+    the raw uint8 store.  Both consume the generator in the identical
+    order (crop oy, ox, then flip draws) so the two paths produce the
+    same batches for the same (seed, epoch, step).
+    """
+
+    def __init__(
+        self, crop_padding: int = 4, flip_p: float = 0.5, fill: float = -1.0
+    ):
+        self.crop_padding = crop_padding
+        self.flip_p = flip_p
+        self.fill = fill
+
+    def __call__(self, batch: dict, rng: np.random.Generator) -> dict:
+        return cifar_augment(
+            batch, rng, crop_padding=self.crop_padding,
+            flip_p=self.flip_p, fill=self.fill,
+        )
+
+    def gather_u8(
+        self, src: np.ndarray, idx: np.ndarray, rng: np.random.Generator
+    ) -> np.ndarray:
+        """Fused gather + crop + flip + normalize over (N,H,W,C) uint8."""
+        from distributeddataparallel_tpu import native
+
+        B = len(idx)
+        p = self.crop_padding
+        if p == 0:
+            # Mirror random_crop's early return: no offset draws.
+            oy = ox = np.zeros(B, np.int64)
+        else:
+            oy = rng.integers(0, 2 * p + 1, B)
+            ox = rng.integers(0, 2 * p + 1, B)
+        flip = rng.random(B) < self.flip_p
+        return native.gather_augment_u8(
+            src, idx, oy, ox, flip, padding=p, fill=self.fill,
+        )

@@ -114,6 +114,13 @@ def _load() -> ctypes.CDLL | None:
             ctypes.c_void_p, i64, i64, ctypes.c_void_p,
         ]
         lib.ddp_plan_buckets.restype = i64
+        lib.ddp_gather_augment_u8.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, i64, i64, i64, i64,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, i64,
+            ctypes.c_float, ctypes.c_float, ctypes.c_float,
+            ctypes.c_void_p, ctypes.c_int,
+        ]
+        lib.ddp_gather_augment_u8.restype = None
         _lib = lib
         return _lib
 
@@ -169,6 +176,54 @@ def gather_normalize_u8(
         src.ctypes.data, idx.ctypes.data, len(idx), row,
         ctypes.c_float(shift), ctypes.c_float(scale), out.ctypes.data,
         DEFAULT_THREADS,
+    )
+    return out
+
+
+def gather_augment_u8(
+    src: np.ndarray,
+    idx: np.ndarray,
+    oy: np.ndarray,
+    ox: np.ndarray,
+    flip: np.ndarray,
+    *,
+    padding: int,
+    shift: float = 0.5,
+    scale: float = 0.5,
+    fill: float = -1.0,
+) -> np.ndarray:
+    """out[i] = normalize(flip_i(crop_i(src[idx[i]]))) in one pass.
+
+    src: (N, H, W, C) uint8; oy/ox: per-row crop offsets in
+    [0, 2*padding]; flip: per-row 0/1.  ``fill`` is in NORMALIZED units
+    (see data.transforms.random_crop).  Fallback composes the NumPy
+    pieces — identical output."""
+    lib = _load()
+    idx = np.ascontiguousarray(idx, dtype=np.int64)
+    if (
+        lib is None
+        or src.dtype != np.uint8
+        or not src.flags.c_contiguous
+        or src.ndim != 4
+        or (len(idx) and (idx.min() < 0 or idx.max() >= len(src)))
+    ):
+        from distributeddataparallel_tpu.data import transforms as T
+
+        imgs = gather_normalize_u8(src, idx, shift=shift, scale=scale)
+        out = T._crop_at(imgs, oy, ox, padding, fill)
+        fl = flip.astype(bool)
+        out[fl] = out[fl, :, ::-1]
+        return out
+    n, h, w, c = src.shape
+    oy = np.ascontiguousarray(oy, dtype=np.int64)
+    ox = np.ascontiguousarray(ox, dtype=np.int64)
+    flip = np.ascontiguousarray(flip, dtype=np.uint8)
+    out = np.empty((len(idx), h, w, c), np.float32)
+    lib.ddp_gather_augment_u8(
+        src.ctypes.data, idx.ctypes.data, len(idx), h, w, c,
+        oy.ctypes.data, ox.ctypes.data, flip.ctypes.data,
+        int(padding), ctypes.c_float(shift), ctypes.c_float(scale),
+        ctypes.c_float(fill), out.ctypes.data, DEFAULT_THREADS,
     )
     return out
 

@@ -472,8 +472,8 @@ def train(args) -> float:
     dataset = build_dataset(args, train=True)
     augment = None
     if args.augment:  # validated LM-free in validate_args
-        from distributeddataparallel_tpu.data import cifar_augment
-        augment = cifar_augment
+        from distributeddataparallel_tpu.data import CifarAugment
+        augment = CifarAugment()  # fused native u8 path when available
     loader = DataLoader(
         dataset, per_replica_batch=args.batch_size, mesh=mesh,
         shuffle=True, seed=args.seed, place_fn=place_fn,
