@@ -137,10 +137,10 @@ def bench_resnet50() -> dict:
     ds = SyntheticClassification(
         num_examples=B * 2, shape=image_shape, num_classes=1000, seed=1
     )
-    def host_rate(dataset) -> float:
+    def host_rate(dataset, augment=None) -> float:
         loader = DataLoader(
             dataset, per_replica_batch=per_chip_batch, mesh=mesh,
-            shuffle=True, seed=0, device_feed=False,
+            shuffle=True, seed=0, device_feed=False, augment=augment,
         )
         rows = 0
         t0 = time.perf_counter()
@@ -161,6 +161,11 @@ def bench_resnet50() -> dict:
         keep_u8=True,
     )
     host_u8_img_s = host_rate(ds_u8)
+    # Full training-augmentation chain fused into the same native pass
+    # (gather + RandomCrop + flip + normalize, csrc ddp_gather_augment_u8).
+    from distributeddataparallel_tpu.data import CifarAugment
+
+    host_u8_aug_img_s = host_rate(ds_u8, augment=CifarAugment())
 
     loader = DataLoader(
         ds, per_replica_batch=per_chip_batch, mesh=mesh, shuffle=True,
@@ -191,6 +196,9 @@ def bench_resnet50() -> dict:
         # reported under a 'native' name.
         ("host_pipeline_u8_native_img_s" if native.available()
          else "host_pipeline_u8_numpy_img_s"): round(host_u8_img_s, 1),
+        ("host_pipeline_u8_augment_native_img_s" if native.available()
+         else "host_pipeline_u8_augment_numpy_img_s"):
+            round(host_u8_aug_img_s, 1),
         "native_kernels": native.available(),
         "e2e_img_s_chip": round(per_chip_batch / e2e_s, 2),
         "e2e_step_ms": round(e2e_s * 1e3, 3),

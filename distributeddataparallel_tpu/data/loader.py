@@ -167,6 +167,19 @@ class DataLoader:
         self.workers = workers
         self.with_mask = with_mask
         self._augment = augment
+        # Fused augment fast path: one native pass does gather + crop +
+        # flip + normalize over the raw uint8 image store.  Gate on the
+        # ACTUAL image column dtype — a normalize_u8 dataset with a
+        # float image must take the generic augment path, not silently
+        # skip augmentation.
+        arrays_fn = getattr(dataset, "arrays", None)
+        self._fused_augment = bool(
+            augment is not None
+            and hasattr(augment, "gather_u8")
+            and getattr(dataset, "normalize_u8", False)
+            and callable(arrays_fn)
+            and getattr(arrays_fn().get("image"), "dtype", None) == np.uint8
+        )
         self._place_fn = place_fn or (
             lambda b: shard_batch(b, self.mesh, self.axis_name)
         )
@@ -198,13 +211,17 @@ class DataLoader:
     def __len__(self) -> int:
         return self.steps_per_epoch
 
-    def _gather(self, idx: np.ndarray) -> Pytree:
+    def _gather(self, idx: np.ndarray, image_gather=None) -> Pytree:
         """Materialize rows `idx` as a dict-of-arrays batch.
 
         Fast path: datasets exposing ``arrays() -> dict[str, np.ndarray]``
         (one fancy-index per column).  Fallback: the generic
         ``__getitem__`` contract — items may be dicts (stacked per key) or
         (image, label) tuples (the torch-Dataset-style pair, ref dpp.py:35).
+
+        ``image_gather(col, idx)`` overrides the uint8 "image" column's
+        gather (the fused augment path) — every other column keeps the
+        ONE normalize contract defined here.
         """
         arrays = getattr(self.dataset, "arrays", None)
         if callable(arrays):
@@ -217,7 +234,10 @@ class DataLoader:
             norm = getattr(self.dataset, "normalize_u8", False)
             return {
                 k: (
-                    native.gather_normalize_u8(v, idx)
+                    image_gather(v, idx)
+                    if image_gather is not None
+                    and k == "image" and v.dtype == np.uint8
+                    else native.gather_normalize_u8(v, idx)
                     if norm and v.dtype == np.uint8 and v.ndim >= 2
                     else v[idx]
                 )
@@ -252,32 +272,17 @@ class DataLoader:
                 if self._augment is not None
                 else None
             )
-            fused = (
-                self._augment is not None
-                and hasattr(self._augment, "gather_u8")
-                and getattr(self.dataset, "normalize_u8", False)
-                and callable(getattr(self.dataset, "arrays", None))
-            )
-            if fused:
+            if self._fused_augment:
                 # One native pass: gather + crop + flip + normalize over
                 # the raw uint8 store (transforms.CifarAugment.gather_u8,
                 # csrc/ddp_native.cpp) — rng-order-identical to the
                 # generic path below.
-                from distributeddataparallel_tpu import native
-
-                # Same normalization contract as _gather: EVERY uint8
-                # ndim>=2 column normalizes; only "image" additionally
-                # augments (fused).
-                batch = {
-                    k: (
-                        self._augment.gather_u8(v, idx_all, rng)
-                        if k == "image" and v.dtype == np.uint8
-                        else native.gather_normalize_u8(v, idx_all)
-                        if v.dtype == np.uint8 and v.ndim >= 2
-                        else v[idx_all]
-                    )
-                    for k, v in self.dataset.arrays().items()
-                }
+                batch = self._gather(
+                    idx_all,
+                    image_gather=lambda v, i: self._augment.gather_u8(
+                        v, i, rng
+                    ),
+                )
             else:
                 batch = self._gather(idx_all)
                 if self._augment is not None:
