@@ -98,12 +98,21 @@ class TransformerConfig:
     # Mixture-of-experts: replace every block's MLP with `moe_experts`
     # expert MLPs routed top-`moe_top_k` (1 = switch, 2 = Mixtral-style
     # with renormalized gates).  `ep_axis` shards the expert dimension
-    # over a mesh axis (parallel.expert_parallel) — each position
-    # computes its E/n local experts over all tokens (dense einsum
-    # dispatch, MXU-friendly) and the combine is one psum.
+    # over a mesh axis (parallel.expert_parallel).
+    #
+    # Dispatch is picked by `moe_capacity_factor`:
+    # - 0.0 (default): dense einsum dispatch — every token through every
+    #   local expert, a (B, S, E) combine tensor blends.  No
+    #   gather/scatter, ideal at tiny E; FLOPs scale with E.
+    # - > 0: token-choice dispatch (GShard/Switch, ops.moe) — each token
+    #   occupies at most K capacity-bounded expert slots, overflow drops
+    #   through the residual.  FLOPs scale with K, not E.  Under EP the
+    #   token slots are exchanged with a real all_to_all over the
+    #   expert axis.
     moe_experts: int = 0
     moe_top_k: int = 1
     ep_axis: str | None = None
+    moe_capacity_factor: float = 0.0
 
     @property
     def kv_heads(self) -> int:
@@ -368,24 +377,40 @@ class MLP(nn.Module):
 
 
 class MoEMLP(nn.Module):
-    """Top-k-routed mixture-of-experts MLP with dense einsum dispatch:
-    every token's hidden state is pushed through each LOCAL expert as
-    one batched einsum (MXU-friendly — no gather/scatter), and a dense
-    (B, S, E) combine-weight tensor selects/blends the outputs.
+    """Top-k-routed mixture-of-experts MLP.
 
     Routing: ``cfg.moe_top_k == 1`` is the Switch convention (the raw
     top probability gates the output — that dependence is what trains
     the router); ``k > 1`` is Mixtral-style (probabilities renormalized
     over the selected k, gradients flow through the renormalization).
 
-    Under expert parallelism (``cfg.ep_axis``) each mesh position holds
-    ``moe_experts / ep`` experts and combines with ITS slice of the
-    weight tensor; the partial sum is completed with one psum
-    (``reduce_from_tp``).  Both the activations AND the combine weights
-    enter the expert region through the copy operator — the weights
-    carry the router's gradient path, and without the copy's backward
-    psum the replicated router grads would come out per-position
-    partial.
+    Two dispatch strategies (picked by ``cfg.moe_capacity_factor``):
+
+    **Dense einsum** (capacity_factor 0): every token's hidden state is
+    pushed through each LOCAL expert as one batched einsum (MXU-friendly
+    — no gather/scatter) and a dense (B, S, E) combine-weight tensor
+    blends the outputs.  Under EP each mesh position computes its E/n
+    experts over ALL tokens and the combine is one psum
+    (``reduce_from_tp``).  FLOPs scale with E — right for tiny E, wrong
+    at Mixtral scale.
+
+    **Token-choice** (capacity_factor > 0, ``ops.moe``): each token
+    occupies at most K slots in a ``(E, capacity)`` buffer; overflow
+    drops through the residual.  FLOPs scale with K, not E.  Under EP
+    each position routes ITS 1/n slice of the tokens, exchanges slot
+    buffers with one ``all_to_all`` over the expert axis (tokens travel
+    to their experts — the GShard dataflow), computes its local experts
+    on all sources' slots, all_to_alls back, combines its slice, and
+    restores replication with an ``all_gather``.
+
+    Gradient completeness: replicated params' grads must come out
+    complete and identical on every expert-axis position so the
+    data-axis sync needs no EP-awareness.  The dense path achieves this
+    with ``copy_to_tp`` (backward psum) on its replicated inputs; the
+    token-choice path instead uses the slice/all_gather conjugate pair
+    ``ep_shard_tokens``/``ep_unshard_tokens`` — a psum there would
+    overcount n× because each position only handles its token slice
+    (see parallel.expert_parallel).
     """
 
     cfg: TransformerConfig
@@ -419,9 +444,6 @@ class MoEMLP(nn.Module):
         if K > 1:
             vals = vals / jnp.sum(vals, axis=-1, keepdims=True)
         sel = jax.nn.one_hot(idx, E, dtype=jnp.float32)  # (B, S, K, E)
-        # Dense combine weights: w[b,s,e] = this token's gate for expert
-        # e (0 off the top-k).
-        w = jnp.sum(sel * vals[..., None], axis=2)     # (B, S, E)
 
         # Load-balance auxiliary (Fedus et al. / GShard): E * sum f_e*P_e,
         # f_e = fraction of routing slots assigned to expert e (stop-grad
@@ -435,20 +457,41 @@ class MoEMLP(nn.Module):
             E * jnp.sum(frac * probs.mean(axis=(0, 1))),
         )
 
-        if cfg.ep_axis is not None and n_ep > 1:
-            x = copy_to_tp(x, cfg.ep_axis)
-            w = copy_to_tp(w, cfg.ep_axis)
         init = nn.initializers.normal(0.02)
         w_up = self.param("experts_up", init, (El, d, f), jnp.float32)
         w_down = self.param("experts_down", init, (El, f, d), jnp.float32)
-        xe = x.astype(cfg.dtype)
-        h = jnp.einsum(
-            "bsd,edf->ebsf", xe, w_up.astype(cfg.dtype)
+        w_gate = (
+            self.param("experts_gate", init, (El, d, f), jnp.float32)
+            if cfg.activation == "swiglu"
+            else None
         )
-        if cfg.activation == "swiglu":
-            w_gate = self.param(
-                "experts_gate", init, (El, d, f), jnp.float32
-            )
+
+        def experts(z):
+            """Batched expert MLP: (El, n, d) -> (El, n, d)."""
+            h = jnp.einsum("end,edf->enf", z, w_up.astype(cfg.dtype))
+            if w_gate is not None:
+                g = jnp.einsum("end,edf->enf", z, w_gate.astype(cfg.dtype))
+                h = nn.silu(g) * h
+            else:
+                h = nn.gelu(h, approximate=True)
+            return jnp.einsum("enf,efd->end", h, w_down.astype(cfg.dtype))
+
+        if cfg.moe_capacity_factor > 0:
+            return self._token_choice(x, vals, idx, experts, n_ep)
+
+        # --- Dense einsum dispatch ---------------------------------------
+        # Dense combine weights: w[b,s,e] = this token's gate for expert
+        # e (0 off the top-k).
+        w = jnp.sum(sel * vals[..., None], axis=2)     # (B, S, E)
+        if cfg.ep_axis is not None and n_ep > 1:
+            x = copy_to_tp(x, cfg.ep_axis)
+            w = copy_to_tp(w, cfg.ep_axis)
+        xe = x.astype(cfg.dtype)
+        # Kept as bsd,edf einsums rather than experts() on a broadcast
+        # (El, B*S, d) operand: the einsum guarantees x is never
+        # materialised El times in HBM.
+        h = jnp.einsum("bsd,edf->ebsf", xe, w_up.astype(cfg.dtype))
+        if w_gate is not None:
             g = jnp.einsum("bsd,edf->ebsf", xe, w_gate.astype(cfg.dtype))
             h = nn.silu(g) * h
         else:
@@ -472,6 +515,77 @@ class MoEMLP(nn.Module):
         if cfg.ep_axis is not None and n_ep > 1:
             out = reduce_from_tp(out, cfg.ep_axis)
         return out
+
+    def _token_choice(self, x, vals, idx, experts, n_ep):
+        """Capacity-bounded token-choice dispatch (ops.moe)."""
+        from distributeddataparallel_tpu.ops.moe import (
+            combine,
+            dispatch,
+            moe_capacity,
+            token_choice_slots,
+        )
+
+        cfg = self.cfg
+        E, K, El = cfg.moe_experts, cfg.moe_top_k, cfg.moe_experts // n_ep
+        B, S, d = x.shape
+        T = B * S
+        ep = cfg.ep_axis if n_ep > 1 else None
+        if ep is not None and T % n_ep:
+            raise ValueError(
+                f"token-choice EP needs tokens ({T}) divisible by the "
+                f"expert-axis size ({n_ep})"
+            )
+        Tl = T // n_ep
+        xt = x.reshape(T, d)
+        vt = vals.reshape(T, K)
+        it = idx.reshape(T, K)
+        if ep is not None:
+            # Conjugate entry (parallel.expert_parallel.ep_shard_tokens):
+            # slice forward, all_gather backward — x and the gate values
+            # carry gradients for upstream replicated params and the
+            # router, which must come out complete and identical on
+            # every expert-axis position.
+            from distributeddataparallel_tpu.parallel.expert_parallel import (
+                ep_shard_tokens,
+            )
+
+            xt = ep_shard_tokens(xt, ep)
+            vt = ep_shard_tokens(vt, ep)
+            r = jax.lax.axis_index(ep)
+            it = jax.lax.dynamic_slice_in_dim(it, r * Tl, Tl, 0)
+        C = moe_capacity(Tl, E, K, cfg.moe_capacity_factor)
+
+        tok_for_slot, gate_for_slot = token_choice_slots(it, vt, E, C)
+        z = dispatch(xt.astype(cfg.dtype), tok_for_slot)  # (E*C, d)
+        if ep is not None:
+            # Tokens travel to their experts: slot buffers for expert
+            # block j go to position j; received leading dim indexes the
+            # SOURCE position.
+            z = jax.lax.all_to_all(
+                z.reshape(n_ep, El, C, d), ep, split_axis=0, concat_axis=0
+            )
+            z = z.transpose(1, 0, 2, 3).reshape(El, n_ep * C, d)
+        else:
+            z = z.reshape(E, C, d)
+        y = experts(z)
+        if ep is not None:
+            y = y.reshape(El, n_ep, C, d).transpose(1, 0, 2, 3)
+            # Outputs travel back: piece s returns to source position s,
+            # restoring this position's original (E, C) slot order.
+            y = jax.lax.all_to_all(y, ep, split_axis=0, concat_axis=0)
+        out = combine(
+            y.reshape(E * C, d), tok_for_slot, gate_for_slot, Tl
+        )
+        if ep is not None:
+            # Conjugate exit: all_gather forward restores replication;
+            # backward keeps each position's own chunk of the
+            # (replicated-identical) cotangent.
+            from distributeddataparallel_tpu.parallel.expert_parallel import (
+                ep_unshard_tokens,
+            )
+
+            out = ep_unshard_tokens(out, ep)
+        return out.reshape(B, S, d)
 
 
 class DecoderBlock(nn.Module):

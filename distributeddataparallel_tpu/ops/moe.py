@@ -1,0 +1,95 @@
+"""Token-choice MoE dispatch primitives (GShard / Switch convention).
+
+The dense-einsum MoE path (``models.transformer.MoEMLP``) pushes every
+token through every local expert — correct and MXU-friendly at tiny E,
+but FLOPs scale with E instead of K.  This module supplies the
+token-choice alternative: each token is materialised in at most K expert
+slots bounded by a per-expert ``capacity``, so expert FLOPs stay
+~``K * T`` regardless of E (the property expert parallelism exists for;
+reference stake: SURVEY.md §2c EP build scope).
+
+Convention (Lepikhin et al. arXiv 2006.16668 / Fedus et al. 2101.03961):
+
+- ``capacity = ceil(K * T / E * capacity_factor)`` slots per expert.
+- Priority is token order: when an expert overflows, LATER tokens drop
+  (their MoE contribution is zero — the residual connection carries
+  them through, "dropped-through-residual").
+- Dispatch/combine here is SORT-based, not the quadratic ``(T, E, C)``
+  one-hot einsum of the original GShard: an ``argsort`` by expert id
+  plus two O(T*K) gathers/scatters.  On TPU the einsum costs
+  ``T * (K*T) * d`` MXU FLOPs (quadratic in T — it dwarfs the expert
+  compute it feeds at training sequence lengths) while the sort path is
+  a VPU-side reshuffle linear in T*K.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def moe_capacity(num_tokens: int, num_experts: int, top_k: int,
+                 capacity_factor: float) -> int:
+    """Static per-expert slot count for a (sub-)batch of ``num_tokens``."""
+    import math
+
+    return max(1, math.ceil(top_k * num_tokens / num_experts
+                            * capacity_factor))
+
+
+def token_choice_slots(idx, gates, num_experts: int, capacity: int):
+    """Assign each (token, k) routing pair to an expert slot.
+
+    idx: (T, K) int32 expert choices; gates: (T, K) combine weights.
+    Returns ``(tok_for_slot, gate_for_slot)`` of shape (E*C,): slot
+    ``e*C + p`` holds the token id routed to expert ``e`` at position
+    ``p`` and its gate.  Empty / overflowed slots keep gate 0 (token id
+    0 — harmless: the combine multiplies by the gate), so no separate
+    validity mask is needed and no spurious gradient flows.
+
+    Differentiable in ``gates`` (gather + scatter-set); ``idx`` is
+    integer routing, no gradient path by construction.
+    """
+    T, K = idx.shape
+    E, C = num_experts, capacity
+    flat_e = idx.reshape(-1)  # token-major: (t0 k0, t0 k1, t1 k0, ...)
+    flat_g = gates.reshape(-1)
+    flat_t = jnp.repeat(jnp.arange(T, dtype=jnp.int32), K)
+    # Stable sort by expert id keeps token order within each expert —
+    # that ordering IS the drop priority.
+    order = jnp.argsort(flat_e)
+    se, st, sg = flat_e[order], flat_t[order], flat_g[order]
+    counts = jnp.zeros((E,), jnp.int32).at[flat_e].add(1)
+    starts = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(counts)[:-1]]
+    )
+    pos = jnp.arange(T * K, dtype=jnp.int32) - starts[se]
+    # Overflow -> sentinel index E*C, dropped by the scatters below.
+    slot = jnp.where(pos < C, se * C + pos, E * C)
+    tok_for_slot = (
+        jnp.zeros((E * C,), jnp.int32).at[slot].set(st, mode="drop")
+    )
+    gate_for_slot = (
+        jnp.zeros((E * C,), flat_g.dtype).at[slot].set(sg, mode="drop")
+    )
+    return tok_for_slot, gate_for_slot
+
+
+def dispatch(xt, tok_for_slot):
+    """Gather tokens into the slot buffer: (T, d) -> (E*C, d).
+
+    Empty slots gather token 0; their gate is 0 so neither the forward
+    combine nor any backward cotangent sees the duplicate.
+    """
+    return jnp.take(xt, tok_for_slot, axis=0)
+
+
+def combine(y_flat, tok_for_slot, gate_for_slot, num_tokens: int):
+    """Scatter-add expert outputs back to token positions with gates.
+
+    y_flat: (E*C, d) expert outputs in slot order.  Returns (T, d);
+    dropped tokens receive zero (the caller's residual carries them).
+    """
+    weighted = y_flat * gate_for_slot[:, None].astype(y_flat.dtype)
+    out = jnp.zeros((num_tokens, y_flat.shape[-1]), y_flat.dtype)
+    return out.at[tok_for_slot].add(weighted)

@@ -13,12 +13,71 @@ expressed by right-aligning the rule against each leaf.
 
 from __future__ import annotations
 
+import functools
 from typing import Any
 
 import jax
+from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 Pytree = Any
+
+
+# --- Token sharding conjugate pair (token-choice dispatch) ---------------
+#
+# The token-choice MoE path splits a REPLICATED token buffer 1/n per
+# expert-axis position, exchanges slots with all_to_all, and must hand
+# back a replicated buffer.  Under the replicated-compute convention the
+# cotangent arriving at the exit is already identical on every position,
+# so the naive pair (slice with zero-pad transpose + all_gather with
+# psum_scatter transpose) would overcount upstream gradients n× — the
+# correct conjugates are slice<->all_gather with NO reduction:
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def ep_shard_tokens(x, axis_name: str):
+    """Forward: this position's 1/n slice along dim 0 of a replicated
+    buffer.  Backward: all_gather of the per-position cotangents —
+    upstream replicated-param grads come out complete AND identical on
+    all positions (no psum; each position contributes exactly its
+    chunk)."""
+    n = lax.psum(1, axis_name)
+    r = lax.axis_index(axis_name)
+    size = x.shape[0] // n
+    return lax.dynamic_slice_in_dim(x, r * size, size, 0)
+
+
+def _shard_fwd(x, axis_name):
+    return ep_shard_tokens(x, axis_name), None
+
+
+def _shard_bwd(axis_name, _, g):
+    return (lax.all_gather(g, axis_name, tiled=True),)
+
+
+ep_shard_tokens.defvjp(_shard_fwd, _shard_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def ep_unshard_tokens(x, axis_name: str):
+    """Forward: all_gather the per-position chunks back to the
+    replicated buffer.  Backward: each position keeps its own chunk of
+    the (replicated-identical) cotangent — a psum_scatter here would
+    multiply by n."""
+    return lax.all_gather(x, axis_name, tiled=True)
+
+
+def _unshard_fwd(x, axis_name):
+    return ep_unshard_tokens(x, axis_name), None
+
+
+def _unshard_bwd(axis_name, _, g):
+    n = lax.psum(1, axis_name)
+    r = lax.axis_index(axis_name)
+    size = g.shape[0] // n
+    return (lax.dynamic_slice_in_dim(g, r * size, size, 0),)
+
+
+ep_unshard_tokens.defvjp(_unshard_fwd, _unshard_bwd)
 
 #: path-suffix -> partition of the TRAILING dims (right-aligned).
 _EP_RULES: tuple[tuple[tuple[str, ...], tuple[str | None, ...]], ...] = (

@@ -109,6 +109,13 @@ def parse_args(argv=None):
                         "an 'expert' mesh axis (requires --moe-experts)")
     p.add_argument("--moe-aux-weight", type=float, default=0.01,
                    help="weight of the switch load-balance auxiliary loss")
+    p.add_argument("--moe-capacity-factor", type=float, default=0.0,
+                   help="> 0 switches MoE to token-choice dispatch with "
+                        "capacity ceil(K*T/E * factor) per expert (GShard "
+                        "convention, overflow drops through the residual; "
+                        "under --ep tokens travel via all_to_all); 0 = "
+                        "dense einsum dispatch (every token through every "
+                        "local expert — exact, right for tiny E)")
     p.add_argument("--zero", action="store_true",
                    help="ZeRO-1 optimizer-state sharding across the data "
                         "axis (reduce_scatter + sharded update + all_gather)")
@@ -293,6 +300,10 @@ def validate_args(args) -> None:
         )
     if args.moe_top_k != 1 and not args.moe_experts:
         raise SystemExit("--moe-top-k requires --moe-experts")
+    if args.moe_capacity_factor and not args.moe_experts:
+        raise SystemExit("--moe-capacity-factor requires --moe-experts")
+    if args.moe_capacity_factor < 0:
+        raise SystemExit("--moe-capacity-factor must be >= 0")
     if args.ep > 1:
         if not args.moe_experts:
             raise SystemExit("--ep requires --moe-experts")
@@ -341,6 +352,7 @@ def build_model(args, num_classes: int = 10, vocab_size: int | None = None):
         if args.moe_experts:
             overrides["moe_experts"] = args.moe_experts
             overrides["moe_top_k"] = args.moe_top_k
+            overrides["moe_capacity_factor"] = args.moe_capacity_factor
         if args.ep > 1:
             overrides["ep_axis"] = "expert"
         if args.layers:
