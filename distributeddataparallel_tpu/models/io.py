@@ -7,13 +7,16 @@ a torch dependency on the load path:
 
 - ``save_params`` / ``load_params``: flat safetensors files (portable,
   zero-copy, no pickle) keyed by ``/``-joined pytree paths.
-- ``convert_gpt2_hf``: HuggingFace GPT-2 checkpoint tensors → this
-  framework's ``TransformerLM`` param tree (verified logit-level against
-  the HF torch implementation in tests/test_io.py).
+- ``convert_gpt2_hf`` / ``convert_llama_hf``: HuggingFace GPT-2 / Llama
+  checkpoint tensors → this framework's ``TransformerLM`` param tree
+  (both verified logit-level against the HF torch implementations in
+  tests/test_io.py), with ``export_llama_hf`` as the Llama inverse.
 - ``convert_resnet_torch``: torchvision ResNet ``state_dict`` →
   ``models.resnet.ResNet`` params + batch stats (and ``export_resnet_torch``,
   its inverse, used for round-trip testing and for handing weights back
   to torch users).
+- ``load_pretrained``: format-sniffing front door for ``dpp.py
+  --pretrained`` (the ref's ``pretrained=True`` fine-tune flow).
 
 torch itself is only needed to *read* .pth files (``load_torch_state_dict``);
 all converters operate on plain NumPy arrays.
@@ -105,6 +108,97 @@ def load_torch_state_dict(path: str) -> dict[str, np.ndarray]:
     return {k: v.detach().numpy() for k, v in sd.items()}
 
 
+def load_checkpoint_tensors(path: str) -> dict[str, np.ndarray]:
+    """Flat name->array dict from either container format: safetensors
+    (torch-free) or a torch pickle (.pth/.pt/.bin)."""
+    if path.endswith((".safetensors", ".st")):
+        from safetensors.numpy import load_file
+
+        return load_file(path)
+    return load_torch_state_dict(path)
+
+
+def stack_scanned_layers(
+    params: Pytree, num_layers: int, prefix: str = "layer_"
+) -> Pytree:
+    """Per-layer param subtrees (``layer_0..layer_{L-1}``, the unscanned
+    layout every converter emits) -> the ``scan_layers`` layout: one
+    ``layers/block`` subtree whose leaves carry a leading layer dim."""
+    stacked = jax.tree.map(
+        lambda *xs: np.stack([np.asarray(x) for x in xs]),
+        *[params[f"{prefix}{i}"] for i in range(num_layers)],
+    )
+    rest = {
+        k: v for k, v in params.items() if not k.startswith(prefix)
+    }
+    rest["layers"] = {"block": stacked}
+    return rest
+
+
+def load_pretrained(path: str, model, variables: Pytree) -> Pytree:
+    """Initialize ``variables`` (a ``model.init`` result) from a
+    pretrained checkpoint — the reference's ``pretrained=True`` analog
+    (ref dpp.py:14) driven by ``dpp.py --pretrained``.
+
+    The source format is sniffed from the key names:
+
+    - torchvision ResNet state_dict (``conv1.weight``/``fc.weight``) ->
+      ``convert_resnet_torch`` (params + batch stats);
+    - HF GPT-2 tensors (``wte.weight``) -> ``convert_gpt2_hf``, stacked
+      into the scanned layout when the model scans its layers;
+    - HF Llama tensors (``model.embed_tokens.weight``) ->
+      ``convert_llama_hf``;
+    - otherwise this framework's own flat safetensors (``save_params``
+      output, or a full-variables dump with ``collection/`` prefixes).
+
+    Every path shape-checks against ``variables`` before returning.
+    """
+    flat = load_checkpoint_tensors(path)
+    if "conv1.weight" in flat and "fc.weight" in flat:
+        from distributeddataparallel_tpu.models.resnet import (
+            BottleneckBlock,
+        )
+
+        return convert_resnet_torch(
+            flat, variables, model.stage_sizes,
+            bottleneck=model.block_cls is BottleneckBlock,
+        )
+    cfg = getattr(model, "cfg", None)
+    if "wte.weight" in flat or "transformer.wte.weight" in flat:
+        params = convert_gpt2_hf(flat, cfg)
+        if cfg.scan_layers:
+            params = stack_scanned_layers(params, cfg.num_layers)
+        return {
+            **variables,
+            "params": unflatten_into(variables["params"], flatten_tree(params)),
+        }
+    if "model.embed_tokens.weight" in flat:
+        params = convert_llama_hf(flat, cfg)
+        if cfg.scan_layers:
+            params = stack_scanned_layers(params, cfg.num_layers)
+        return {
+            **variables,
+            "params": unflatten_into(variables["params"], flatten_tree(params)),
+        }
+    collections = {"params", "batch_stats", "cache", "intermediates"}
+    if flat and all(k.split(SEP, 1)[0] in collections for k in flat):
+        # Full-variables dump: route each collection separately.
+        nested: dict[str, dict[str, np.ndarray]] = {}
+        for k, v in flat.items():
+            col, rest = k.split(SEP, 1)
+            nested.setdefault(col, {})[rest] = v
+        return {
+            **variables,
+            **{
+                col: unflatten_into(variables[col], d)
+                for col, d in nested.items()
+            },
+        }
+    return {
+        **variables, "params": unflatten_into(variables["params"], flat)
+    }
+
+
 # ----------------------------- GPT-2 (HF) --------------------------------
 
 def convert_gpt2_hf(
@@ -162,6 +256,106 @@ def convert_gpt2_hf(
             },
         }
     return params
+
+
+# ----------------------------- Llama (HF) --------------------------------
+
+def convert_llama_hf(sd: Mapping[str, np.ndarray], cfg) -> Pytree:
+    """HF Llama tensors -> TransformerLM params (cfg from ``llama3_8b``).
+
+    Layout notes: torch Linear stores (out, in) so every kernel
+    transposes; q splits (H*D, d) -> (d, H, D) and k/v split at the GQA
+    kv-head count (Hkv*D, d) -> (d, Hkv, D); o re-groups (d, H*D) ->
+    (H, D, d); SwiGLU is gate/up/down; norms are RMS scales.  No RoPE
+    permutation: both HF and ``ops.attention.apply_rope`` use the
+    half-split (rotate_half) convention.  ``lm_head.weight`` maps to the
+    untied head; a tied config (no ``lm_head`` in sd) reuses the
+    embedding, matching ``cfg.tie_embeddings``.
+    """
+    H, Hkv, D, d = cfg.num_heads, cfg.kv_heads, cfg.dims_per_head, cfg.d_model
+
+    def g(key):
+        if key in sd:
+            return np.asarray(sd[key])
+        raise KeyError(key)
+
+    params: dict[str, Any] = {
+        "token_embed": {"embedding": g("model.embed_tokens.weight")},
+        "final_norm": {"scale": g("model.norm.weight")},
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = {"kernel": g("lm_head.weight").T}
+    for i in range(cfg.num_layers):
+        p = f"model.layers.{i}."
+        params[f"layer_{i}"] = {
+            "attn_norm": {"scale": g(p + "input_layernorm.weight")},
+            "attn": {
+                "q_proj": {
+                    "kernel": g(p + "self_attn.q_proj.weight").T
+                    .reshape(d, H, D)
+                },
+                "k_proj": {
+                    "kernel": g(p + "self_attn.k_proj.weight").T
+                    .reshape(d, Hkv, D)
+                },
+                "v_proj": {
+                    "kernel": g(p + "self_attn.v_proj.weight").T
+                    .reshape(d, Hkv, D)
+                },
+                "o_proj": {
+                    "kernel": g(p + "self_attn.o_proj.weight").T
+                    .reshape(H, D, d)
+                },
+            },
+            "mlp_norm": {"scale": g(p + "post_attention_layernorm.weight")},
+            "mlp": {
+                "gate_proj": {"kernel": g(p + "mlp.gate_proj.weight").T},
+                "up_proj": {"kernel": g(p + "mlp.up_proj.weight").T},
+                "down_proj": {"kernel": g(p + "mlp.down_proj.weight").T},
+            },
+        }
+    return params
+
+
+def export_llama_hf(params: Pytree, cfg) -> dict[str, np.ndarray]:
+    """Inverse of ``convert_llama_hf`` (round-trip testing / handing
+    weights back to HF users).  All outputs are C-contiguous: safetensors
+    serializes the raw buffer, so transposed views would save scrambled."""
+    H, Hkv, D, d = cfg.num_heads, cfg.kv_heads, cfg.dims_per_head, cfg.d_model
+    sd: dict[str, np.ndarray] = {
+        "model.embed_tokens.weight": np.asarray(
+            params["token_embed"]["embedding"]
+        ),
+        "model.norm.weight": np.asarray(params["final_norm"]["scale"]),
+    }
+    if not cfg.tie_embeddings:
+        sd["lm_head.weight"] = np.asarray(params["lm_head"]["kernel"]).T
+    for i in range(cfg.num_layers):
+        lp = params[f"layer_{i}"]
+        p = f"model.layers.{i}."
+        sd[p + "input_layernorm.weight"] = np.asarray(
+            lp["attn_norm"]["scale"]
+        )
+        sd[p + "self_attn.q_proj.weight"] = (
+            np.asarray(lp["attn"]["q_proj"]["kernel"]).reshape(d, H * D).T
+        )
+        sd[p + "self_attn.k_proj.weight"] = (
+            np.asarray(lp["attn"]["k_proj"]["kernel"]).reshape(d, Hkv * D).T
+        )
+        sd[p + "self_attn.v_proj.weight"] = (
+            np.asarray(lp["attn"]["v_proj"]["kernel"]).reshape(d, Hkv * D).T
+        )
+        sd[p + "self_attn.o_proj.weight"] = (
+            np.asarray(lp["attn"]["o_proj"]["kernel"]).reshape(H * D, d).T
+        )
+        sd[p + "post_attention_layernorm.weight"] = np.asarray(
+            lp["mlp_norm"]["scale"]
+        )
+        for name in ("gate_proj", "up_proj", "down_proj"):
+            sd[p + f"mlp.{name}.weight"] = np.asarray(
+                lp["mlp"][name]["kernel"]
+            ).T
+    return {k: np.ascontiguousarray(v) for k, v in sd.items()}
 
 
 # --------------------------- ResNet (torch) ------------------------------
@@ -242,7 +436,9 @@ def export_resnet_torch(
     sd: dict[str, np.ndarray] = {}
 
     def put_conv(key, kern):
-        sd[key] = np.asarray(kern).transpose(3, 2, 0, 1)
+        # ascontiguousarray: safetensors serializes the raw buffer, so a
+        # transposed VIEW would save scrambled.
+        sd[key] = np.ascontiguousarray(np.asarray(kern).transpose(3, 2, 0, 1))
 
     def put_bn(prefix, p, s):
         sd[prefix + "weight"] = np.asarray(p["scale"])
@@ -270,6 +466,8 @@ def export_resnet_torch(
                          params[name]["conv_proj"]["kernel"])
                 put_bn(tp + "downsample.1.", params[name]["norm_proj"],
                        stats[name]["norm_proj"])
-    sd["fc.weight"] = np.asarray(params["Dense_0"]["kernel"]).T
+    sd["fc.weight"] = np.ascontiguousarray(
+        np.asarray(params["Dense_0"]["kernel"]).T
+    )
     sd["fc.bias"] = np.asarray(params["Dense_0"]["bias"])
     return sd

@@ -47,6 +47,13 @@ def parse_args(argv=None):
     p.add_argument("--d-model", type=int, default=None,
                    help="override the model family's width")
     p.add_argument("--data-root", default="data")
+    p.add_argument("--pretrained", default=None, metavar="FILE",
+                   help="initialize from a pretrained checkpoint before "
+                        "training (ref dpp.py:14's pretrained=True analog): "
+                        "torchvision ResNet state_dict, HF GPT-2 or Llama "
+                        "tensors (.safetensors or torch .pth), or this "
+                        "framework's save_params safetensors — the format "
+                        "is sniffed from the key names")
     p.add_argument("--epochs", type=int, default=5)          # ref dpp.py:27
     p.add_argument("--batch-size", type=int, default=32,     # ref dpp.py:35
                    help="per-replica batch (global = batch × replicas)")
@@ -515,6 +522,15 @@ def train(args) -> float:
     else:
         sample = jnp.zeros((1,) + dataset.images.shape[1:], jnp.float32)
         variables = model.init(rng, sample)
+    if args.pretrained:
+        # Fine-tune flow (ref dpp.py:14-15): replace the random init with
+        # converted pretrained weights; every sharded placement below
+        # (DP broadcast / ZeRO / TP / EP / PP / FSDP) then distributes
+        # the pretrained tree exactly like a fresh one.
+        from distributeddataparallel_tpu.models.io import load_pretrained
+
+        variables = load_pretrained(args.pretrained, model, variables)
+        log0("loaded pretrained weights from %s", args.pretrained)
     params = variables["params"]
     # Non-param collections (BatchNorm running stats for ResNets) become
     # framework-managed model state — the torch "buffers" DDP broadcasts.

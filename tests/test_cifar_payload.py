@@ -1,0 +1,259 @@
+"""Real-format CIFAR-10 payload + pretrained fine-tune flow.
+
+The reference's whole purpose is fine-tuning pretrained ResNet-18 on real
+CIFAR-10 (ref dpp.py:14-15,33).  These tests synthesize a GENUINE
+``cifar-10-python.tar.gz`` (python-pickle batches, CHW uint8 planes,
+bytes keys — exactly the upstream layout) so the tar/extract/parse path
+in ``data/datasets.py`` runs for real, and drive ``dpp.py --pretrained``
+end-to-end for both converter families.
+"""
+
+import io
+import os
+import pickle
+import tarfile
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributeddataparallel_tpu.data.datasets import (
+    load_cifar10,
+    normalize_images,
+)
+from distributeddataparallel_tpu.models import io as mio
+
+N_PER_BATCH = 8  # tiny but genuine: 5 train batches + 1 test batch
+
+
+def _raw_batches(seed=0):
+    """The 6 pickle payloads, keyed like the upstream archive."""
+    rng = np.random.default_rng(seed)
+    out = {}
+    for name in [f"data_batch_{i}" for i in range(1, 6)] + ["test_batch"]:
+        out[name] = {
+            b"data": rng.integers(
+                0, 256, size=(N_PER_BATCH, 3072), dtype=np.uint8
+            ),
+            b"labels": [int(x) for x in rng.integers(0, 10, N_PER_BATCH)],
+        }
+    return out
+
+
+def _write_cifar_tgz(root, batches):
+    """A genuine cifar-10-python.tar.gz: pickle members under the
+    standard cifar-10-batches-py/ prefix."""
+    os.makedirs(root, exist_ok=True)
+    tgz = os.path.join(root, "cifar-10-python.tar.gz")
+    with tarfile.open(tgz, "w:gz") as tf:
+        for name, payload in batches.items():
+            blob = pickle.dumps(payload)
+            info = tarfile.TarInfo(f"cifar-10-batches-py/{name}")
+            info.size = len(blob)
+            tf.addfile(info, io.BytesIO(blob))
+    return tgz
+
+
+@pytest.fixture()
+def cifar_root(tmp_path):
+    batches = _raw_batches()
+    _write_cifar_tgz(str(tmp_path), batches)
+    return str(tmp_path), batches
+
+
+def test_load_cifar10_real_payload(cifar_root):
+    """Extract-from-tar + pickle parse + CHW->HWC + normalize, checked
+    value-for-value against the raw arrays that went into the archive."""
+    root, batches = cifar_root
+    ds = load_cifar10(root, train=True, synthetic_fallback=False)
+    assert len(ds) == 5 * N_PER_BATCH
+    # Extraction must have materialized the batch dir atomically.
+    assert os.path.isdir(os.path.join(root, "cifar-10-batches-py"))
+
+    want_u8 = np.concatenate(
+        [
+            batches[f"data_batch_{i}"][b"data"]
+            .reshape(-1, 3, 32, 32)
+            .transpose(0, 2, 3, 1)
+            for i in range(1, 6)
+        ]
+    )
+    np.testing.assert_allclose(ds.images, normalize_images(want_u8))
+    assert ds.images.min() >= -1.0 and ds.images.max() <= 1.0
+    want_labels = np.concatenate(
+        [batches[f"data_batch_{i}"][b"labels"] for i in range(1, 6)]
+    )
+    np.testing.assert_array_equal(ds.labels, want_labels)
+
+    test_ds = load_cifar10(root, train=False, synthetic_fallback=False)
+    assert len(test_ds) == N_PER_BATCH
+    np.testing.assert_array_equal(
+        test_ds.labels, batches["test_batch"][b"labels"]
+    )
+
+
+def test_load_cifar10_real_payload_u8_mode(cifar_root):
+    """keep_u8 stores raw uint8 and normalizes on access — __getitem__
+    must agree exactly with the eager f32 pipeline."""
+    root, _ = cifar_root
+    eager = load_cifar10(root, train=True, synthetic_fallback=False)
+    lazy = load_cifar10(
+        root, train=True, synthetic_fallback=False, keep_u8=True
+    )
+    assert lazy.images.dtype == np.uint8 and lazy.normalize_u8
+    img_lazy, lbl_lazy = lazy[3]
+    img_eager, lbl_eager = eager[3]
+    np.testing.assert_allclose(img_lazy, img_eager)
+    assert lbl_lazy == lbl_eager
+
+
+def test_cifar10_cli_trains_on_real_payload(cifar_root, devices):
+    """dpp.py --dataset cifar10 against the real-format payload: loader,
+    sharding, and a full epoch run off the parsed pickle batches."""
+    import sys
+
+    sys.path.insert(0, "/root/repo")
+    import dpp
+
+    root, _ = cifar_root
+    args = dpp.parse_args(
+        [
+            "--device", "cpu",
+            "--model", "cnn",
+            "--dataset", "cifar10",
+            "--data-root", root,
+            "--epochs", "1",
+            "--batch-size", "4",
+            "--log-every", "1000",
+        ]
+    )
+    loss = dpp.train(args)
+    assert np.isfinite(loss)
+
+
+def test_pretrained_resnet18_finetune_cli(cifar_root, devices):
+    """The reference's end-to-end journey (ref dpp.py:14-15,33): a
+    torchvision-layout ResNet-18 checkpoint + real-format CIFAR-10 ->
+    ``--pretrained`` converts the state_dict into the initial params and
+    training runs.  Also pins that the converted tree EQUALS the source
+    (via load_pretrained directly)."""
+    import sys
+
+    from safetensors.numpy import save_file
+
+    from distributeddataparallel_tpu.models.resnet import ResNet18
+
+    sys.path.insert(0, "/root/repo")
+    import dpp
+
+    root, _ = cifar_root
+    model = ResNet18(num_classes=10, stem="cifar")
+    variables = model.init(
+        jax.random.PRNGKey(7), jnp.zeros((1, 32, 32, 3), jnp.float32)
+    )
+    sd = mio.export_resnet_torch(
+        variables, model.stage_sizes, bottleneck=False
+    )
+    ckpt = os.path.join(root, "resnet18.safetensors")
+    save_file(sd, ckpt)
+
+    # Direct conversion equality: torch layout -> our tree round-trips.
+    fresh = model.init(
+        jax.random.PRNGKey(8), jnp.zeros((1, 32, 32, 3), jnp.float32)
+    )
+    loaded = mio.load_pretrained(ckpt, model, fresh)
+    for (path, a), b in zip(
+        jax.tree_util.tree_flatten_with_path(loaded)[0],
+        jax.tree.leaves(variables),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-6,
+            err_msg="/".join(str(getattr(k, "key", k)) for k in path),
+        )
+
+    args = dpp.parse_args(
+        [
+            "--device", "cpu",
+            "--model", "resnet18",
+            "--dataset", "cifar10",
+            "--data-root", root,
+            "--pretrained", ckpt,
+            "--epochs", "1",
+            "--batch-size", "4",
+            "--log-every", "1000",
+        ]
+    )
+    loss = dpp.train(args)
+    assert np.isfinite(loss)
+
+
+def test_pretrained_gpt2_hf_cli(tmp_path, devices):
+    """HF-layout GPT-2 tensors load through --pretrained: format sniffed,
+    c_attn split, and the run trains (fine-tune flow for the LM family)."""
+    import sys
+
+    from safetensors.numpy import save_file
+
+    sys.path.insert(0, "/root/repo")
+    import dpp
+
+    # Shapes for the CLI config below: d=32, heads=2, D=16, ff=128, V=64.
+    d, V, S, L, ff = 32, 64, 32, 2, 128
+    rng = np.random.default_rng(0)
+    w = lambda *shape: (0.02 * rng.standard_normal(shape)).astype(np.float32)
+    sd = {"wte.weight": w(V, d), "wpe.weight": w(S, d),
+          "ln_f.weight": np.ones(d, np.float32), "ln_f.bias": w(d)}
+    for i in range(L):
+        p = f"h.{i}."
+        sd.update({
+            p + "ln_1.weight": np.ones(d, np.float32), p + "ln_1.bias": w(d),
+            p + "attn.c_attn.weight": w(d, 3 * d),
+            p + "attn.c_attn.bias": w(3 * d),
+            p + "attn.c_proj.weight": w(d, d), p + "attn.c_proj.bias": w(d),
+            p + "ln_2.weight": np.ones(d, np.float32), p + "ln_2.bias": w(d),
+            p + "mlp.c_fc.weight": w(d, ff), p + "mlp.c_fc.bias": w(ff),
+            p + "mlp.c_proj.weight": w(ff, d), p + "mlp.c_proj.bias": w(d),
+        })
+    ckpt = str(tmp_path / "gpt2.safetensors")
+    save_file(sd, ckpt)
+
+    args = dpp.parse_args(
+        [
+            "--device", "cpu",
+            "--model", "gpt2",
+            "--layers", str(L),
+            "--d-model", str(d),
+            "--seq-len", str(S),
+            "--vocab-size", str(V),
+            "--pretrained", ckpt,
+            "--epochs", "1",
+            "--num-examples", "64",
+            "--batch-size", "4",
+            "--log-every", "1000",
+        ]
+    )
+    loss = dpp.train(args)
+    assert np.isfinite(loss)
+
+
+def test_pretrained_native_safetensors(devices):
+    """The framework's own save_params output loads through the
+    --pretrained sniffing path (no conversion, strict shape check)."""
+    from distributeddataparallel_tpu.models import TransformerLM, tiny_lm
+
+    import tempfile
+
+    cfg = tiny_lm()
+    model = TransformerLM(cfg)
+    toks = jnp.zeros((1, 16), jnp.int32)
+    variables = model.init(jax.random.PRNGKey(0), toks)
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "params.safetensors")
+        mio.save_params(variables["params"], path)
+        fresh = model.init(jax.random.PRNGKey(1), toks)
+        loaded = mio.load_pretrained(path, model, fresh)
+    for a, b in zip(
+        jax.tree.leaves(loaded["params"]), jax.tree.leaves(variables["params"])
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b))
