@@ -8,7 +8,12 @@ stages" is literally "shard that leading dim over the pipe axis" — each
 mesh position holds ``L / n_stages`` layers and runs the same scanned
 block code on its slice.
 
-The schedule is plain GPipe inside ``shard_map``:
+Two schedules share the stage sharding (``make_pp_train_step(...,
+schedule=)``): GPipe (default, below) and 1F1B
+(``_pp_1f1b_loss_and_grads`` — interleaved manual backward, O(stages)
+activation memory instead of O(microbatches); see its docstring).
+
+The GPipe schedule inside ``shard_map``:
 
 - The per-position batch splits into M microbatches.  Each tick, stage 0
   injects the next microbatch's embeddings, every stage applies its
@@ -281,6 +286,18 @@ def make_pp_eval_step(
         toks, valid = batch["tokens"], batch["valid"]
         inputs, targets = toks[:, :-1], toks[:, 1:]
         n = n_stages
+        pad = (-inputs.shape[0]) % M
+        if pad:
+            # Tail batch whose per-position rows don't divide the
+            # microbatch count (drop_last=False loaders): pad with
+            # valid=0 rows — the mask already zero-weights them, same
+            # contract as the non-PP masked eval.
+            zrow = jnp.zeros((pad, inputs.shape[1]), inputs.dtype)
+            inputs = jnp.concatenate([inputs, zrow])
+            targets = jnp.concatenate([targets, zrow])
+            valid = jnp.concatenate(
+                [valid, jnp.zeros((pad,), valid.dtype)]
+            )
         mb_rows = inputs.shape[0] // M
         S = inputs.shape[1]
         _check_seq_bound(cfg, S)
@@ -350,6 +367,172 @@ def make_pp_eval_step(
     return eval_step
 
 
+def _pp_1f1b_loss_and_grads(
+    cfg,
+    stack,
+    params,
+    inputs,
+    targets,
+    *,
+    pp_axis: str,
+    n: int,
+    microbatches: int,
+):
+    """1F1B schedule with a MANUAL backward: returns ``(loss, grads)``
+    shaped exactly like ``value_and_grad(pp_loss)`` so the surrounding
+    step (pipe psum completion, DP sync, ZeRO) is schedule-agnostic.
+
+    GPipe (``pp_loss``) differentiates through the whole tick loop, so
+    AD keeps every microbatch's stage activations alive until the
+    reverse sweep — O(M) activation memory.  Here forward and backward
+    interleave on a synchronized alternating clock (even ticks forward,
+    odd ticks backward — the SPMD rendering of Megatron-LM's 1F1B,
+    arXiv 2104.04473 §2.2): a microbatch's backward starts as soon as
+    its forward leaves the last stage, so at most ``2(n-1)`` microbatch
+    inputs are in flight per stage regardless of M.  Only the STAGE
+    INPUT is saved per in-flight microbatch (a ``2n+1``-slot ring, last
+    slot = scratch for masked writes); the backward tick recomputes the
+    stage forward under ``jax.vjp`` — stage-granular activation
+    checkpointing, the standard 1F1B memory/compute trade.
+
+    Schedule (F-tick index k, B-tick index k'): stage s runs forward of
+    microbatch ``k - s`` and backward of microbatch ``k' - 2(n-1) + s``;
+    activations hop +1 after every F-tick, cotangents hop -1 after every
+    B-tick.  The last stage seeds the backward from the loss vjp of the
+    microbatch it just finished; stage 0's outgoing cotangent feeds the
+    embedding vjp.  Per-stage schedule shifts are data-dependent on
+    ``axis_index``, so off-schedule ticks compute on clamped dummies and
+    every accumulation is masked — exactly the trick the GPipe path uses
+    for its bubble ticks.
+
+    v1 restrictions (the GPipe path remains for these): no ``cp_axis``
+    and no MoE aux loss (the manual vjp has no mutable-intermediates
+    channel).  TP composes: the stage body's Megatron collectives sit
+    inside ``jax.vjp``, which transposes them exactly as AD does.
+    """
+    from distributeddataparallel_tpu.models.transformer import (
+        rope_frequencies,
+    )
+    from distributeddataparallel_tpu.ops.losses import lm_cross_entropy
+
+    M = microbatches
+    s = lax.axis_index(pp_axis)
+    mb_rows = inputs.shape[0] // M
+    S = inputs.shape[1]
+    _check_seq_bound(cfg, S)
+    mbs_in = inputs.reshape(M, mb_rows, S)
+    mbs_tgt = targets.reshape(M, mb_rows, S)
+    rope = (
+        rope_frequencies(
+            cfg.dims_per_head, cfg.max_seq_len, theta=cfg.rope_theta
+        )
+        if cfg.positional == "rope"
+        else None
+    )
+
+    head_keys = ("final_norm",) + (
+        ("token_embed",) if cfg.tie_embeddings else ("lm_head",)
+    )
+    embed_keys = ("token_embed",) + (
+        ("pos_embed",) if cfg.positional == "learned" else ()
+    )
+
+    def stage_fn(layer_params, x):
+        y, _ = stack.apply({"params": layer_params}, x, None, rope, True)
+        return y
+
+    def head_loss(hparams, y, tgt):
+        return lm_cross_entropy(_head(cfg, hparams, y), tgt)
+
+    def embed_fn(eparams, toks):
+        return _embed(cfg, eparams, toks)
+
+    n_slots = 2 * n + 1          # in-flight <= 2(n-1); last slot = scratch
+    saved = jnp.zeros((n_slots, mb_rows, S, cfg.d_model), cfg.dtype)
+    fbuf = jnp.zeros((mb_rows, S, cfg.d_model), cfg.dtype)
+    bbuf = jnp.zeros((mb_rows, S, cfg.d_model), cfg.dtype)
+    gacc = jax.tree.map(jnp.zeros_like, params)
+    loss_acc = jnp.zeros((), jnp.float32)
+    perm_f = [(i, (i + 1) % n) for i in range(n)]
+    perm_b = [((i + 1) % n, i) for i in range(n)]
+
+    def _acc(acc_tree, keys, grad_tree, w):
+        out = dict(acc_tree)
+        for k in keys:
+            out[k] = jax.tree.map(
+                lambda a, g: a + g.astype(a.dtype) * w,
+                acc_tree[k], grad_tree[k],
+            )
+        return out
+
+    n_f_ticks = M + n - 1
+    n_b_ticks = M + 2 * (n - 1)
+    T = max(n_f_ticks, n_b_ticks)
+
+    # One scan iteration = one F-tick + one B-tick (the even/odd clock
+    # flattened).  lax.scan, NOT an unrolled python loop, for two
+    # load-bearing reasons: the carried ring buffer updates alias in
+    # place, and iteration boundaries stop the scheduler from hoisting
+    # every B-tick's recompute ahead of the backwards (which would
+    # resurrect the O(M) liveness this schedule exists to kill).
+    def tick(carry, i):
+        saved, fbuf, bbuf, gacc, loss_acc = carry
+        # --- F-tick i: stage s runs forward of microbatch i - s -------
+        # (0 <= m < M subsumes the tick-range bound: i < T implies the
+        # per-stage microbatch index is already past M when off-schedule)
+        m = i - s
+        valid = (m >= 0) & (m < M)
+        mc = jnp.clip(m, 0, M - 1)
+        toks = lax.dynamic_index_in_dim(mbs_in, mc, 0, keepdims=False)
+        x = jnp.where(s == 0, embed_fn(params, toks), fbuf)
+        slot = jnp.where(valid, mc % (2 * n), 2 * n)
+        saved = lax.dynamic_update_slice_in_dim(saved, x[None], slot, 0)
+        fbuf = lax.ppermute(stage_fn(params["layers"], x), pp_axis, perm_f)
+        # --- B-tick i: stage s runs backward of mb i - 2(n-1) + s -----
+        m = i - 2 * (n - 1) + s
+        valid = (m >= 0) & (m < M)
+        mc = jnp.clip(m, 0, M - 1)
+        slot = jnp.where(valid, mc % (2 * n), 2 * n)
+        xb = lax.dynamic_index_in_dim(saved, slot, 0, keepdims=False)
+        y, stage_vjp = jax.vjp(stage_fn, params["layers"], xb)
+        tgt = lax.dynamic_index_in_dim(mbs_tgt, mc, 0, keepdims=False)
+        lval, head_vjp = jax.vjp(
+            lambda hp, y_: head_loss(hp, y_, tgt),
+            {kk: params[kk] for kk in head_keys}, y,
+        )
+        # Seed 1/M: the step's loss is the microbatch MEAN, so each
+        # microbatch's cotangent carries the mean's scaling.
+        dhp, dy_head = head_vjp(jnp.full((), 1.0 / M, lval.dtype))
+        on_last = (s == n - 1)
+        gy = jnp.where(on_last, dy_head.astype(fbuf.dtype), bbuf)
+        dlayers, dx = stage_vjp(gy)
+        toksb = lax.dynamic_index_in_dim(mbs_in, mc, 0, keepdims=False)
+        _, embed_vjp = jax.vjp(
+            lambda ep: embed_fn(ep, toksb),
+            {kk: params[kk] for kk in embed_keys},
+        )
+        # Stage 0's outgoing cotangent is the embedding's; a zero
+        # cotangent elsewhere makes the vjp contribute nothing.
+        (dep,) = embed_vjp(jnp.where(s == 0, dx, jnp.zeros_like(dx)))
+        w = valid.astype(jnp.float32)
+        gacc = _acc(gacc, ("layers",), {"layers": dlayers}, w)
+        gacc = _acc(gacc, head_keys, dhp, w * on_last.astype(jnp.float32))
+        gacc = _acc(gacc, embed_keys, dep, w)
+        loss_acc = loss_acc + jnp.where(valid & on_last, lval, 0.0)
+        bbuf = lax.ppermute(dx, pp_axis, perm_b)
+        return (saved, fbuf, bbuf, gacc, loss_acc), None
+
+    (saved, fbuf, bbuf, gacc, loss_acc), _ = lax.scan(
+        tick,
+        (saved, fbuf, bbuf, gacc, loss_acc),
+        jnp.arange(T, dtype=jnp.int32),
+    )
+
+    # Only the last stage accumulated loss; psum-fwd/identity-bwd is
+    # irrelevant here (no AD through this), plain psum replicates it.
+    return lax.psum(loss_acc, pp_axis) / M, gacc
+
+
 def make_pp_train_step(
     cfg,
     *,
@@ -361,6 +544,7 @@ def make_pp_train_step(
     grad_sync: bool = True,
     moe_aux_weight: float = 0.01,
     zero: bool = False,
+    schedule: str = "gpipe",
 ):
     """Compiled DP x PP train step for a scanned TransformerLM config.
 
@@ -410,6 +594,18 @@ def make_pp_train_step(
         # Same contract as make_train_step: the ZeRO reduce_scatter IS
         # the sync — it cannot be skipped.
         raise ValueError("grad_sync=False does not compose with zero=True")
+    if schedule not in ("gpipe", "1f1b"):
+        raise ValueError(f"unknown pipeline schedule {schedule!r}")
+    if schedule == "1f1b":
+        if cfg.cp_axis is not None:
+            raise ValueError(
+                "1f1b v1 does not compose with cp_axis (use gpipe)"
+            )
+        if cfg.moe_experts > 0 and moe_aux_weight > 0.0:
+            raise ValueError(
+                "1f1b v1 has no mutable-intermediates channel for the MoE "
+                "aux loss (use gpipe, or moe_aux_weight=0)"
+            )
     n_stages = mesh.shape[pp_axis]
     M = microbatches
     stack = _stage_stack(cfg, n_stages)
@@ -505,9 +701,15 @@ def make_pp_train_step(
         else:
             toks = batch["tokens"]
             inputs, targets = toks[:, :-1], toks[:, 1:]
-        loss, grads = jax.value_and_grad(pp_loss)(
-            state.params, inputs, targets
-        )
+        if schedule == "1f1b":
+            loss, grads = _pp_1f1b_loss_and_grads(
+                cfg, stack, state.params, inputs, targets,
+                pp_axis=pp_axis, n=n_stages, microbatches=M,
+            )
+        else:
+            loss, grads = jax.value_and_grad(pp_loss)(
+                state.params, inputs, targets
+            )
         # Complete replicated-param grads over the pipe (only the stages
         # that use them contributed); layer-slice grads stay local.
         gspecs = pp_param_specs(grads, pp_axis, cfg.tp_axis, cfg.ep_axis)
@@ -572,6 +774,8 @@ def make_pp_train_step(
                 check_vma=False,
             )
             compiled = jax.jit(sharded, **jit_kwargs)
+            step.jitted = compiled  # introspection: memory_analysis, AOT
         return compiled(state, batch, rng)
 
+    step.jitted = None
     return step

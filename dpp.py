@@ -104,7 +104,13 @@ def parse_args(argv=None):
                         "'pipe' mesh axis, layer stack sharded per stage "
                         "(scanned LM models only)")
     p.add_argument("--pp-microbatches", type=int, default=None,
-                   help="GPipe microbatches per step (default: --pp)")
+                   help="pipeline microbatches per step (default: --pp)")
+    p.add_argument("--pp-schedule", default="gpipe",
+                   choices=["gpipe", "1f1b"],
+                   help="pipeline schedule: gpipe (AD through the tick "
+                        "loop, O(microbatches) activation memory) or 1f1b "
+                        "(interleaved manual backward, O(stages) activation "
+                        "memory — the Megatron-LM 1F1B schedule)")
     p.add_argument("--moe-experts", type=int, default=0,
                    help="replace every block's MLP with N routed experts "
                         "(LM only)")
@@ -267,6 +273,16 @@ def validate_args(args) -> None:
             raise SystemExit(
                 f"--layers {args.layers} must be divisible by --pp {args.pp}"
             )
+        if args.pp_schedule == "1f1b":
+            if args.cp > 1:
+                raise SystemExit(
+                    "--pp-schedule 1f1b does not support --cp (use gpipe)"
+                )
+            if args.moe_experts and args.moe_aux_weight > 0:
+                raise SystemExit(
+                    "--pp-schedule 1f1b does not support the MoE aux loss; "
+                    "use gpipe or --moe-aux-weight 0"
+                )
     if args.fsdp:
         if not is_lm(args):
             raise SystemExit("--fsdp requires an LM model (--model gpt2|llama)")
@@ -679,6 +695,7 @@ def train(args) -> float:
         step_fn = ddp.make_pp_train_step(
             model.cfg, mesh=mesh, microbatches=M, zero=args.zero,
             moe_aux_weight=args.moe_aux_weight if args.moe_experts else 0.0,
+            schedule=args.pp_schedule,
         )
     else:
         # One factory for the other compositions: DP × {accum, buckets,

@@ -44,9 +44,9 @@ def _reference_step(cfg, params, tokens, tx):
     return float(loss), optax.apply_updates(params, updates)
 
 
-def _run_pp(cfg, params, tokens, tx, mesh, microbatches):
+def _run_pp(cfg, params, tokens, tx, mesh, microbatches, schedule="gpipe"):
     step = make_pp_train_step(cfg, mesh=mesh, microbatches=microbatches,
-                              donate=False)
+                              donate=False, schedule=schedule)
     state = ddp.TrainState.create(apply_fn=None, params=params, tx=tx)
     state = shard_state_pp(state, mesh)
     batch = shard_batch({"tokens": tokens}, mesh)
@@ -478,3 +478,188 @@ def test_cp_pp_zero_matches_replicated(devices):
         jax.tree.leaves(state.params), jax.tree.leaves(zstate.params)
     ):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-6)
+
+
+# --- 1F1B schedule (interleaved manual backward) ------------------------
+
+
+def test_1f1b_matches_gpipe_and_single_device(devices):
+    """The 1F1B schedule is a different EXECUTION ORDER of the same math:
+    loss equals GPipe's exactly and params match the single-device step
+    (manual vjp backward vs AD — tolerance covers recompute rounding)."""
+    cfg = _scan_cfg()
+    mesh = ddp.make_mesh(("data", "pipe"), shape=(2, 4))
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, 256, size=(8, 33)).astype(np.int32)
+    params = TransformerLM(cfg).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 32), jnp.int32)
+    )["params"]
+    tx = optax.adam(1e-2)
+    loss_ref, params_ref = _reference_step(cfg, params, tokens, tx)
+    loss_g, _ = _run_pp(cfg, params, tokens, tx, mesh, 4, schedule="gpipe")
+    loss_1, state = _run_pp(cfg, params, tokens, tx, mesh, 4, schedule="1f1b")
+    assert loss_1 == pytest.approx(loss_g, rel=1e-6)
+    assert loss_1 == pytest.approx(loss_ref, rel=1e-5)
+    for (path, a), b in zip(
+        jax.tree_util.tree_flatten_with_path(state.params)[0],
+        jax.tree.leaves(params_ref),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=5e-5,
+            err_msg="/".join(str(getattr(k, "key", k)) for k in path),
+        )
+
+
+def test_1f1b_tp_matches_single_device(devices):
+    """1F1B x Megatron TP: the stage body's collectives transpose inside
+    the manual jax.vjp exactly as under AD."""
+    cfg = _scan_cfg(num_heads=4, num_kv_heads=2, tp_axis="model")
+    cfg_ref = dataclasses.replace(cfg, tp_axis=None)
+    mesh = ddp.make_mesh(("data", "pipe", "model"), shape=(2, 2, 2))
+    rng = np.random.default_rng(5)
+    tokens = rng.integers(0, 256, size=(8, 33)).astype(np.int32)
+    params = TransformerLM(cfg_ref).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 32), jnp.int32)
+    )["params"]
+    tx = optax.sgd(0.1)
+    loss_ref, params_ref = _reference_step(cfg_ref, params, tokens, tx)
+    step = make_pp_train_step(
+        cfg, mesh=mesh, microbatches=4, donate=False, schedule="1f1b"
+    )
+    state = ddp.TrainState.create(apply_fn=None, params=params, tx=tx)
+    state = shard_state_pp(state, mesh, tp_axis="model")
+    state, metrics = step(
+        state, shard_batch({"tokens": tokens}, mesh), jax.random.PRNGKey(0)
+    )
+    assert float(metrics["loss"]) == pytest.approx(loss_ref, rel=1e-5)
+    for (path, a), b in zip(
+        jax.tree_util.tree_flatten_with_path(state.params)[0],
+        jax.tree.leaves(params_ref),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=5e-5,
+            err_msg="/".join(str(getattr(k, "key", k)) for k in path),
+        )
+
+
+def test_1f1b_activation_memory_flat_in_microbatches(devices):
+    """THE point of 1F1B: compiled temp memory is ~constant in the
+    microbatch count (a 2n-slot stage-input ring + per-tick transients)
+    while GPipe's grows linearly (AD keeps every microbatch's stage
+    activations until the reverse sweep)."""
+    cfg = _scan_cfg(
+        num_layers=8, d_model=128, d_ff=512, num_heads=4, max_seq_len=256
+    )
+    mesh = ddp.make_mesh(("data", "pipe"), shape=(2, 4))
+    params = TransformerLM(cfg).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 256), jnp.int32)
+    )["params"]
+    rng = np.random.default_rng(0)
+
+    def temp_mib(schedule, M):
+        tokens = rng.integers(0, 256, size=(4 * M, 257)).astype(np.int32)
+        step = make_pp_train_step(
+            cfg, mesh=mesh, microbatches=M, donate=False, schedule=schedule
+        )
+        state = ddp.TrainState.create(
+            apply_fn=None, params=params, tx=optax.sgd(0.1)
+        )
+        state = shard_state_pp(state, mesh)
+        batch = shard_batch({"tokens": tokens}, mesh)
+        state, _ = step(state, batch, jax.random.PRNGKey(0))
+        analysis = (
+            step.jitted.lower(state, batch, jax.random.PRNGKey(0))
+            .compile().memory_analysis()
+        )
+        if analysis is None:
+            pytest.skip("backend exposes no memory analysis")
+        return analysis.temp_size_in_bytes / 2**20
+
+    g4, g16 = temp_mib("gpipe", 4), temp_mib("gpipe", 16)
+    f4, f16 = temp_mib("1f1b", 4), temp_mib("1f1b", 16)
+    # GPipe grows with M; 1F1B stays flat and beats GPipe at M=16.
+    assert g16 > 1.5 * g4, (g4, g16)
+    assert f16 < 1.2 * f4, (f4, f16)
+    assert f16 < g16 / 2, (f16, g16)
+
+
+def test_1f1b_rejects_cp_and_moe_aux(devices):
+    mesh = ddp.make_mesh(("data", "pipe"), shape=(2, 4))
+    with pytest.raises(ValueError, match="cp_axis"):
+        make_pp_train_step(
+            _scan_cfg(cp_axis="seq"), mesh=mesh, microbatches=4,
+            schedule="1f1b",
+        )
+    with pytest.raises(ValueError, match="aux"):
+        make_pp_train_step(
+            _scan_cfg(moe_experts=4), mesh=mesh, microbatches=4,
+            schedule="1f1b", moe_aux_weight=0.01,
+        )
+
+
+def test_pp_eval_pads_tail_to_microbatch_multiple(devices):
+    """A tail batch whose per-position row count does not divide the
+    microbatch count must evaluate (padded with valid=0 rows), matching
+    the unsharded masked metrics on the valid rows."""
+    from distributeddataparallel_tpu.parallel import make_pp_eval_step
+    from distributeddataparallel_tpu.ops import (
+        per_example_accuracy,
+        per_example_cross_entropy,
+    )
+
+    cfg = _scan_cfg()
+    mesh = ddp.make_mesh(("data", "pipe"), shape=(2, 4))
+    model = TransformerLM(cfg)
+    rng = np.random.default_rng(2)
+    # 6 rows over data=2 -> 3 rows/position, not divisible by M=4.
+    tokens = rng.integers(0, 256, size=(6, 33)).astype(np.int32)
+    valid = np.array([1, 1, 1, 1, 1, 0], np.int32)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 32), jnp.int32)
+    )["params"]
+
+    logits = model.apply({"params": params}, jnp.asarray(tokens[:, :-1]))
+    v = jnp.asarray(valid, jnp.float32)
+    want_loss = float(
+        jnp.sum(per_example_cross_entropy(logits, tokens[:, 1:]) * v)
+        / v.sum()
+    )
+    want_acc = float(
+        jnp.sum(per_example_accuracy(logits, tokens[:, 1:]) * v) / v.sum()
+    )
+
+    eval_step = make_pp_eval_step(cfg, mesh=mesh, microbatches=4)
+    batch = shard_batch({"tokens": tokens, "valid": valid}, mesh)
+    metrics, cnt = eval_step(params, batch)
+    assert float(cnt) == 5.0
+    assert float(metrics["loss"]) == pytest.approx(want_loss, rel=1e-5)
+    assert float(metrics["accuracy"]) == pytest.approx(want_acc, abs=1e-6)
+
+
+def test_entrypoint_pp_1f1b_cli(devices):
+    """dpp.py --pp --pp-schedule 1f1b end-to-end (with eval)."""
+    import sys
+
+    sys.path.insert(0, "/root/repo")
+    import dpp
+
+    args = dpp.parse_args(
+        [
+            "--device", "cpu",
+            "--model", "gpt2",
+            "--layers", "4",
+            "--d-model", "32",
+            "--seq-len", "32",
+            "--vocab-size", "64",
+            "--pp", "2",
+            "--pp-microbatches", "4",
+            "--pp-schedule", "1f1b",
+            "--eval",
+            "--epochs", "1",
+            "--num-examples", "64",
+            "--batch-size", "8",
+            "--log-every", "1000",
+        ]
+    )
+    loss = dpp.train(args)
+    assert loss == loss
