@@ -53,6 +53,7 @@ from distributeddataparallel_tpu.parallel.pipeline_parallel import (  # noqa: F4
 from distributeddataparallel_tpu.parallel.fsdp import (  # noqa: F401
     fsdp_gather_params,
     fsdp_state,
+    make_fsdp_eval_step,
     make_fsdp_train_step,
 )
 from distributeddataparallel_tpu.training.state import TrainState  # noqa: F401

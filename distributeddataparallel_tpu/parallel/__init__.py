@@ -35,5 +35,6 @@ from distributeddataparallel_tpu.parallel.expert_parallel import (  # noqa: F401
 from distributeddataparallel_tpu.parallel.fsdp import (  # noqa: F401
     fsdp_gather_params,
     fsdp_state,
+    make_fsdp_eval_step,
     make_fsdp_train_step,
 )

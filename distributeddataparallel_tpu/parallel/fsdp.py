@@ -17,7 +17,9 @@ three facts:
    ``DecoderBlock`` the model uses.  Under ``cfg.remat`` the body is
    ``jax.checkpoint``ed, so the backward re-gathers each layer instead
    of keeping it live — peak weight memory is one layer, forward and
-   backward.
+   backward.  The non-layer flat is gathered separately around its two
+   uses (embedding in, head out) and checkpointed the same way, so the
+   full embeddings/head are never co-resident with the layer scan.
 3. **Gradient sync needs no code at all**: the AD transpose of
    ``all_gather`` IS ``psum_scatter``, so differentiating the forward
    produces reduce-scattered (1/N) gradients in exactly the storage
@@ -26,11 +28,31 @@ three facts:
 
 The elementwise optax update then runs directly on the sharded flats
 (same restriction as ZeRO-1: transforms needing global tensor structure
-don't apply).  ``fsdp_gather_params`` reassembles the full tree for
-checkpoints / generation / weight interchange.
+don't apply).
 
-v1 scope: scanned TransformerLM configs (``scan_layers=True``, no
-dropout), pure DP mesh — no TP/PP/CP/EP composition (rejected loudly).
+v2 additions over the round-2 v1:
+
+- **TP composition** (``tp_axis``): flats store each model position's
+  Megatron shard (model-major layout); the step still gathers over the
+  DATA axis only — each model position reconstitutes its own TP-local
+  layer and the block's conjugate operators do the rest.  The non-layer
+  flat is replicated per model position (standard Megatron embedding
+  placement).
+- **bf16 gathers** (``gather_dtype``): the f32 master flats are cast to
+  the gather dtype BEFORE the all_gather — half the collective bytes
+  and half the gathered-weight residency.  Norm scales ride along in
+  the lower precision (the torch-FSDP ``MixedPrecision(param_dtype=)``
+  trade).
+- **Streaming eval** (``make_fsdp_eval_step``): masked forward-only
+  metrics with the same per-layer gathers as training — the full
+  replicated tree is never materialized on device.
+- **Host gather** (``fsdp_gather_params(..., host=True)``): assembles
+  the full tree in host RAM shard by shard for checkpoint interchange
+  and generation at scales where a device-side gather would OOM.
+
+v2 scope: scanned TransformerLM configs (``scan_layers=True``, no
+dropout), DP x TP meshes — no CP/EP composition (rejected loudly), no
+grad_clip under TP (per-model-position flat norms would differ).
 """
 
 from __future__ import annotations
@@ -39,6 +61,7 @@ from typing import Any
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -54,12 +77,12 @@ def _abstract_params(cfg):
     if not cfg.scan_layers:
         raise ValueError("FSDP requires scan_layers=True")
     if cfg.dropout_rate:
-        raise ValueError("FSDP v1 does not support dropout")
-    for axis in (cfg.cp_axis, cfg.tp_axis, cfg.ep_axis):
+        raise ValueError("FSDP does not support dropout")
+    for axis in (cfg.cp_axis, cfg.ep_axis):
         if axis is not None:
-            raise ValueError(
-                "FSDP v1 is pure data parallelism: unset cp/tp/ep_axis"
-            )
+            raise ValueError("FSDP v2 composes with TP only: unset cp/ep_axis")
+    # eval_shape outside shard_map: tp_size() sees no bound axis, so the
+    # shapes come out FULL (unsharded) regardless of cfg.tp_axis.
     return jax.eval_shape(
         lambda: TransformerLM(cfg).init(
             jax.random.PRNGKey(0), jnp.zeros((1, 2), jnp.int32)
@@ -67,82 +90,190 @@ def _abstract_params(cfg):
     )
 
 
-class _Meta:
-    """Static flat-layout bookkeeping shared by state build and step."""
+def _path_names(path) -> tuple:
+    return tuple(str(getattr(k, "key", getattr(k, "name", k))) for k in path)
 
-    def __init__(self, cfg, n: int):
+
+class _Meta:
+    """Static flat-layout bookkeeping shared by state build and steps.
+
+    With ``n_tp > 1`` the layer templates are TP-LOCAL (Megatron-sharded
+    dims divided by ``n_tp``) and the global flats lay the model
+    positions out major: ``layers`` is ``(L, n_tp * layer_chunk * n)``
+    sharded ``P(None, (tp_axis, data_axis))`` so position ``(j, k)``
+    holds data-chunk ``k`` of model shard ``j``; ``rest`` tiles one
+    replicated copy per model position the same way.
+    """
+
+    def __init__(self, cfg, n: int, tp_axis: str | None = None, n_tp: int = 1):
+        from distributeddataparallel_tpu.parallel.tensor_parallel import (
+            _spec_for_path,
+        )
+
+        if (tp_axis is None) != (cfg.tp_axis is None):
+            # A cfg.tp_axis with full (non-localized) templates would run
+            # the Megatron psums over full weights — silently wrong, not
+            # a shape error.
+            raise ValueError(
+                "pass tp_axis to BOTH the config and the FSDP entry point"
+            )
         aparams = _abstract_params(cfg)
         self.cfg = cfg
         self.n = n
+        self.tp_axis = tp_axis
+        self.n_tp = n_tp if tp_axis is not None else 1
         self.L = cfg.num_layers
+        self._tp_rule = _spec_for_path
         layers = aparams["layers"]
-        # Single-layer template: the stacked leading dim stripped.
-        self.layer_template = jax.tree.map(
+        # Single-layer template: the stacked leading dim stripped, then
+        # Megatron-sharded dims divided for the TP-local view.
+        full_layer = jax.tree.map(
             lambda s: jax.ShapeDtypeStruct(s.shape[1:], s.dtype), layers
         )
+        self.layer_template = self._localize(full_layer)
         self.rest_template = {
             k: v for k, v in aparams.items() if k != "layers"
         }
         _, self.layer_chunk = flat_size(self.layer_template, n)
         _, self.rest_chunk = flat_size(self.rest_template, n)
 
-    def flatten_full(self, params: Pytree) -> dict:
-        """Full param tree -> {"layers": (L, layer_chunk*n) f32,
-        "rest": (rest_chunk*n,) f32}, assembled HOST-SIDE with numpy —
-        at the 8B scale this feature exists for, a full f32 flat on one
-        device would not fit its HBM (the subsequent device_put moves
-        each position only its shard)."""
-        import numpy as np
+    def _localize(self, template: Pytree) -> Pytree:
+        if self.n_tp == 1:
+            return template
+        flat = jax.tree_util.tree_flatten_with_path(template)[0]
+        treedef = jax.tree.structure(template)
+        leaves = []
+        for path, leaf in flat:
+            spec = self._tp_rule(_path_names(path), leaf, "model")
+            shape = list(leaf.shape)
+            for dim, name in enumerate(spec):
+                if name == "model":
+                    if shape[dim] % self.n_tp:
+                        raise ValueError(
+                            f"tp={self.n_tp} does not divide dim {dim} of "
+                            f"{'/'.join(_path_names(path))} {tuple(shape)}"
+                        )
+                    shape[dim] //= self.n_tp
+            leaves.append(jax.ShapeDtypeStruct(tuple(shape), leaf.dtype))
+        return jax.tree.unflatten(treedef, leaves)
 
-        # jax.tree.leaves everywhere: canonical (sorted-key) order, the
-        # same order zero.unflatten walks the template in.
-        lay = np.concatenate(
-            [
-                np.asarray(l, np.float32).reshape(self.L, -1)
-                for l in jax.tree.leaves(params["layers"])
-            ],
-            axis=1,
-        )
-        lay = np.pad(
-            lay, ((0, 0), (0, self.layer_chunk * self.n - lay.shape[1]))
-        )
+    def _model_dim(self, names, ndim: int) -> int | None:
+        """Which dim of a STACKED (leading L) layer leaf is Megatron-
+        sharded, or None."""
+        probe = jax.ShapeDtypeStruct((1,) * ndim, jnp.float32)
+        spec = self._tp_rule(names, probe, "model")
+        for dim, name in enumerate(spec):
+            if name == "model":
+                return dim
+        return None
+
+    def flatten_full(self, params: Pytree) -> dict:
+        """Full param tree -> the sharded-flat layout, assembled
+        HOST-SIDE with numpy — at the 8B scale this feature exists for,
+        a full f32 flat on one device would not fit its HBM (the
+        subsequent device_put moves each position only its shard)."""
+        parts = []
+        for j in range(self.n_tp):
+            rows = []
+            # jax.tree.leaves order everywhere: canonical (sorted-key)
+            # order, the same order zero.unflatten walks the template in.
+            for path, leaf in jax.tree_util.tree_flatten_with_path(
+                params["layers"]
+            )[0]:
+                arr = np.asarray(leaf, np.float32)
+                dim = (
+                    self._model_dim(_path_names(path), arr.ndim)
+                    if self.n_tp > 1 else None
+                )
+                if dim is not None:
+                    size = arr.shape[dim] // self.n_tp
+                    arr = np.take(
+                        arr, range(j * size, (j + 1) * size), axis=dim
+                    )
+                rows.append(arr.reshape(self.L, -1))
+            loc = np.concatenate(rows, axis=1)
+            parts.append(np.pad(
+                loc, ((0, 0), (0, self.layer_chunk * self.n - loc.shape[1]))
+            ))
+        lay = np.concatenate(parts, axis=1)
         rest_leaves = [
             np.asarray(l, np.float32).reshape(-1)
-            for l in jax.tree.leaves(
-                {k: v for k, v in params.items() if k != "layers"}
-            )
+            for l in jax.tree.leaves(self.rest_of(params))
         ]
         rest = (
             np.concatenate(rest_leaves)
             if rest_leaves else np.zeros((0,), np.float32)
         )
         rest = np.pad(rest, (0, self.rest_chunk * self.n - rest.shape[0]))
-        return {"layers": lay, "rest": rest}
+        return {"layers": lay, "rest": np.tile(rest, self.n_tp)}
+
+    @staticmethod
+    def rest_of(params: Pytree) -> dict:
+        return {k: v for k, v in params.items() if k != "layers"}
 
     def unflatten_full(self, flat: dict) -> Pytree:
-        """Inverse of flatten_full (full, gathered flats)."""
-        rest = unflatten(flat["rest"], self.rest_template)
-        layer_rows = [
-            unflatten(flat["layers"][i], self.layer_template)
-            for i in range(self.L)
-        ]
-        layers = jax.tree.map(
-            lambda *rows: jnp.stack(rows), *layer_rows
+        """Inverse of flatten_full (full, gathered flats): TP-local
+        segments unflattened per model position, sharded dims
+        re-concatenated.  Numpy inputs assemble entirely in numpy —
+        jnp.stack/concatenate would commit the ~full-tree intermediates
+        to a device, defeating the host=True gather."""
+        xp = np if isinstance(flat["layers"], np.ndarray) else jnp
+        rest = unflatten(
+            flat["rest"][: self.rest_chunk * self.n], self.rest_template
         )
-        return {"layers": layers, **rest}
+        seg_w = self.layer_chunk * self.n
+        per_j = []
+        for j in range(self.n_tp):
+            seg = flat["layers"][:, j * seg_w:(j + 1) * seg_w]
+            rows = [
+                unflatten(seg[i], self.layer_template)
+                for i in range(self.L)
+            ]
+            per_j.append(jax.tree.map(lambda *r: xp.stack(r), *rows))
+        if self.n_tp == 1:
+            return {"layers": per_j[0], **rest}
+        flat0, treedef = jax.tree_util.tree_flatten_with_path(per_j[0])
+        leaves = []
+        for i, (path, leaf0) in enumerate(flat0):
+            dim = self._model_dim(_path_names(path), leaf0.ndim)
+            if dim is None:
+                leaves.append(leaf0)  # replicated: any position's copy
+            else:
+                leaves.append(xp.concatenate(
+                    [jax.tree.leaves(t)[i] for t in per_j], axis=dim
+                ))
+        return {
+            "layers": jax.tree_util.tree_unflatten(treedef, leaves), **rest
+        }
+
+    def shard_axes(self, data_axis: str):
+        return (
+            (self.tp_axis, data_axis) if self.n_tp > 1 else data_axis
+        )
 
     def param_specs(self, axis_name: str) -> dict:
-        return {"layers": P(None, axis_name), "rest": P(axis_name)}
+        ax = self.shard_axes(axis_name)
+        return {"layers": P(None, ax), "rest": P(ax)}
 
     def flat_leaf_spec(self, leaf, axis_name: str) -> P:
         """Spec for opt-state leaves mirroring the flat params: the
         (L, chunk) stacks shard their chunk dim, flat vectors shard
         whole, scalars replicate."""
+        ax = self.shard_axes(axis_name)
         if getattr(leaf, "ndim", 0) == 2:
-            return P(None, axis_name)
+            return P(None, ax)
         if getattr(leaf, "ndim", 0) == 1:
-            return P(axis_name)
+            return P(ax)
         return P()
+
+    def gather_template(self, template: Pytree, dtype) -> Pytree:
+        """The template at the gather dtype (bf16 gathers unflatten to
+        bf16 leaves; None keeps the f32 master dtype)."""
+        if dtype is None:
+            return template
+        return jax.tree.map(
+            lambda s: jax.ShapeDtypeStruct(s.shape, dtype), template
+        )
 
 
 def fsdp_state(
@@ -153,25 +284,26 @@ def fsdp_state(
     *,
     apply_fn=None,
     axis_name: str = "data",
+    tp_axis: str | None = None,
 ):
     """Build the fully-sharded TrainState from a full param tree.
 
     params/grads/opt state are all 1/N per device; cross-device bytes
-    exist only transiently inside the step's per-layer gathers.
+    exist only transiently inside the step's per-layer gathers.  With
+    ``tp_axis`` the flats additionally split Megatron shards over the
+    model axis (1/(N*TP) layer residency per device).
     """
     from distributeddataparallel_tpu.training.state import TrainState
 
     n = mesh.shape[axis_name]
-    meta = _Meta(cfg, n)
+    n_tp = mesh.shape[tp_axis] if tp_axis is not None else 1
+    meta = _Meta(cfg, n, tp_axis, n_tp)
     flat = meta.flatten_full(params)
     flat = jax.tree.map(
         lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
         flat,
         meta.param_specs(axis_name),
     )
-
-    def init_opt(local_flat):
-        return tx.init(local_flat)
 
     opt_shapes = jax.eval_shape(
         tx.init,
@@ -187,7 +319,7 @@ def fsdp_state(
     )
     opt_state = jax.jit(
         jax.shard_map(
-            init_opt,
+            tx.init,
             mesh=mesh,
             in_specs=(meta.param_specs(axis_name),),
             out_specs=opt_specs,
@@ -206,14 +338,110 @@ def fsdp_state(
     )
 
 
-def fsdp_gather_params(cfg, state, mesh: Mesh, axis_name: str = "data"):
-    """Reassemble the full (replicated) param tree from the sharded flats
-    — for checkpoint interchange, evaluation, or generation."""
-    meta = _Meta(cfg, mesh.shape[axis_name])
+def fsdp_gather_params(
+    cfg,
+    state,
+    mesh: Mesh,
+    axis_name: str = "data",
+    tp_axis: str | None = None,
+    *,
+    host: bool = False,
+):
+    """Reassemble the full param tree from the sharded flats — for
+    checkpoint interchange, evaluation, or generation.
+
+    ``host=False`` materializes the tree REPLICATED on every device:
+    fine at small scale, guaranteed OOM at the 8B scale FSDP exists for
+    (a full f32 tree is ~30 GB).  ``host=True`` pulls the flats into
+    host RAM and assembles with numpy — no device memory spike; the
+    caller decides what (if anything) goes back to device, e.g. a bf16
+    cast for decoding.  Prefer ``make_fsdp_eval_step`` for evaluation —
+    it never forms the full tree at all.
+    """
+    n_tp = mesh.shape[tp_axis] if tp_axis is not None else 1
+    meta = _Meta(cfg, mesh.shape[axis_name], tp_axis, n_tp)
+    if host:
+        full_flat = jax.tree.map(
+            lambda x: np.asarray(jax.device_get(x)), state.params
+        )
+        return jax.tree.map(
+            np.asarray, meta.unflatten_full(full_flat)
+        )
     full_flat = jax.tree.map(
         lambda x: jax.device_put(x, NamedSharding(mesh, P())), state.params
     )
     return meta.unflatten_full(full_flat)
+
+
+def _forward_pieces(cfg, meta, *, data_axis: str, gather_dtype):
+    """The shared embed -> layer-scan -> head forward over sharded flats
+    (training loss and streaming eval both build on this).
+
+    Returns ``forward(flat, inputs) -> logits`` plus the rope tables.
+    Each piece gathers what it needs and is checkpointed under
+    ``cfg.remat`` so the backward re-gathers instead of keeping gathered
+    weights alive — the full rest (embeddings + head) is never
+    co-resident with the layer scan.
+    """
+    from distributeddataparallel_tpu.models.transformer import (
+        DecoderBlock,
+        rope_frequencies,
+    )
+    from distributeddataparallel_tpu.parallel.pipeline_parallel import (
+        _embed,
+        _head,
+    )
+
+    block = DecoderBlock(cfg)
+    rest_tmpl = meta.gather_template(meta.rest_template, gather_dtype)
+    layer_tmpl = meta.gather_template(meta.layer_template, gather_dtype)
+    gdt = gather_dtype or jnp.float32
+
+    def gather_rest(flat_rest):
+        vec = lax.all_gather(
+            flat_rest.astype(gdt), data_axis, axis=0, tiled=True
+        )
+        return unflatten(vec, rest_tmpl)
+
+    def embed_part(flat_rest, inputs):
+        return _embed(cfg, gather_rest(flat_rest), inputs)
+
+    def head_part(flat_rest, x):
+        return _head(cfg, gather_rest(flat_rest), x)
+
+    if cfg.remat:
+        embed_part = jax.checkpoint(embed_part, prevent_cse=False)
+        head_part = jax.checkpoint(head_part, prevent_cse=False)
+
+    def forward(flat, inputs):
+        from distributeddataparallel_tpu.parallel.pipeline_parallel import (
+            _check_seq_bound,
+        )
+
+        _check_seq_bound(cfg, inputs.shape[1])
+        rope = (
+            rope_frequencies(
+                cfg.dims_per_head, cfg.max_seq_len, theta=cfg.rope_theta
+            )
+            if cfg.positional == "rope"
+            else None
+        )
+
+        def body(x, layer_row):
+            vec = lax.all_gather(
+                layer_row.astype(gdt), data_axis, axis=0, tiled=True
+            )
+            lp = unflatten(vec, layer_tmpl)
+            y = block.apply({"params": lp["block"]}, x, None, rope, True)
+            return y, None
+
+        if cfg.remat:
+            body = jax.checkpoint(body, prevent_cse=False)
+        x = embed_part(flat["rest"], inputs)
+        x, _ = lax.scan(body, x, flat["layers"])
+        return head_part(flat["rest"], x)
+
+    return forward
 
 
 def make_fsdp_train_step(
@@ -221,9 +449,11 @@ def make_fsdp_train_step(
     *,
     mesh: Mesh,
     data_axis: str = "data",
+    tp_axis: str | None = None,
     donate: bool = True,
     grad_clip: float | None = None,
     accum_steps: int = 1,
+    gather_dtype=None,
 ):
     """Compiled FSDP train step for a scanned TransformerLM config.
 
@@ -234,6 +464,19 @@ def make_fsdp_train_step(
     backward re-gathers (``cfg.remat``) and reduce-scatters gradients —
     both directions emerge from AD of the all_gather, no hooks anywhere.
 
+    ``tp_axis``: FSDP x Megatron — state from ``fsdp_state(...,
+    tp_axis=)``, cfg with ``tp_axis`` set.  Gathers stay on the data
+    axis (each model position reconstitutes its own TP shard); the
+    block's conjugate operators complete replicated-param grads across
+    the model axis, so the psum_scatter from AD remains the only
+    data-axis sync.
+
+    ``gather_dtype`` (e.g. ``jnp.bfloat16``): cast the f32 master shards
+    to this dtype BEFORE the all_gather — halves collective bytes and
+    gathered-weight residency; norm scales ride in the lower precision
+    (torch-FSDP's ``param_dtype`` mixed-precision trade).  Grads still
+    land f32 on the master flats.
+
     ``accum_steps`` accumulates microbatch gradients IN THE SHARDED
     layout (each microbatch's reduce-scatter lands on the 1/N flats and
     sums there) — like torch FSDP under no_sync, every microbatch still
@@ -241,54 +484,29 @@ def make_fsdp_train_step(
     """
     if accum_steps < 1:
         raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
-    from distributeddataparallel_tpu.models.transformer import (
-        DecoderBlock,
-        rope_frequencies,
-    )
+    if (tp_axis is None) != (cfg.tp_axis is None):
+        raise ValueError("pass tp_axis to BOTH the config and the factory")
+    if grad_clip is not None and tp_axis is not None:
+        raise ValueError(
+            "grad_clip under FSDP x TP needs a model-axis-aware norm "
+            "(per-position flat norms differ); drop one of the two"
+        )
     from distributeddataparallel_tpu.ops.losses import lm_cross_entropy
-    from distributeddataparallel_tpu.parallel.pipeline_parallel import (
-        _check_seq_bound,
-        _embed,
-        _head,
-    )
 
     n = mesh.shape[data_axis]
-    meta = _Meta(cfg, n)
-    block = DecoderBlock(cfg)
+    n_tp = mesh.shape[tp_axis] if tp_axis is not None else 1
+    meta = _Meta(cfg, n, tp_axis, n_tp)
+    forward = _forward_pieces(
+        cfg, meta, data_axis=data_axis, gather_dtype=gather_dtype
+    )
 
     def _replica_step(state, batch, rng):
         toks = batch["tokens"]
         inputs, targets = toks[:, :-1], toks[:, 1:]
         S = inputs.shape[1]
-        _check_seq_bound(cfg, S)
-        rope = (
-            rope_frequencies(
-                cfg.dims_per_head, cfg.max_seq_len, theta=cfg.rope_theta
-            )
-            if cfg.positional == "rope"
-            else None
-        )
 
         def loss_fn(flat, inputs, targets):
-            rest_vec = lax.all_gather(
-                flat["rest"], data_axis, axis=0, tiled=True
-            )
-            rest = unflatten(rest_vec, meta.rest_template)
-            x = _embed(cfg, rest, inputs)
-
-            def body(x, layer_row):
-                vec = lax.all_gather(
-                    layer_row, data_axis, axis=0, tiled=True
-                )
-                lp = unflatten(vec, meta.layer_template)
-                y = block.apply({"params": lp["block"]}, x, None, rope, True)
-                return y, None
-
-            if cfg.remat:
-                body = jax.checkpoint(body, prevent_cse=False)
-            x, _ = lax.scan(body, x, flat["layers"])
-            logits = _head(cfg, rest, x)
-            return lm_cross_entropy(logits, targets)
+            return lm_cross_entropy(forward(flat, inputs), targets)
 
         if accum_steps == 1:
             loss, gflat = jax.value_and_grad(loss_fn)(
@@ -325,8 +543,11 @@ def make_fsdp_train_step(
             loss = loss * inv
         # The all_gather transpose SUMMED per-replica contributions into
         # each shard; divide for DDP mean semantics (global loss is the
-        # mean of per-replica means).
-        gflat = jax.tree.map(lambda g: g / n, gflat)
+        # mean of per-replica means).  Cast: under gather_dtype the
+        # cotangents arrive in that dtype; the master update is f32.
+        gflat = jax.tree.map(
+            lambda g, p: g.astype(p.dtype) / n, gflat, state.params
+        )
         if grad_clip is not None:
             # The flat shards partition the gradient vector: global
             # norm² is one psum of local sum-of-squares — exact.
@@ -366,6 +587,75 @@ def make_fsdp_train_step(
                 check_vma=False,
             )
             compiled = jax.jit(sharded, **jit_kwargs)
+            step.jitted = compiled
         return compiled(state, batch, rng)
 
+    step.jitted = None
     return step
+
+
+def make_fsdp_eval_step(
+    cfg,
+    *,
+    mesh: Mesh,
+    data_axis: str = "data",
+    tp_axis: str | None = None,
+    gather_dtype=None,
+):
+    """Streaming masked evaluation over the sharded flats.
+
+    ``eval_step(params_flat, batch) -> (metrics, count)`` with the same
+    contract as ``make_eval_step(masked=True)``: ``batch = {"tokens":
+    (B_local, S+1), "valid": (B_local,)}``, per-row metrics weighted by
+    the valid mask, count = global valid rows.  The forward is the
+    training step's (per-layer gathers, short-liveness rest) — the full
+    replicated tree that ``fsdp_gather_params`` would materialize never
+    exists, which is what makes ``--fsdp --eval`` viable at 8B
+    (ADVICE r2: the gathered-eval path silently capped FSDP at small
+    models).
+    """
+    from distributeddataparallel_tpu.ops.losses import (
+        per_example_accuracy,
+        per_example_cross_entropy,
+    )
+
+    n = mesh.shape[data_axis]
+    n_tp = mesh.shape[tp_axis] if tp_axis is not None else 1
+    meta = _Meta(cfg, n, tp_axis, n_tp)
+    forward = _forward_pieces(
+        cfg, meta, data_axis=data_axis, gather_dtype=gather_dtype
+    )
+
+    def _eval(flat, batch):
+        toks, valid = batch["tokens"], batch["valid"]
+        inputs, targets = toks[:, :-1], toks[:, 1:]
+        logits = forward(flat, inputs)
+        v = valid.astype(jnp.float32)
+        loss_sum = jnp.sum(per_example_cross_entropy(logits, targets) * v)
+        acc_sum = jnp.sum(per_example_accuracy(logits, targets) * v)
+        cnt = jnp.sum(v)
+        loss_sum, acc_sum, cnt = (
+            lax.psum(x, data_axis) for x in (loss_sum, acc_sum, cnt)
+        )
+        denom = jnp.maximum(cnt, 1.0)
+        return {"loss": loss_sum / denom, "accuracy": acc_sum / denom}, cnt
+
+    compiled = None
+
+    def eval_step(params_flat, batch):
+        nonlocal compiled
+        if compiled is None:
+            sharded = jax.shard_map(
+                _eval,
+                mesh=mesh,
+                in_specs=(
+                    meta.param_specs(data_axis),
+                    {"tokens": P(data_axis), "valid": P(data_axis)},
+                ),
+                out_specs=(P(), P()),
+                check_vma=False,
+            )
+            compiled = jax.jit(sharded)
+        return compiled(params_flat, batch)
+
+    return eval_step

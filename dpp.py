@@ -129,6 +129,10 @@ def parse_args(argv=None):
                         "under --ep tokens travel via all_to_all); 0 = "
                         "dense einsum dispatch (every token through every "
                         "local expert — exact, right for tiny E)")
+    p.add_argument("--fsdp-gather", choices=["f32", "bf16"], default="f32",
+                   help="dtype for FSDP weight gathers: bf16 halves "
+                        "collective bytes and gathered-weight residency "
+                        "(f32 master storage either way)")
     p.add_argument("--zero", action="store_true",
                    help="ZeRO-1 optimizer-state sharding across the data "
                         "axis (reduce_scatter + sharded update + all_gather)")
@@ -288,7 +292,7 @@ def validate_args(args) -> None:
             raise SystemExit("--fsdp requires an LM model (--model gpt2|llama)")
         bad = [
             f for f, on in (
-                ("--zero", args.zero), ("--tp", args.tp > 1),
+                ("--zero", args.zero),
                 ("--pp", args.pp > 1), ("--cp", args.cp > 1),
                 ("--ep", args.ep > 1), ("--moe-experts", bool(args.moe_experts)),
                 ("--bucket-mb", bool(args.bucket_mb)),
@@ -296,7 +300,12 @@ def validate_args(args) -> None:
         ]
         if bad:
             raise SystemExit(
-                f"--fsdp v1 is pure data parallelism; drop {', '.join(bad)}"
+                f"--fsdp composes with --tp only; drop {', '.join(bad)}"
+            )
+        if args.grad_clip is not None and args.tp > 1:
+            raise SystemExit(
+                "--fsdp --tp does not support --grad-clip (per-position "
+                "flat norms differ)"
             )
     if args.augment and is_lm(args):
         raise SystemExit("--augment is for image datasets only")
@@ -561,7 +570,8 @@ def train(args) -> float:
         # Fully-sharded: params/grads/opt state 1/N per device; the step
         # gathers one layer at a time (parallel/fsdp.py).
         state = ddp.fsdp_state(
-            model.cfg, params, tx, mesh, apply_fn=model.apply
+            model.cfg, params, tx, mesh, apply_fn=model.apply,
+            tp_axis="model" if args.tp > 1 else None,
         )
     elif args.zero:
         # With --tp/--ep/--pp, zero_state places params in the sharded
@@ -676,6 +686,8 @@ def train(args) -> float:
         step_fn = ddp.make_fsdp_train_step(
             model.cfg, mesh=mesh, grad_clip=args.grad_clip,
             accum_steps=args.accum_steps,
+            tp_axis="model" if args.tp > 1 else None,
+            gather_dtype=jnp.bfloat16 if args.fsdp_gather == "bf16" else None,
         )
     elif args.pp > 1:
         # GPipe: the step factory takes the model CONFIG (it decomposes
@@ -716,7 +728,14 @@ def train(args) -> float:
         sharded flats are gathered back to the model layout (reads the
         CURRENT state)."""
         if args.fsdp:
-            return ddp.fsdp_gather_params(model.cfg, state, mesh)
+            # Host-side assembly: no device-memory spike (the device-side
+            # replicated gather would OOM at the 8B scale FSDP exists
+            # for); the caller's jit commits what it needs back.
+            host = ddp.fsdp_gather_params(
+                model.cfg, state, mesh,
+                tp_axis="model" if args.tp > 1 else None, host=True,
+            )
+            return jax.tree.map(jnp.asarray, host)
         return state.params
 
     ckpt = None
@@ -798,6 +817,19 @@ def train(args) -> float:
         eval_step = make_pp_eval_step(
             model.cfg, mesh=mesh,
             microbatches=args.pp_microbatches or args.pp,
+        )
+        eval_loader = DataLoader(
+            build_dataset(args, train=False), per_replica_batch=args.batch_size,
+            mesh=mesh, shuffle=False, seed=args.seed, drop_last=False,
+            with_mask=True,
+        )
+    elif args.eval and args.fsdp:
+        # Streaming masked eval over the sharded flats: per-layer gathers,
+        # no full replicated tree, no 2x-params transient (ADVICE r2).
+        eval_step = ddp.make_fsdp_eval_step(
+            model.cfg, mesh=mesh,
+            tp_axis="model" if args.tp > 1 else None,
+            gather_dtype=jnp.bfloat16 if args.fsdp_gather == "bf16" else None,
         )
         eval_loader = DataLoader(
             build_dataset(args, train=False), per_replica_batch=args.batch_size,
@@ -937,9 +969,9 @@ def train(args) -> float:
             # Masked eval: each step returns (masked means, valid-row
             # count); weighting means by counts is exactly the mean over
             # unique samples — sampler pad duplicates contribute nothing.
-            # FSDP: gather the replicated param tree ONCE per epoch (the
-            # sharded flats are not the model layout the eval applies).
-            eval_params = full_params()
+            # FSDP streams over the sharded flats; everything else gets
+            # the (possibly gathered) model-layout tree.
+            eval_params = state.params if args.fsdp else full_params()
             evals = []
             for b in eval_loader:
                 m, cnt = (
@@ -978,7 +1010,18 @@ def train(args) -> float:
             dataset.tokens[:2, : max(args.seq_len // 4, 1)], jnp.int32
         )
         n_new = min(args.generate, model.cfg.max_seq_len - prompt.shape[1])
-        out = _gen(model, full_params(), prompt, n_new)
+        gen_model = model
+        if args.fsdp and model.cfg.tp_axis is not None:
+            # FSDP x TP: full_params() reassembled the FULL unsharded
+            # tree, so decode runs on a TP-free twin config.
+            import dataclasses
+
+            from distributeddataparallel_tpu.models import TransformerLM
+
+            gen_model = TransformerLM(
+                dataclasses.replace(model.cfg, tp_axis=None)
+            )
+        out = _gen(gen_model, full_params(), prompt, n_new)
         log0("generate: prompt %s -> %s (last 8 tokens: %s)",
              prompt.shape, out.shape, np.asarray(out[0, -8:]).tolist())
 

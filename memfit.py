@@ -205,11 +205,15 @@ def analyze(seq_len: int, microbatches=(1, 2)) -> dict:
     from distributeddataparallel_tpu.parallel.fsdp import _Meta
 
     meta = _Meta(full_cfg, FSDPN)
-    layer_full = 4 * sum(
-        l.size for l in jax.tree.leaves(meta.layer_template)
-    )
-    rest_full = 4 * meta.rest_chunk * FSDPN
+    layer_elems = sum(l.size for l in jax.tree.leaves(meta.layer_template))
+    rest_elems = meta.rest_chunk * FSDPN
+    # v2 gathers ride bf16 (gather_dtype) and the rest flat is
+    # checkpointed around its two uses, so the transient is the LARGER
+    # of (gathered rest) and (~2 gathered layers), not their sum.
+    fsdp_transient = max(2 * rest_elems, 2 * 2 * layer_elems)
     fsdp_stored = 4 * (meta.L * meta.layer_chunk + meta.rest_chunk)
+    FSDPN32 = 32
+    fsdp32_stored = fsdp_stored * FSDPN / FSDPN32
     rows = []
     for name, tx in (
         ("sgd", sgd),
@@ -240,8 +244,10 @@ def analyze(seq_len: int, microbatches=(1, 2)) -> dict:
         opt_mult = opt_bytes / max(params_bytes, 1)  # 0 sgd, 1 mom, 2 adamw
         residual = max(model_fixed - 2 * params_bytes, 0)
         fsdp_fixed = (
-            fsdp_stored * (2 + opt_mult) + rest_full + 2 * layer_full
-            + residual
+            fsdp_stored * (2 + opt_mult) + fsdp_transient + residual
+        )
+        fsdp32_fixed = (
+            fsdp32_stored * (2 + opt_mult) + fsdp_transient + residual
         )
         rows.append({
             "optimizer": name,
@@ -263,6 +269,9 @@ def analyze(seq_len: int, microbatches=(1, 2)) -> dict:
             "tp8_zero8_max_mb_v5p": max_mb(V5P_HBM_BYTES, tp_zero_fixed),
             "fsdp8_fixed_gb": gb(fsdp_fixed),
             "fsdp8_max_mb_v5p": max_mb(V5P_HBM_BYTES, fsdp_fixed),
+            "fsdp8_max_mb_v5e": max_mb(hbm, fsdp_fixed),
+            "fsdp32_fixed_gb": gb(fsdp32_fixed),
+            "fsdp32_max_mb_v5e": max_mb(hbm, fsdp32_fixed),
         })
 
     return {
@@ -316,8 +325,11 @@ def main() -> None:
           "max mb (v5e 16G) | max mb (v5p 95G) | ZeRO-1x8 fixed | "
           "ZeRO-1x8 max mb (v5p) | TP-8 fixed | TP-8 max mb (v5p) | "
           "TP-8 x ZeRO-1x8 fixed | TP-8 x ZeRO max mb (v5p) | "
-          "FSDP-8 fixed | FSDP-8 max mb (v5p) |")
-    print("|---|---|---|---|---|---|---|---|---|---|---|---|---|---|")
+          "FSDP-8 fixed | FSDP-8 max mb (v5p) | FSDP-8 max mb (v5e 16G) | "
+          "FSDP-32 fixed | FSDP-32 max mb (v5e 16G) |  "
+          "(FSDP columns assume --fsdp-gather bf16; f32 gathers double "
+          "the transient term)")
+    print("|---|---|---|---|---|---|---|---|---|---|---|---|---|---|---|---|---|")
     for row in r["optimizers"]:
         mbs = sorted(row["peak8b_gb"])
         print(
@@ -328,7 +340,9 @@ def main() -> None:
             f"| {row['tp8_fixed_gb']} GB | {row['tp8_max_mb_v5p']} "
             f"| {row['tp8_zero8_fixed_gb']} GB "
             f"| {row['tp8_zero8_max_mb_v5p']} "
-            f"| {row['fsdp8_fixed_gb']} GB | {row['fsdp8_max_mb_v5p']} |"
+            f"| {row['fsdp8_fixed_gb']} GB | {row['fsdp8_max_mb_v5p']} "
+            f"| {row['fsdp8_max_mb_v5e']} "
+            f"| {row['fsdp32_fixed_gb']} GB | {row['fsdp32_max_mb_v5e']} |"
         )
     import json
     print("\n```json")

@@ -147,8 +147,19 @@ def test_fsdp_residency(devices):
 def test_fsdp_guards(devices):
     with pytest.raises(ValueError, match="scan_layers"):
         _Meta(_cfg(scan_layers=False), 8)
-    with pytest.raises(ValueError, match="pure data parallelism"):
-        _Meta(dataclasses.replace(_cfg(), tp_axis="model"), 8)
+    with pytest.raises(ValueError, match="TP only"):
+        _Meta(dataclasses.replace(_cfg(), cp_axis="seq"), 8)
+    # tp_axis must be given to BOTH the config and the factory.
+    mesh = ddp.make_mesh(("data", "model"), shape=(4, 2))
+    with pytest.raises(ValueError, match="BOTH"):
+        make_fsdp_train_step(
+            dataclasses.replace(_cfg(), tp_axis="model"), mesh=mesh
+        )
+    with pytest.raises(ValueError, match="grad_clip under FSDP x TP"):
+        make_fsdp_train_step(
+            dataclasses.replace(_cfg(), tp_axis="model"), mesh=mesh,
+            tp_axis="model", grad_clip=1.0,
+        )
 
 
 def test_fsdp_accum_matches_single_big_batch(devices):
@@ -195,3 +206,175 @@ def test_entrypoint_fsdp_eval_generate(devices):
          "--log-every", "1000"]
     ))
     assert loss == loss  # finite: gather->eval->decode wiring intact
+
+
+# --- FSDP v2: TP composition, bf16 gathers, streaming eval, host gather --
+
+
+def test_fsdp_tp_matches_single_device(devices):
+    """FSDP(4) x Megatron TP(2): flats store each model position's TP
+    shard, gathers ride the data axis only — still equal to the
+    single-device step, adam state included."""
+    cfg = _cfg(num_heads=4, num_kv_heads=2)
+    cfg_tp = dataclasses.replace(cfg, tp_axis="model")
+    mesh = ddp.make_mesh(("data", "model"), shape=(4, 2))
+    model = TransformerLM(cfg)
+    rng = np.random.default_rng(7)
+    tokens = rng.integers(0, 256, size=(8, 17)).astype(np.int32)
+    params = _init_params(cfg)
+    tx = optax.adam(1e-2)
+
+    def ref_loss(p):
+        logits = model.apply({"params": p}, jnp.asarray(tokens[:, :-1]))
+        return lm_cross_entropy(logits, jnp.asarray(tokens[:, 1:]))
+
+    loss_ref, grads_ref = jax.value_and_grad(ref_loss)(params)
+    updates, _ = tx.update(grads_ref, tx.init(params), params)
+    params_ref = optax.apply_updates(params, updates)
+
+    state = fsdp_state(cfg_tp, params, tx, mesh, tp_axis="model")
+    step = make_fsdp_train_step(
+        cfg_tp, mesh=mesh, tp_axis="model", donate=False
+    )
+    state, metrics = step(
+        state, shard_batch({"tokens": tokens}, mesh), jax.random.PRNGKey(0)
+    )
+    assert float(metrics["loss"]) == pytest.approx(float(loss_ref), rel=1e-5)
+    got = fsdp_gather_params(cfg_tp, state, mesh, tp_axis="model")
+    # atol 1e-4 as in test_fsdp_adam_multi_step: adam's rsqrt amplifies
+    # the reduce-scatter's different fp summation order.
+    for (path, a), b in zip(
+        jax.tree_util.tree_flatten_with_path(got)[0],
+        jax.tree.leaves(params_ref),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-4,
+            err_msg="/".join(str(getattr(k, "key", k)) for k in path),
+        )
+
+
+def test_fsdp_tp_flat_roundtrip(devices):
+    """flatten_full/unflatten_full with TP: Megatron shards laid out
+    model-major round-trip exactly to the original tree."""
+    cfg = dataclasses.replace(
+        _cfg(num_heads=4, num_kv_heads=2), tp_axis="model"
+    )
+    params = _init_params(dataclasses.replace(cfg, tp_axis=None))
+    meta = _Meta(cfg, n=4, tp_axis="model", n_tp=2)
+    back = meta.unflatten_full(
+        {k: jnp.asarray(v) for k, v in meta.flatten_full(params).items()}
+    )
+    for (path, a), b in zip(
+        jax.tree_util.tree_flatten_with_path(params)[0],
+        jax.tree.leaves(back),
+    ):
+        np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b),
+            err_msg="/".join(str(getattr(k, "key", k)) for k in path),
+        )
+
+
+def test_fsdp_gather_params_host(devices):
+    """host=True assembles the full tree in host RAM (pure numpy leaves)
+    and matches the device-side gather exactly."""
+    cfg = _cfg()
+    mesh = ddp.make_mesh(("data",))
+    params = _init_params(cfg)
+    state = fsdp_state(cfg, params, optax.sgd(0.1), mesh)
+    dev = fsdp_gather_params(cfg, state, mesh)
+    host = fsdp_gather_params(cfg, state, mesh, host=True)
+    for (path, a), b in zip(
+        jax.tree_util.tree_flatten_with_path(host)[0], jax.tree.leaves(dev)
+    ):
+        assert isinstance(a, np.ndarray)
+        np.testing.assert_array_equal(a, np.asarray(b), err_msg=str(path))
+
+
+def test_fsdp_streaming_eval_matches_direct(devices):
+    """make_fsdp_eval_step (per-layer gathers, no full tree) reproduces
+    the direct masked metrics, padded rows excluded."""
+    from distributeddataparallel_tpu.ops import (
+        per_example_accuracy,
+        per_example_cross_entropy,
+    )
+    from distributeddataparallel_tpu.parallel.fsdp import make_fsdp_eval_step
+
+    cfg = _cfg()
+    mesh = ddp.make_mesh(("data",))
+    model = TransformerLM(cfg)
+    rng = np.random.default_rng(3)
+    tokens = rng.integers(0, 256, size=(16, 17)).astype(np.int32)
+    valid = np.array([1] * 13 + [0] * 3, np.int32)
+    params = _init_params(cfg)
+
+    logits = model.apply({"params": params}, jnp.asarray(tokens[:, :-1]))
+    v = jnp.asarray(valid, jnp.float32)
+    want_loss = float(
+        jnp.sum(per_example_cross_entropy(logits, tokens[:, 1:]) * v) / v.sum()
+    )
+    want_acc = float(
+        jnp.sum(per_example_accuracy(logits, tokens[:, 1:]) * v) / v.sum()
+    )
+
+    state = fsdp_state(cfg, params, optax.sgd(0.1), mesh)
+    eval_step = make_fsdp_eval_step(cfg, mesh=mesh)
+    metrics, cnt = eval_step(
+        state.params, shard_batch({"tokens": tokens, "valid": valid}, mesh)
+    )
+    assert float(cnt) == 13.0
+    assert float(metrics["loss"]) == pytest.approx(want_loss, rel=1e-5)
+    assert float(metrics["accuracy"]) == pytest.approx(want_acc, abs=1e-6)
+
+
+def test_fsdp_bf16_gather_runs_and_tracks_f32(devices):
+    """gather_dtype=bfloat16: master flats stay f32, the step runs, and
+    the loss tracks the exact f32 step within bf16 rounding."""
+    cfg = _cfg()
+    mesh = ddp.make_mesh(("data",))
+    rng = np.random.default_rng(11)
+    tokens = rng.integers(0, 256, size=(8, 17)).astype(np.int32)
+    params = _init_params(cfg)
+    batch = shard_batch({"tokens": tokens}, mesh)
+
+    def run(gdt):
+        state = fsdp_state(cfg, params, optax.sgd(0.1), mesh)
+        step = make_fsdp_train_step(
+            cfg, mesh=mesh, donate=False, gather_dtype=gdt
+        )
+        state, m = step(state, batch, jax.random.PRNGKey(0))
+        assert state.params["layers"].dtype == jnp.float32
+        return float(m["loss"]), state
+
+    loss_f32, _ = run(None)
+    loss_bf16, _ = run(jnp.bfloat16)
+    assert loss_bf16 == pytest.approx(loss_f32, rel=2e-2)
+
+
+def test_entrypoint_fsdp_tp_cli(devices):
+    """dpp.py --fsdp --tp 2 end-to-end with streaming eval and host-
+    gathered generation."""
+    import sys
+
+    sys.path.insert(0, "/root/repo")
+    import dpp
+
+    args = dpp.parse_args(
+        [
+            "--device", "cpu",
+            "--model", "llama",
+            "--layers", "2",
+            "--d-model", "64",
+            "--seq-len", "32",
+            "--vocab-size", "64",
+            "--fsdp",
+            "--tp", "2",
+            "--eval",
+            "--generate", "8",
+            "--epochs", "1",
+            "--num-examples", "64",
+            "--batch-size", "4",
+            "--log-every", "1000",
+        ]
+    )
+    loss = dpp.train(args)
+    assert loss == loss
