@@ -321,7 +321,10 @@ class Attention(nn.Module):
             # Ring attention contracts q and kv headwise: expand GQA here.
             k = repeat_kv(k, Hl // Hkvl)
             v = repeat_kv(v, Hl // Hkvl)
-            out = ring_attention(q, k, v, axis_name=cfg.cp_axis, causal=True)
+            out = ring_attention(
+                q, k, v, axis_name=cfg.cp_axis, causal=True,
+                impl=cfg.attn_impl,
+            )
         else:
             # GQA kv stays at its own head count: the flash kernel indexes
             # the shared head natively; the XLA path expands internally.

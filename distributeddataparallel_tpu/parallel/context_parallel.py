@@ -29,6 +29,7 @@ are per-token and need no communication; attention is the one collective.
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Callable
 
 import jax
@@ -41,6 +42,122 @@ from distributeddataparallel_tpu.ops.attention import NEG_INF, causal_mask_bias
 Pytree = Any
 
 
+def _flash_ring_fwd_impl(q, k, v, axis_name: str, interpret: bool):
+    """Ring forward where each hop's block is the PALLAS flash kernel.
+
+    Hop 0 runs the causal diagonal; later hops run the visiting chunk
+    unmasked (its keys are strictly earlier) and wrapped chunks (strictly
+    later keys) are zeroed by forcing their lse to -inf before the
+    online-softmax merge of normalized partials:
+    ``o = Σ o_i · exp(lse_i - logaddexp(lse…))``.
+    Returns ``(out, lse)`` with lse (B, H, S) f32 — the backward's
+    global row statistics.
+    """
+    from distributeddataparallel_tpu.ops.pallas_attention import (
+        _flash_fwd_impl,
+    )
+
+    n = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    B, S, H, D = q.shape
+    out, lse8 = _flash_fwd_impl(q, k, v, causal=True, interpret=interpret)
+    lse = lse8[:, 0, :].reshape(B, H, S)
+    of = out.astype(jnp.float32)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def hop(carry, s):
+        kc, vc, of, lse = carry
+        kc = lax.ppermute(kc, axis_name, perm)
+        vc = lax.ppermute(vc, axis_name, perm)
+        oh, lseh8 = _flash_fwd_impl(
+            q, kc, vc, causal=False, interpret=interpret
+        )
+        lseh = lseh8[:, 0, :].reshape(B, H, S)
+        # After s hops this device holds chunk idx - s; wrapped (future)
+        # chunks contribute nothing.
+        lseh = jnp.where(idx - s >= 0, lseh, NEG_INF)
+        lse_new = jnp.logaddexp(lse, lseh)
+        w_old = jnp.exp(lse - lse_new).transpose(0, 2, 1)[..., None]
+        w_new = jnp.exp(lseh - lse_new).transpose(0, 2, 1)[..., None]
+        of = of * w_old + oh.astype(jnp.float32) * w_new
+        return (kc, vc, of, lse_new), None
+
+    (_, _, of, lse), _ = lax.scan(
+        hop, (k, v, of, lse), jnp.arange(1, n)
+    )
+    return of.astype(q.dtype), lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def flash_ring_attention(
+    q, k, v, axis_name: str, interpret: bool = False
+):
+    """Causal ring attention whose per-hop block math runs in the Pallas
+    flash kernel (``ops.pallas_attention``) instead of XLA einsums —
+    the long-context CP path at flash speed (README r2 admitted the ring
+    couldn't use the kernel; this closes it).
+
+    Same contract as ``ring_attention``: local shards (B, S/N, H, D)
+    inside shard_map, kv already expanded to the query head count.
+    The backward is the standard ring-flash scheme: per hop, the saved
+    GLOBAL (out, lse) make ``exp(s - lse)`` the exact softmax slice for
+    the visiting chunk, so the per-chunk Pallas backward kernels emit
+    exact dq/dk/dv pieces; dk/dv ride the ring with their chunk and one
+    final hop returns them to the owner.
+    """
+    out, _ = _flash_ring_fwd_impl(q, k, v, axis_name, interpret)
+    return out
+
+
+def _flash_ring_fwd(q, k, v, axis_name, interpret):
+    out, lse = _flash_ring_fwd_impl(q, k, v, axis_name, interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_ring_bwd(axis_name, interpret, res, do):
+    from distributeddataparallel_tpu.ops.pallas_attention import (
+        _bwd as flash_bwd,
+    )
+
+    q, k, v, out, lse = res
+    n = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    B, S, H, D = q.shape
+    lse8 = jnp.broadcast_to(
+        lse.reshape(B * H, 1, S), (B * H, 8, S)
+    )
+    # Hop 0: own chunk, causal diagonal.
+    dq, dk, dv = flash_bwd(True, interpret, (q, k, v, out, lse8), do)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def hop(carry, s):
+        kc, vc, dkc, dvc, dq = carry
+        kc = lax.ppermute(kc, axis_name, perm)
+        vc = lax.ppermute(vc, axis_name, perm)
+        dkc = lax.ppermute(dkc, axis_name, perm)
+        dvc = lax.ppermute(dvc, axis_name, perm)
+        dq_h, dk_h, dv_h = flash_bwd(
+            False, interpret, (q, kc, vc, out, lse8), do
+        )
+        live = (idx - s >= 0).astype(dq.dtype)
+        dq = dq + dq_h * live
+        dkc = dkc + dk_h.astype(dkc.dtype) * live
+        dvc = dvc + dv_h.astype(dvc.dtype) * live
+        return (kc, vc, dkc, dvc, dq), None
+
+    (_, _, dkc, dvc, dq), _ = lax.scan(
+        hop, (k, v, dk, dv, dq), jnp.arange(1, n)
+    )
+    # Chunks sit one hop short of home after n-1 rotations; the final
+    # rotation delivers each chunk's accumulated gradient to its owner.
+    dk = lax.ppermute(dkc, axis_name, perm)
+    dv = lax.ppermute(dvc, axis_name, perm)
+    return dq, dk, dv
+
+
+flash_ring_attention.defvjp(_flash_ring_fwd, _flash_ring_bwd)
+
+
 def ring_attention(
     q: jnp.ndarray,
     k: jnp.ndarray,
@@ -48,6 +165,7 @@ def ring_attention(
     *,
     axis_name: str,
     causal: bool = True,
+    impl: str = "auto",
 ) -> jnp.ndarray:
     """Blockwise ring attention over the ``axis_name`` mesh axis.
 
@@ -55,7 +173,31 @@ def ring_attention(
     concatenation of shards in axis order.  Returns the local (B, S_local,
     H, D) output shard — numerically identical (up to fp accumulation
     order) to slicing full attention over the gathered sequence.
+
+    ``impl``: 'auto' uses the Pallas flash kernel per kv-hop
+    (``flash_ring_attention``) when the local shapes support it and the
+    kernel probe-compiles, 'pallas' forces it, 'xla' keeps the einsum
+    blocks below.  Only causal attention takes the kernel path (the
+    ring's wrap masking assumes it).
     """
+    if impl in ("auto", "pallas") and causal:
+        from distributeddataparallel_tpu.ops.attention import _flash_compiles
+        from distributeddataparallel_tpu.ops.pallas_attention import supported
+
+        if supported(q, k, v) and k.shape[2] == q.shape[2]:
+            # Probe BOTH causal variants: hop 0 runs the causal kernels,
+            # every later hop the non-causal ones — a shape passing only
+            # the causal probe would still die at jit time in the ring.
+            if impl == "pallas" or (
+                _flash_compiles(q, k, v, True)
+                and _flash_compiles(q, k, v, False)
+            ):
+                return flash_ring_attention(q, k, v, axis_name)
+        elif impl == "pallas":
+            raise ValueError(
+                f"pallas ring attention unsupported for shapes "
+                f"q={q.shape} kv={k.shape} on {jax.default_backend()}"
+            )
     n = lax.psum(1, axis_name)
     idx = lax.axis_index(axis_name)
     B, S, H, D = q.shape

@@ -361,6 +361,16 @@ def fsdp_gather_params(
     n_tp = mesh.shape[tp_axis] if tp_axis is not None else 1
     meta = _Meta(cfg, mesh.shape[axis_name], tp_axis, n_tp)
     if host:
+        if jax.process_count() > 1:
+            # A multi-host host-RAM gather needs a HOST-side exchange
+            # (device_get cannot fetch non-addressable shards, and a
+            # device-side allgather would reintroduce the HBM spike this
+            # path exists to avoid).  Until that exists: checkpoint the
+            # sharded state (training.checkpoint) and reload where needed.
+            raise NotImplementedError(
+                "fsdp_gather_params(host=True) is single-process; "
+                "save a sharded checkpoint instead on multi-host runs"
+            )
         full_flat = jax.tree.map(
             lambda x: np.asarray(jax.device_get(x)), state.params
         )

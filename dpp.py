@@ -728,13 +728,22 @@ def train(args) -> float:
         sharded flats are gathered back to the model layout (reads the
         CURRENT state)."""
         if args.fsdp:
-            # Host-side assembly: no device-memory spike (the device-side
-            # replicated gather would OOM at the 8B scale FSDP exists
-            # for); the caller's jit commits what it needs back.
+            # Host-side assembly: no device-memory spike from the gather
+            # itself (a device-side replicated gather would OOM at the 8B
+            # scale FSDP exists for).  Before committing back to device,
+            # cast to the model's compute dtype on HOST — the bf16 copy
+            # is what decode runs on and is half the f32 tree.  (f32
+            # configs commit f32: those are the small/test models.)
             host = ddp.fsdp_gather_params(
                 model.cfg, state, mesh,
                 tp_axis="model" if args.tp > 1 else None, host=True,
             )
+            if model.cfg.dtype == jnp.bfloat16:
+                import ml_dtypes
+
+                host = jax.tree.map(
+                    lambda x: x.astype(ml_dtypes.bfloat16), host
+                )
             return jax.tree.map(jnp.asarray, host)
         return state.params
 
