@@ -9,6 +9,7 @@ single logical write).
 
 from __future__ import annotations
 
+import json
 import os
 from typing import Any
 
@@ -22,24 +23,68 @@ class Checkpointer:
     """Epoch-keyed async checkpoints of a TrainState-like pytree."""
 
     def __init__(self, directory: str, *, max_to_keep: int = 3):
+        self._dir = os.path.abspath(directory)
         self._mgr = ocp.CheckpointManager(
-            os.path.abspath(directory),
+            self._dir,
             options=ocp.CheckpointManagerOptions(
                 max_to_keep=max_to_keep, enable_async_checkpointing=True
             ),
         )
 
-    def save(self, state: Pytree, epoch: int) -> None:
+    def save(
+        self, state: Pytree, epoch: int, *, meta: dict | None = None
+    ) -> None:
+        """``meta``: topology metadata (``training.elastic.topology_meta``)
+        written as a json sidecar — what lets a resume at a DIFFERENT
+        device count reshard the flat layouts (sidecar, not part of the
+        pytree: orbax owns the step dir's contents and atomicity)."""
         self._mgr.save(epoch, args=ocp.args.StandardSave(_arrays_only(state)))
+        if meta is not None and jax.process_index() == 0:
+            tmp = os.path.join(self._dir, f".meta_{epoch}.tmp")
+            with open(tmp, "w") as fh:
+                json.dump(meta, fh)
+            os.replace(tmp, os.path.join(self._dir, f"meta_{epoch}.json"))
+            # Prune sidecars for steps the manager has garbage-collected
+            # (max_to_keep) so the directory doesn't accumulate orphans.
+            live = set(self._mgr.all_steps()) | {epoch}
+            import glob
 
-    def restore_latest(self, state: Pytree) -> tuple[Pytree, int]:
+            for p in glob.glob(os.path.join(self._dir, "meta_*.json")):
+                try:
+                    s = int(os.path.basename(p)[5:-5])
+                except ValueError:
+                    continue
+                if s not in live:
+                    os.remove(p)
+
+    def latest_step(self) -> int | None:
+        return self._mgr.latest_step()
+
+    def read_meta(self, step: int) -> dict | None:
+        path = os.path.join(self._dir, f"meta_{step}.json")
+        if not os.path.exists(path):
+            return None
+        with open(path) as fh:
+            return json.load(fh)
+
+    def restore_latest(
+        self, state: Pytree, *, template: Pytree | None = None
+    ) -> tuple[Pytree, int]:
         """Restore into the structure of ``state``; returns (state, next_epoch).
 
         With no checkpoint present, returns the input state and epoch 0.
+        ``template`` overrides the restore target (same treedef, possibly
+        different leaf shapes/placements — the elastic-reshard hook);
+        the restored tree is then returned RAW for the caller to re-place.
         """
         step = self._mgr.latest_step()
         if step is None:
             return state, 0
+        if template is not None:
+            restored = self._mgr.restore(
+                step, args=ocp.args.StandardRestore(template)
+            )
+            return restored, step + 1
         restored = self._mgr.restore(
             step, args=ocp.args.StandardRestore(_arrays_only(state))
         )

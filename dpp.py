@@ -752,9 +752,34 @@ def train(args) -> float:
     preempted = {"signal": None}
     if args.checkpoint_dir:
         from distributeddataparallel_tpu.training.checkpoint import Checkpointer
+        from distributeddataparallel_tpu.training.elastic import (
+            elastic_restore,
+            topology_meta,
+        )
+
         ckpt = Checkpointer(args.checkpoint_dir)
+        ckpt_meta = topology_meta(
+            mesh,
+            "fsdp" if args.fsdp
+            else "zero1" if args.zero
+            else "replicated",
+        )
         if args.resume:
-            state, start_epoch = ckpt.restore_latest(state)
+            # Elastic resume: the flat ZeRO/FSDP layouts reshard when the
+            # checkpoint was written at a different device count.  The
+            # layout string is the SAME value the save sidecar records;
+            # model-axis runs (segmented flats) restore exact-topology
+            # and reject a changed device count loudly.
+            pure_dp = (
+                args.tp == 1 and args.ep == 1 and args.pp == 1
+                and args.cp == 1
+            )
+            state, start_epoch = elastic_restore(
+                ckpt, state, mesh,
+                layout=ckpt_meta["layout"],
+                cfg=model.cfg if args.fsdp else None,
+                allow_reshard=pure_dp,
+            )
         # Preemption handling (TPU-VM maintenance events deliver SIGTERM):
         # finish the in-flight step, checkpoint, exit cleanly.  Epoch
         # granularity: --resume continues from the NEXT epoch — the
@@ -967,7 +992,7 @@ def train(args) -> float:
                     log0("Epoch %d, Batch %d, Loss: %.4f",
                          epoch, batch_idx, last_loss)
                 if ckpt is not None and preempt_agreed(batch_idx):
-                    ckpt.save(state, epoch)
+                    ckpt.save(state, epoch, meta=ckpt_meta)
                     ckpt.wait()
                     log0("preempted: checkpoint saved mid-epoch %d; "
                          "--resume continues from epoch %d", epoch, epoch + 1)
@@ -1001,7 +1026,7 @@ def train(args) -> float:
                 }
                 log0("Epoch %d eval: %s", epoch, mean)
         if ckpt is not None:
-            ckpt.save(state, epoch)
+            ckpt.save(state, epoch, meta=ckpt_meta)
         if eval_step is not None or ckpt is not None:
             # Don't let eval/checkpoint wall time pollute throughput.
             timer.reset()

@@ -1,0 +1,284 @@
+"""Elastic checkpoint reshard: save at N devices, restore and continue at
+M != N.  The invariant in every case: with the same GLOBAL batches, the
+resharded continuation reproduces the uninterrupted N-device run's losses
+and parameters exactly (the flat layouts' padding is mechanical, not
+semantic)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import Mesh
+
+import distributeddataparallel_tpu as ddp
+from distributeddataparallel_tpu.data.loader import shard_batch
+from distributeddataparallel_tpu.models import TransformerLM, tiny_lm
+from distributeddataparallel_tpu.ops import lm_cross_entropy
+from distributeddataparallel_tpu.training.checkpoint import Checkpointer
+from distributeddataparallel_tpu.training.elastic import (
+    elastic_restore,
+    topology_meta,
+)
+
+
+def _cfg(**over):
+    base = dict(
+        num_layers=2, num_heads=2, d_model=32, d_ff=64, max_seq_len=32,
+    )
+    base.update(over)
+    return tiny_lm(**base)
+
+
+def _mesh(n):
+    return Mesh(np.array(jax.devices()[:n]), ("data",))
+
+
+def _batches(k=4, rows=8, vocab=256):
+    rng = np.random.default_rng(0)
+    return [
+        rng.integers(0, vocab, size=(rows, 17)).astype(np.int32)
+        for _ in range(k)
+    ]
+
+
+def _loss_fn(model):
+    def loss_fn(p, batch, rng):
+        toks = batch["tokens"]
+        logits = model.apply({"params": p}, toks[:, :-1])
+        return lm_cross_entropy(logits, toks[:, 1:]), {}
+
+    return loss_fn
+
+
+def test_elastic_replicated_8_to_4(tmp_path, devices):
+    """Plain DP: train 2 steps @8, save, restore @4, continue 2 steps —
+    losses and params match the uninterrupted 8-device run (same global
+    batches throughout)."""
+    cfg = _cfg()
+    model = TransformerLM(cfg)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 16), jnp.int32)
+    )["params"]
+    tx = optax.adam(1e-2)
+    batches = _batches()
+    loss_fn = _loss_fn(model)
+
+    def fresh(mesh):
+        st = ddp.TrainState.create(
+            apply_fn=model.apply, params=params, tx=tx
+        )
+        st = ddp.broadcast_params(st, mesh)
+        step = ddp.make_train_step(loss_fn, mesh=mesh, donate=False)
+        return st, step
+
+    # Uninterrupted @8.
+    mesh8 = _mesh(8)
+    st, step = fresh(mesh8)
+    ref_losses = []
+    for t in batches:
+        st, m = step(st, shard_batch({"tokens": t}, mesh8), jax.random.PRNGKey(0))
+        ref_losses.append(float(m["loss"]))
+    ref_params = jax.tree.map(np.asarray, st.params)
+
+    # Interrupted: 2 steps @8, save, reshard to @4, finish.
+    st, step = fresh(mesh8)
+    for t in batches[:2]:
+        st, m = step(st, shard_batch({"tokens": t}, mesh8), jax.random.PRNGKey(0))
+    ckpt = Checkpointer(str(tmp_path))
+    ckpt.save(st, 0, meta=topology_meta(mesh8, "replicated"))
+    ckpt.wait()
+
+    mesh4 = _mesh(4)
+    st4, step4 = fresh(mesh4)
+    st4, next_epoch = elastic_restore(
+        ckpt, st4, mesh4, layout="replicated"
+    )
+    assert next_epoch == 1
+    losses = ref_losses[:2]
+    for t in batches[2:]:
+        st4, m = step4(
+            st4, shard_batch({"tokens": t}, mesh4), jax.random.PRNGKey(0)
+        )
+        losses.append(float(m["loss"]))
+    np.testing.assert_allclose(losses, ref_losses, rtol=1e-6)
+    for a, b in zip(jax.tree.leaves(st4.params), jax.tree.leaves(ref_params)):
+        # atol 1e-5: pmean over 8 vs 4 devices reduces in a
+        # different fp order
+        np.testing.assert_allclose(np.asarray(a), b, atol=1e-5)
+
+
+def test_elastic_zero1_8_to_4(tmp_path, devices):
+    """ZeRO-1: the flat opt vectors bake in N (padded to 8 chunks); the
+    reshard truncates the tail padding and re-pads for 4 — adam moments
+    continue exactly."""
+    # d_model 28 / vocab 251: park the total param count off the
+    # 8-chunk alignment so the padded flat sizes actually differ
+    cfg = _cfg(vocab_size=251, d_model=28, d_ff=52, num_layers=3)
+    model = TransformerLM(cfg)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 16), jnp.int32)
+    )["params"]
+    tx = optax.adam(1e-2)
+    batches = _batches(vocab=251)
+    loss_fn = _loss_fn(model)
+
+    def fresh(mesh):
+        st = ddp.zero_state(
+            apply_fn=model.apply, params=params, tx=tx, mesh=mesh
+        )
+        step = ddp.make_train_step(
+            loss_fn, mesh=mesh, zero=True, donate=False
+        )
+        return st, step
+
+    mesh8 = _mesh(8)
+    st, step = fresh(mesh8)
+    ref_losses = []
+    for t in batches:
+        st, m = step(st, shard_batch({"tokens": t}, mesh8), jax.random.PRNGKey(0))
+        ref_losses.append(float(m["loss"]))
+    ref_params = jax.tree.map(np.asarray, st.params)
+
+    st, step = fresh(mesh8)
+    for t in batches[:2]:
+        st, _ = step(st, shard_batch({"tokens": t}, mesh8), jax.random.PRNGKey(0))
+    ckpt = Checkpointer(str(tmp_path))
+    ckpt.save(st, 0, meta=topology_meta(mesh8, "zero1"))
+    ckpt.wait()
+
+    mesh4 = _mesh(4)
+    st4, step4 = fresh(mesh4)
+    # The flat opt shapes REALLY differ across topologies (the bug this
+    # feature fixes): assert the precondition so the test can't pass
+    # vacuously.
+    olds = {l.shape for l in jax.tree.leaves(st.opt_state) if l.ndim == 1}
+    news = {l.shape for l in jax.tree.leaves(st4.opt_state) if l.ndim == 1}
+    assert olds != news, (olds, news)
+    st4, _ = elastic_restore(ckpt, st4, mesh4, layout="zero1")
+    losses = ref_losses[:2]
+    for t in batches[2:]:
+        st4, m = step4(
+            st4, shard_batch({"tokens": t}, mesh4), jax.random.PRNGKey(0)
+        )
+        losses.append(float(m["loss"]))
+    np.testing.assert_allclose(losses, ref_losses, rtol=1e-6)
+    for a, b in zip(jax.tree.leaves(st4.params), jax.tree.leaves(ref_params)):
+        # atol 1e-5: pmean over 8 vs 4 devices reduces in a
+        # different fp order
+        np.testing.assert_allclose(np.asarray(a), b, atol=1e-5)
+
+
+def test_elastic_fsdp_8_to_4(tmp_path, devices):
+    """FSDP: params AND opt state are flats whose chunk sizes bake in N;
+    both reshard and the run continues exactly."""
+    from distributeddataparallel_tpu.parallel.fsdp import (
+        fsdp_gather_params,
+        fsdp_state,
+        make_fsdp_train_step,
+    )
+
+    cfg = _cfg(scan_layers=True, vocab_size=251, d_model=28, d_ff=52,
+               num_layers=3)
+    model = TransformerLM(cfg)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 16), jnp.int32)
+    )["params"]
+    tx = optax.adam(1e-2)
+    batches = _batches(vocab=251)
+
+    def fresh(mesh):
+        st = fsdp_state(cfg, params, tx, mesh, apply_fn=model.apply)
+        step = make_fsdp_train_step(cfg, mesh=mesh, donate=False)
+        return st, step
+
+    mesh8 = _mesh(8)
+    st, step = fresh(mesh8)
+    ref_losses = []
+    for t in batches:
+        st, m = step(st, shard_batch({"tokens": t}, mesh8), jax.random.PRNGKey(0))
+        ref_losses.append(float(m["loss"]))
+    ref_params = jax.tree.map(
+        np.asarray, fsdp_gather_params(cfg, st, mesh8)
+    )
+
+    st, step = fresh(mesh8)
+    for t in batches[:2]:
+        st, _ = step(st, shard_batch({"tokens": t}, mesh8), jax.random.PRNGKey(0))
+    ckpt = Checkpointer(str(tmp_path))
+    ckpt.save(st, 0, meta=topology_meta(mesh8, "fsdp"))
+    ckpt.wait()
+
+    mesh4 = _mesh(4)
+    st4, step4 = fresh(mesh4)
+    assert st4.params["layers"].shape != st.params["layers"].shape
+    st4, _ = elastic_restore(ckpt, st4, mesh4, layout="fsdp", cfg=cfg)
+    losses = ref_losses[:2]
+    for t in batches[2:]:
+        st4, m = step4(
+            st4, shard_batch({"tokens": t}, mesh4), jax.random.PRNGKey(0)
+        )
+        losses.append(float(m["loss"]))
+    np.testing.assert_allclose(losses, ref_losses, rtol=1e-6)
+    got = fsdp_gather_params(cfg, st4, mesh4)
+    for a, b in zip(jax.tree.leaves(got), jax.tree.leaves(ref_params)):
+        # atol 1e-5: pmean over 8 vs 4 devices reduces in a
+        # different fp order
+        np.testing.assert_allclose(np.asarray(a), b, atol=1e-5)
+
+
+def test_elastic_layout_mismatch_rejected(tmp_path, devices):
+    cfg = _cfg()
+    model = TransformerLM(cfg)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 16), jnp.int32)
+    )["params"]
+    mesh8 = _mesh(8)
+    st = ddp.TrainState.create(
+        apply_fn=model.apply, params=params, tx=optax.sgd(0.1)
+    )
+    st = ddp.broadcast_params(st, mesh8)
+    ckpt = Checkpointer(str(tmp_path))
+    ckpt.save(st, 0, meta=topology_meta(mesh8, "replicated"))
+    ckpt.wait()
+    with pytest.raises(ValueError, match="layout"):
+        elastic_restore(ckpt, st, _mesh(4), layout="zero1")
+
+
+def test_elastic_cli_resume_at_different_device_count(tmp_path, devices):
+    """dpp.py end-to-end: checkpoint @8 fake devices, --resume @4 — the
+    run continues from the saved epoch instead of crashing on the
+    resharded state.  Subprocesses: the CPU device count is fixed at
+    backend init, so each topology needs its own process."""
+    import subprocess
+    import sys
+
+    common = [
+        sys.executable, "/root/repo/dpp.py",
+        "--device", "cpu",
+        "--model", "gpt2",
+        "--layers", "2",
+        "--d-model", "32",
+        "--seq-len", "32",
+        "--vocab-size", "64",
+        "--zero",
+        "--optimizer", "adam",
+        "--num-examples", "64",
+        "--log-every", "4",
+        "--checkpoint-dir", str(tmp_path),
+    ]
+    r1 = subprocess.run(
+        common + ["--fake-devices", "8", "--batch-size", "4",
+                  "--epochs", "1"],
+        capture_output=True, text=True, timeout=240,
+    )
+    assert r1.returncode == 0, r1.stderr[-2000:]
+    r2 = subprocess.run(
+        common + ["--fake-devices", "4", "--batch-size", "8",
+                  "--epochs", "2", "--resume"],
+        capture_output=True, text=True, timeout=240,
+    )
+    assert r2.returncode == 0, r2.stderr[-2000:]
+    # Resumed at epoch 1, not 0 (log lines go to stderr).
+    log = r2.stdout + r2.stderr
+    assert "Epoch 1," in log and "Epoch 0," not in log, log[-2000:]
