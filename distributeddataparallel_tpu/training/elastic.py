@@ -72,6 +72,15 @@ def elastic_restore(
     if step is None:
         return state, 0
     meta = ckpt.read_meta(step)
+    if meta is not None and meta.get("layout") != layout:
+        # Checked BEFORE any restore attempt: a layout mismatch at the
+        # same device count would otherwise die in an opaque orbax
+        # structure error.
+        raise ValueError(
+            f"checkpoint layout {meta.get('layout')!r} does not match the "
+            f"current run's {layout!r} — rebuild the state the same way "
+            f"it was saved"
+        )
     n_new = int(mesh.shape[data_axis])
     n_old = (meta or {}).get("n_data", n_new)
     if n_old == n_new or layout == "replicated":
@@ -84,12 +93,6 @@ def elastic_restore(
             f"checkpoint was written at {n_old} data shards, this run has "
             f"{n_new}, and the current layout cannot reshard (model axes "
             f"segment the flats) — restore at the original device count"
-        )
-    if meta is not None and meta.get("layout") != layout:
-        raise ValueError(
-            f"checkpoint layout {meta.get('layout')!r} does not match the "
-            f"current run's {layout!r} — rebuild the state the same way "
-            f"it was saved"
         )
 
     if layout == "zero1":

@@ -250,11 +250,13 @@ def test_elastic_cli_resume_at_different_device_count(tmp_path, devices):
     run continues from the saved epoch instead of crashing on the
     resharded state.  Subprocesses: the CPU device count is fixed at
     backend init, so each topology needs its own process."""
+    import pathlib
     import subprocess
     import sys
 
+    repo = str(pathlib.Path(__file__).resolve().parents[1])
     common = [
-        sys.executable, "/root/repo/dpp.py",
+        sys.executable, str(pathlib.Path(repo) / "dpp.py"),
         "--device", "cpu",
         "--model", "gpt2",
         "--layers", "2",
