@@ -314,16 +314,29 @@ def test_entrypoint_cp_ep_moe_aux(devices):
     assert loss == loss  # not NaN: aux plumbing intact under CP x EP
 
 
-def test_ep_zero_matches_plain_ep(devices):
+@pytest.mark.parametrize(
+    "moe_kwargs,seed,atol",
+    [
+        ({}, 11, 2e-6),
+        # Token-choice dispatch: the all_to_all token exchange composes
+        # with the flat-chunk updates exactly as the dense path does
+        # (5e-6: adam amplifies the dispatch paths' different fp
+        # summation order over two steps).
+        ({"moe_top_k": 2, "moe_capacity_factor": 4.0}, 23, 5e-6),
+    ],
+    ids=["dense", "token-choice"],
+)
+def test_ep_zero_matches_plain_ep(moe_kwargs, seed, atol, devices):
     """EP × ZeRO-1: the flat-chunk sharded update on each position's
     LOCAL expert shard must reproduce the replicated-optimizer DP×EP
     step exactly over two adam steps (expert stacks are uniform across
     the expert axis, so flat offsets are position-invariant and the
-    replicated leaves — router included — stay in lockstep)."""
+    replicated leaves — router included — stay in lockstep) — for both
+    dispatch modes."""
     mesh = ddp.make_mesh(("data", "expert"), shape=(4, 2))
-    cfg_x = _moe_cfg(ep_axis="expert")
+    cfg_x = _moe_cfg(ep_axis="expert", **moe_kwargs)
     model_x = TransformerLM(cfg_x)
-    rng = np.random.default_rng(11)
+    rng = np.random.default_rng(seed)
     batches = [
         shard_batch(
             {"tokens": rng.integers(0, 256, size=(8, 17)).astype(np.int32)},
@@ -331,7 +344,9 @@ def test_ep_zero_matches_plain_ep(devices):
         )
         for _ in range(2)
     ]
-    params = TransformerLM(_moe_cfg()).init(
+    params = TransformerLM(_moe_cfg(**{
+        k: v for k, v in moe_kwargs.items() if k != "moe_capacity_factor"
+    })).init(
         jax.random.PRNGKey(0), jnp.zeros((1, 16), jnp.int32)
     )["params"]
     tx = optax.adam(1e-2)
@@ -369,7 +384,7 @@ def test_ep_zero_matches_plain_ep(devices):
         jax.tree.leaves(zstate.params),
     ):
         np.testing.assert_allclose(
-            np.asarray(a), np.asarray(b), atol=2e-6,
+            np.asarray(a), np.asarray(b), atol=atol,
             err_msg="/".join(str(getattr(k, "key", k)) for k in path),
         )
 
@@ -800,3 +815,53 @@ def test_entrypoint_token_choice_cli(devices):
     )
     loss = dpp.train(args)
     assert loss == loss  # not NaN
+
+
+def test_pp_ep_token_choice_matches_single_device(devices):
+    """DP(2) × PP(2) × EP(2) with token-choice dispatch: the MoE
+    all_to_all runs inside pipeline stage bodies — still equal to the
+    single-device step (aux weight 0: 1F1B-style restriction does not
+    apply, this is GPipe with AD)."""
+    from distributeddataparallel_tpu.parallel.pipeline_parallel import (
+        make_pp_train_step,
+        shard_state_pp,
+    )
+
+    cfg = _moe_cfg(num_layers=2, scan_layers=True, moe_top_k=2)
+    cfg_x = dataclasses.replace(
+        cfg, ep_axis="expert", moe_capacity_factor=4.0
+    )
+    mesh = ddp.make_mesh(("data", "pipe", "expert"), shape=(2, 2, 2))
+    model = TransformerLM(cfg)
+    rng = np.random.default_rng(31)
+    tokens = rng.integers(0, 256, size=(4, 33)).astype(np.int32)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 32), jnp.int32)
+    )["params"]
+    tx = optax.sgd(0.1)
+
+    def ref_loss(p):
+        logits = model.apply({"params": p}, jnp.asarray(tokens[:, :-1]))
+        return lm_cross_entropy(logits, jnp.asarray(tokens[:, 1:]))
+
+    loss_ref, grads_ref = jax.value_and_grad(ref_loss)(params)
+    updates, _ = tx.update(grads_ref, tx.init(params), params)
+    params_ref = optax.apply_updates(params, updates)
+
+    state = ddp.TrainState.create(apply_fn=None, params=params, tx=tx)
+    state = shard_state_pp(state, mesh, ep_axis="expert")
+    step = make_pp_train_step(
+        cfg_x, mesh=mesh, microbatches=2, donate=False, moe_aux_weight=0.0
+    )
+    state, metrics = step(
+        state, shard_batch({"tokens": tokens}, mesh), jax.random.PRNGKey(0)
+    )
+    assert float(metrics["loss"]) == pytest.approx(float(loss_ref), rel=1e-5)
+    for (path, a), b in zip(
+        jax.tree_util.tree_flatten_with_path(state.params)[0],
+        jax.tree.leaves(params_ref),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=2e-5,
+            err_msg="/".join(str(getattr(k, "key", k)) for k in path),
+        )
