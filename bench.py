@@ -581,9 +581,26 @@ def bench_overlap() -> dict:
     from distributeddataparallel_tpu.utils.metrics import overlap_probe
 
     mesh, loss_fn, state, batch = _gpt2_setup("auto", tx=optax.sgd(0.01))
-    return overlap_probe(
+    out = overlap_probe(
         loss_fn, state, batch, jax.random.PRNGKey(1), mesh=mesh, iters=4
     )
+
+    # The scheduled-HLO demonstration (OVERLAP.md): AOT-compile the
+    # chained-bucket DP step for an 8-chip v5e topology and report how
+    # much backward compute the TPU compiler scheduled inside the
+    # async-collective windows, vs stock XLA's combined post-backward
+    # all-reduce.  This is the BASELINE "overlap demonstrated in
+    # profile" artifact — the wall-clock probe above cannot show it with
+    # one visible chip (overlap_frac None).
+    from distributeddataparallel_tpu.parallel.overlap import (
+        grad_sync_schedule_pair,
+    )
+
+    try:
+        out.update(grad_sync_schedule_pair())
+    except Exception as e:  # noqa: BLE001 - evidence lives in dryrun too
+        out["scheduled_error"] = repr(e)
+    return out
 
 
 def _run(fn, label: str) -> dict:

@@ -37,6 +37,13 @@ Pytree = Any
 #: DDP's default bucket size: 25 MiB (SURVEY.md §2b, torch Reducer default).
 DEFAULT_BUCKET_BYTES = 25 * 1024 * 1024
 
+#: Overlap (chain) mode bucket size: unlike DDP's 25 MiB (NCCL latency
+#: amortization), the TPU async-collective scheduler overlaps best when
+#: large leaves ride solo as native-dtype all-reduces; only sub-MiB
+#: leaves (biases, norms) are worth coalescing.  Measured in
+#: parallel/overlap.py — 25 MiB concat buckets get zero async windows.
+OVERLAP_BUCKET_BYTES = 1 * 1024 * 1024
+
 
 def all_reduce_gradients(
     grads: Pytree,
@@ -44,18 +51,24 @@ def all_reduce_gradients(
     *,
     op: str = "mean",
     bucket_bytes: int | None = None,
+    chain: bool = False,
 ) -> Pytree:
     """All-reduce a gradient pytree across the data axis (inside shard_map).
 
     ``op='mean'`` reproduces DDP's divide-by-world-size so every replica
     holds averaged gradients and stays in lockstep under a local optimizer
-    step (ref dpp.py:52-53 semantics).
+    step (ref dpp.py:52-53 semantics).  ``chain=True`` (needs
+    ``bucket_bytes``) orders the buckets with barriers so the compiler
+    keeps them separate and can overlap them with backward — see
+    ``bucket_gradients`` and ``parallel.overlap``.
     """
     if op not in ("mean", "sum"):
         raise ValueError(f"op must be 'mean' or 'sum', got {op!r}")
+    if chain and bucket_bytes is None:
+        bucket_bytes = OVERLAP_BUCKET_BYTES
     if bucket_bytes is not None:
         return bucket_gradients(
-            grads, axis_name, op=op, bucket_bytes=bucket_bytes
+            grads, axis_name, op=op, bucket_bytes=bucket_bytes, chain=chain
         )
     if op == "mean":
         return jax.tree.map(lambda g: lax.pmean(g, axis_name), grads)
@@ -68,6 +81,7 @@ def bucket_gradients(
     *,
     op: str = "mean",
     bucket_bytes: int = DEFAULT_BUCKET_BYTES,
+    chain: bool = False,
 ) -> Pytree:
     """Coalesced all-reduce: flatten grad leaves into ~bucket_bytes groups,
     reduce each group as one flat vector, scatter back.
@@ -77,6 +91,18 @@ def bucket_gradients(
     the last-computed (earliest-layer) grads is reduced last — giving the
     XLA scheduler the same freedom to overlap early buckets with remaining
     backward work.
+
+    ``chain=True`` additionally threads an ``optimization_barrier`` from
+    each bucket's reduced output into the next bucket's input.  That
+    pins the reduction order (reverse, like DDP's Reducer stream) and —
+    the real point — makes the buckets *data-dependent* on each other so
+    XLA's all-reduce combiner cannot legally merge them back into one
+    giant all-reduce that waits for the entire backward.  Separate
+    buckets are what lets the TPU backend's async-collective-fusion +
+    latency-hiding scheduler start bucket k's all-reduce while the
+    remaining backward is still computing (see ``parallel.overlap`` for
+    the scheduled-HLO evidence).  Numerics are identical to the unchained
+    path; the barrier moves no data.
     """
     from distributeddataparallel_tpu import native
 
@@ -88,13 +114,43 @@ def bucket_gradients(
     )
 
     reduced: list[Any] = [None] * len(leaves)
+    prev = None
+    # Static mean divisor: lax.psum(1, axis) would materialize a scalar
+    # all-reduce per bucket on the TPU backend, serializing the tail of
+    # the overlapped schedule; the axis size is known at trace time.
+    inv_n = 1.0 / lax.axis_size(axis_name)
     for bucket in buckets:
-        flat = jnp.concatenate(
-            [leaves[i].reshape(-1).astype(jnp.float32) for i in bucket]
+        # chain (overlap) mode reduces in the native gradient dtype (DDP
+        # semantics, half the wire bytes for bf16) when the bucket is
+        # dtype-uniform; the legacy coalescing path keeps its original
+        # f32 accumulation so --bucket-mb numerics are unchanged.
+        dtypes = {leaves[i].dtype for i in bucket}
+        bdt = (
+            dtypes.pop()
+            if chain and len(dtypes) == 1
+            else jnp.float32
         )
+        if len(bucket) == 1:
+            # Single-leaf bucket: skip the concat/flatten round-trip —
+            # keeps the leaf's layout intact for the async scheduler.
+            flat = leaves[bucket[0]].astype(bdt)
+        else:
+            flat = jnp.concatenate(
+                [leaves[i].reshape(-1).astype(bdt) for i in bucket]
+            )
+        if chain and prev is not None:
+            # Bucket k may not start reducing until bucket k-1 finished:
+            # the combiner would have to create a cycle to merge them.
+            flat, prev = lax.optimization_barrier((flat, prev))
         flat = lax.psum(flat, axis_name)
+        if chain:
+            prev = flat
         if op == "mean":
-            flat = flat / lax.psum(1, axis_name)
+            flat = flat * jnp.asarray(inv_n, bdt)
+        if len(bucket) == 1:
+            i = bucket[0]
+            reduced[i] = flat.astype(leaves[i].dtype)
+            continue
         offset = 0
         for i in bucket:
             n = leaves[i].size

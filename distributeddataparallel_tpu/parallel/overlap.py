@@ -1,0 +1,344 @@
+"""Demonstrated comm/compute overlap: DDP's defining perf property, TPU-native.
+
+The reference's ``loss.backward()`` (ref dpp.py:52) hides the bucketed
+NCCL all-reduce under the remaining backward computation — SURVEY.md §3.4
+calls this "THE performance property to reproduce".  This module is where
+the framework *demonstrates* the property rather than assuming XLA
+provides it, because measured stock behavior is the opposite:
+
+1. **Stock XLA serializes the gradient sync.**  The all-reduce combiner
+   merges every per-leaf grad ``pmean`` into ONE tuple all-reduce whose
+   inputs include the last-computed gradient, so it is scheduled after
+   the *entire* backward — zero overlap by construction (verified on the
+   TPU compiler: a single ``all-reduce`` at schedule position ~n-5 of n).
+
+2. **The CPU test fabric cannot overlap at all.**  The XLA CPU backend
+   emits only synchronous ``all-reduce`` (no ``-start``/``-done`` split,
+   no async conversion), and on this machine the 8-device CPU mesh is
+   time-sliced on ONE physical core (``len(os.sched_getaffinity(0)) ==
+   1``) where inter-device "communication" is itself CPU work on that
+   same core.  ``overlap_frac = 0.0`` on the CPU mesh is an architectural
+   property of the fabric, not of this framework — hiding comm under
+   compute cannot reduce wall time when both execute on the same core.
+
+The TPU-native fix has two halves:
+
+- ``bucket_gradients(..., chain=True)`` (parallel.data_parallel): DDP-style
+  reverse-order buckets (1 MiB ``OVERLAP_BUCKET_BYTES`` default — large
+  leaves ride solo in native dtype, which is what the async scheduler
+  converts; 25 MiB concat buckets measure zero async windows), each
+  barrier-chained to the previous bucket's output so the combiner cannot
+  re-merge them.  Bucket k's all-reduce then depends only on the
+  late-layer grads that backward produces *first*.
+
+- ``OVERLAP_COMPILER_OPTIONS``: the TPU compiler's async-collective +
+  latency-hiding-scheduler options.  With separate buckets available,
+  the backend converts each bucket's all-reduce into an
+  ``async-collective-start`` / ``async-collective-done`` pair (and fuses
+  collectives *into* compute fusions — ``%async_collective_fusion.*``
+  computations) and schedules real backward fusions inside the window.
+
+``schedule_report`` extracts the proof from the compiled executable's own
+scheduled HLO: per-window compute cycles (the compiler's
+``estimated_cycles`` cost model) placed between each collective's start
+and done.  ``grad_sync_schedule_evidence`` packages an end-to-end check
+that AOT-compiles a DP train step for a multi-chip TPU topology (no
+multi-chip hardware needed — ``jax.experimental.topologies``) and
+reports the measured schedule.  Artifacts land in OVERLAP.md and the
+bench/dryrun JSON sidecars.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any
+
+#: TPU compiler options that enable async collectives + the latency-hiding
+#: scheduler.  Verified accepted by this image's TPU compiler; the CPU
+#: compiler rejects TPU option names, hence the backend gate below.
+OVERLAP_COMPILER_OPTIONS = {
+    "xla_tpu_enable_latency_hiding_scheduler": "true",
+    "xla_tpu_enable_async_collective_fusion": "true",
+    "xla_tpu_enable_async_collective_fusion_fuse_all_reduce": "true",
+    "xla_tpu_enable_async_collective_fusion_multiple_steps": "true",
+    "xla_tpu_overlap_compute_collective_tc": "true",
+    "xla_enable_async_all_reduce": "true",
+}
+
+
+def overlap_compiler_options(backend: str | None = None) -> dict | None:
+    """The OVERLAP_COMPILER_OPTIONS when targeting TPU, else None.
+
+    Pass the result straight to ``jax.jit(..., compiler_options=...)``
+    (None is accepted and means "no overrides").
+    """
+    import jax
+
+    if backend is None:
+        backend = jax.default_backend()
+    return dict(OVERLAP_COMPILER_OPTIONS) if backend == "tpu" else None
+
+
+def schedule_report(hlo_text: str) -> dict:
+    """Quantify collective/compute overlap from scheduled HLO text.
+
+    For TPU executables the ENTRY instruction order *is* the linear
+    TensorCore schedule, and fusions carry the compiler's own
+    ``estimated_cycles``.  The report pairs each
+    ``async-collective-start``/``-done`` and sums the compute cycles
+    scheduled inside the window — compute the TensorCore executes while
+    the collective's DMAs are in flight.  Collective-carrying fusions
+    (``async_collective_fusion`` computations: compute fused WITH a
+    collective) count as overlapped compute too.
+
+    Returns a dict with ``n_async_windows``, ``n_sync_collectives``
+    (collectives left synchronous — the no-overlap failure mode),
+    per-window cycle counts, and ``overlapped_frac_of_compute``.
+    """
+    # Computations that contain a collective op.
+    ar_comps: set[str] = set()
+    cur = None
+    in_entry = False
+    for line in hlo_text.splitlines():
+        if line and not line.startswith(" ") and "{" in line:
+            in_entry = line.lstrip().startswith("ENTRY")
+            m = re.search(r"(%[\w.\-]+)\s*\(", line)
+            if m:
+                cur = m.group(1)
+        if re.search(r"\ball-reduce\(|\breduce-scatter\(|\ball-gather\(", line):
+            if cur and not in_entry:
+                ar_comps.add(cur)
+
+    entry = hlo_text[hlo_text.find("ENTRY"):]
+    events: list[tuple[str, int]] = []  # (kind, cycles)
+    for line in entry.splitlines():
+        m = re.search(r"%([\w.\-]+) = ", line)
+        if not m:
+            continue
+        name = m.group(1)
+        cyc_m = re.search(r'"estimated_cycles":"(\d+)"', line)
+        cycles = int(cyc_m.group(1)) if cyc_m else 0
+        call_m = re.search(r"calls=(%[\w.\-]+)", line)
+        callee = call_m.group(1) if call_m else None
+        if name.startswith("async-collective-start") or re.search(
+            r"\ball-reduce-start\(|\ball-gather-start\(", line
+        ):
+            events.append(("start", cycles))
+        elif name.startswith("async-collective-done") or re.search(
+            r"\ball-reduce-done\(|\ball-gather-done\(", line
+        ):
+            events.append(("done", cycles))
+        elif callee in ar_comps or "async_collective_fusion" in (callee or ""):
+            # Compute fused with a collective: overlapped by construction.
+            events.append(("comm_fused", cycles))
+        elif re.search(r"\ball-reduce\(|\breduce-scatter\(|\ball-gather\(", line):
+            events.append(("sync_collective", cycles))
+        elif re.search(r"= \S+ (fusion|custom-call|convolution)\(", line):
+            events.append(("compute", cycles))
+
+    windows: list[dict] = []
+    depth = 0
+    win_cycles = 0
+    win_ops = 0
+    total_compute = 0
+    n_sync = 0
+    for kind, cycles in events:
+        if kind == "start":
+            depth += 1
+            if depth == 1:
+                win_cycles, win_ops = 0, 0
+        elif kind == "done":
+            if depth > 0:
+                depth -= 1
+                if depth == 0:
+                    windows.append(
+                        {"compute_cycles": win_cycles, "n_compute_ops": win_ops}
+                    )
+        elif kind == "sync_collective":
+            n_sync += 1
+        else:  # compute / comm_fused
+            total_compute += cycles
+            if depth > 0 and cycles:
+                win_cycles += cycles
+                win_ops += 1
+
+    overlapped = sum(w["compute_cycles"] for w in windows)
+    return {
+        "n_async_windows": len(windows),
+        "n_sync_collectives": n_sync,
+        "windows": windows,
+        "total_compute_cycles": total_compute,
+        "overlapped_compute_cycles": overlapped,
+        "overlapped_frac_of_compute": (
+            round(overlapped / total_compute, 4) if total_compute else 0.0
+        ),
+    }
+
+
+def tpu_topology_mesh(topology: str = "v5e:2x4", axis_names=("data",),
+                      shape=None):
+    """An n-chip TPU Mesh from an AOT topology description — no multi-chip
+    hardware required (``jax.experimental.topologies``).  Programs built
+    on this mesh can be ``.lower().compile()``d (not run) to inspect what
+    the real TPU compiler does at scale."""
+    import numpy as np
+    from jax.experimental import topologies
+    from jax.sharding import Mesh
+
+    topo = topologies.get_topology_desc(platform="tpu", topology_name=topology)
+    devs = np.array(topo.devices)
+    if shape is None:
+        shape = (devs.size,) if len(axis_names) == 1 else None
+    return Mesh(devs.reshape(shape), axis_names)
+
+
+def grad_sync_schedule_evidence(
+    *,
+    topology: str = "v5e:2x4",
+    n_layers: int = 8,
+    d_model: int = 2048,
+    batch_per_chip: int = 32,
+    bucket_bytes: int | None = None,
+    chain: bool = True,
+    return_hlo: bool = False,
+) -> dict:
+    """AOT-compile a DP grad-sync step for a multi-chip TPU topology and
+    report the scheduled overlap (``schedule_report``).
+
+    The program is the DDP kernel in miniature: an ``n_layers`` MLP
+    forward+backward with per-bucket chained pmean of the gradients —
+    one bucket per layer by default (``bucket_bytes=None`` → leaf-sized
+    buckets), matching the granularity DDP's Reducer sees.  With
+    ``chain=False`` the same program shows the stock-XLA failure mode
+    (combiner merges to one post-backward all-reduce) for comparison.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from distributeddataparallel_tpu.parallel.data_parallel import (
+        bucket_gradients,
+    )
+
+    mesh = tpu_topology_mesh(topology)
+    n_chips = mesh.devices.size
+
+    def step(w, x):
+        def loss(w, x):
+            h = x
+            for wi in w:
+                h = jnp.tanh(h @ wi)
+            return jnp.sum(h.astype(jnp.float32) ** 2)
+
+        g = jax.grad(loss)(w, x)
+        if chain:
+            bb = bucket_bytes or (d_model * d_model * 2)  # one leaf/bucket
+            g = bucket_gradients(g, "data", bucket_bytes=bb, chain=True)
+        else:
+            g = jax.tree.map(lambda t: lax.pmean(t, "data"), g)
+        return g
+
+    fn = jax.jit(
+        jax.shard_map(
+            step, mesh=mesh, in_specs=(P(), P("data")), out_specs=P(),
+            check_vma=False,
+        )
+    )
+    w = [
+        jax.ShapeDtypeStruct((d_model, d_model), jnp.bfloat16)
+        for _ in range(n_layers)
+    ]
+    x = jax.ShapeDtypeStruct((batch_per_chip * n_chips, d_model), jnp.bfloat16)
+    txt = (
+        fn.lower(w, x)
+        .compile(compiler_options=dict(OVERLAP_COMPILER_OPTIONS))
+        .as_text()
+    )
+    rep = schedule_report(txt)
+    rep.update(
+        {
+            "topology": topology,
+            "n_chips": n_chips,
+            "config": {
+                "n_layers": n_layers,
+                "d_model": d_model,
+                "batch_per_chip": batch_per_chip,
+                "chain": chain,
+                "bucket_bytes": bucket_bytes,
+            },
+        }
+    )
+    if return_hlo:
+        rep["hlo_text"] = txt
+    return rep
+
+
+def grad_sync_schedule_pair(**kwargs) -> dict:
+    """The chain-vs-stock evidence pair, packaged for artifacts.
+
+    One definition shared by the dryrun (MULTICHIP_PROBES.json) and the
+    bench (BENCH_r{N}.json) so the two recorded protocols cannot drift.
+    Raises if no TPU compiler is reachable — callers decide how to
+    degrade.
+    """
+    sched = grad_sync_schedule_evidence(chain=True, **kwargs)
+    stock = grad_sync_schedule_evidence(chain=False, **kwargs)
+    keys = (
+        "n_async_windows", "n_sync_collectives",
+        "overlapped_compute_cycles", "total_compute_cycles",
+        "overlapped_frac_of_compute", "topology", "n_chips",
+    )
+    return {
+        "tpu_schedule": {k: sched[k] for k in keys},
+        "tpu_schedule_stock_xla": {
+            k: stock[k]
+            for k in ("n_async_windows", "overlapped_frac_of_compute")
+        },
+    }
+
+
+def cpu_fabric_note() -> dict:
+    """Machine-checked statement of why overlap cannot appear on the CPU
+    test mesh: single-core fabric + synchronous-only CPU collectives.
+    Returned as data so dryrun/bench artifacts carry the evidence."""
+    import os
+
+    import jax
+
+    note = {
+        "physical_cores": len(os.sched_getaffinity(0)),
+        "claim": (
+            "XLA:CPU lowers collectives as synchronous all-reduce (no "
+            "start/done split, no async conversion pass), and the virtual "
+            "8-device mesh time-slices one physical core where "
+            "inter-device reduction is itself CPU work on that core — "
+            "step_time >= compute + comm by construction, so "
+            "overlap_frac=0.0 measures the fabric, not the framework. "
+            "See parallel/overlap.py and OVERLAP.md for the TPU-schedule "
+            "demonstration of the property."
+        ),
+    }
+    # Verify the sync-only claim against the live compiler when this
+    # process is on the CPU backend (cheap: tiny program).
+    try:
+        if jax.default_backend() == "cpu" and len(jax.devices()) > 1:
+            import numpy as np
+            import jax.numpy as jnp
+            from jax import lax
+            from jax.sharding import Mesh, PartitionSpec as P
+
+            n = len(jax.devices())
+            m = Mesh(np.array(jax.devices()), ("d",))
+            f = jax.jit(
+                jax.shard_map(
+                    lambda t: lax.psum(t, "d"), mesh=m, in_specs=P(),
+                    out_specs=P(), check_vma=False,
+                )
+            )
+            txt = f.lower(jnp.ones((128,), jnp.float32)).compile().as_text()
+            note["cpu_hlo_sync_allreduce"] = " all-reduce(" in txt
+            note["cpu_hlo_async_allreduce"] = "all-reduce-start" in txt
+    except Exception as exc:  # pragma: no cover - evidence gathering only
+        note["verify_error"] = repr(exc)
+    return note

@@ -35,7 +35,10 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from distributeddataparallel_tpu.parallel.data_parallel import all_reduce_gradients
+from distributeddataparallel_tpu.parallel.data_parallel import (
+    OVERLAP_BUCKET_BYTES,
+    all_reduce_gradients,
+)
 from distributeddataparallel_tpu.training.state import TrainState
 
 Pytree = Any
@@ -50,6 +53,7 @@ def make_train_step(
     axis_name: str = "data",
     accum_steps: int = 1,
     bucket_bytes: int | None = None,
+    overlap: bool = False,
     donate: bool = True,
     with_model_state: bool = False,
     zero: bool = False,
@@ -82,6 +86,17 @@ def make_train_step(
       DDP's ``broadcast_buffers=True`` semantics (rank 0's running stats
       win, the other replicas' updates are discarded).  Choose this for
       bit-level parity with the reference's training behavior.
+
+    ``overlap=True`` is the demonstrated analog of DDP's bucketed
+    all-reduce hidden under backward (ref dpp.py:52, SURVEY §3.4):
+    gradients reduce as chained reverse-order buckets
+    (``bucket_gradients(chain=True)``) and the step compiles with the
+    TPU async-collective/latency-hiding options, which schedules real
+    backward compute inside each collective's start/done window — see
+    ``parallel/overlap.py`` and OVERLAP.md for the scheduled-HLO
+    evidence.  Composes with ``accum_steps`` (reduction still fires once
+    per boundary) and ``grad_clip``; on non-TPU backends the chained
+    buckets still run (semantics identical) without the TPU options.
 
     With ``zero=True``, optimizer state is ZeRO-1-sharded across the data
     axis (see ``parallel.zero``): grads reduce_scatter instead of
@@ -135,11 +150,12 @@ def make_train_step(
     ``zero=True`` composes with both by the same local-flat-shard
     argument (build the state with ``zero_state(..., ep_axis=...)``).
     """
-    if zero and bucket_bytes is not None:
-        raise ValueError("zero=True does its own reduction; drop bucket_bytes")
-    if not grad_sync and (zero or bucket_bytes is not None):
+    if zero and (bucket_bytes is not None or overlap):
+        raise ValueError("zero=True does its own reduction; drop "
+                         "bucket_bytes/overlap")
+    if not grad_sync and (zero or bucket_bytes is not None or overlap):
         raise ValueError("grad_sync=False skips the reduction entirely; "
-                         "it does not compose with zero/bucket_bytes")
+                         "it does not compose with zero/bucket_bytes/overlap")
     if grad_clip is not None and (tp_axis is not None or ep_axis is not None):
         # Local Megatron/expert shards would each compute a DIFFERENT
         # "global" norm and scale the replicated leaves divergently —
@@ -256,8 +272,19 @@ def make_train_step(
         else:
             if grad_sync:
                 # THE DDP moment: average grads across the data axis.
+                # overlap=True: chained reverse-order buckets so the TPU
+                # backend's async-collective fusion can hide each bucket's
+                # all-reduce under the remaining backward (parallel.overlap;
+                # the scheduled-HLO evidence lives in OVERLAP.md).  1 MiB
+                # default bucket: leaves above it ride solo in native
+                # dtype, which is what the async scheduler fuses best.
                 grads = all_reduce_gradients(
-                    grads, axis_name, op="mean", bucket_bytes=bucket_bytes
+                    grads, axis_name, op="mean",
+                    bucket_bytes=(
+                        bucket_bytes if bucket_bytes is not None
+                        else (OVERLAP_BUCKET_BYTES if overlap else None)
+                    ),
+                    chain=overlap,
                 )
             if grad_clip is not None:
                 # Grads are complete per position here (post sync / cp
@@ -320,6 +347,16 @@ def make_train_step(
         P(axis_name, cp_axis) if cp_axis is not None else P(axis_name)
     )
     jit_kwargs = {"donate_argnums": (0,)} if donate else {}
+    if overlap:
+        # TPU async-collective + latency-hiding-scheduler options; None
+        # (a no-op) on backends whose compiler rejects TPU option names.
+        from distributeddataparallel_tpu.parallel.overlap import (
+            overlap_compiler_options,
+        )
+
+        opts = overlap_compiler_options()
+        if opts:
+            jit_kwargs["compiler_options"] = opts
 
     if not zero and tp_axis is None and ep_axis is None:
         sharded = jax.shard_map(

@@ -144,6 +144,12 @@ def parse_args(argv=None):
     p.add_argument("--bucket-mb", type=float, default=None,
                    help="explicit DDP-style gradient bucket size in MiB "
                         "(default: let XLA schedule the all-reduce)")
+    p.add_argument("--overlap", action="store_true",
+                   help="demonstrated comm/compute overlap (ref dpp.py:52): "
+                        "chained reverse-order gradient buckets + TPU "
+                        "async-collective/latency-hiding compiler options, "
+                        "so each bucket's all-reduce hides under the "
+                        "remaining backward (see OVERLAP.md)")
     p.add_argument("--buffer-sync", choices=["mean", "broadcast"],
                    default="mean",
                    help="BatchNorm-style buffer consistency across replicas: "
@@ -316,6 +322,20 @@ def validate_args(args) -> None:
             raise SystemExit(
                 "--grad-clip needs complete per-position grads "
                 "(no --tp/--ep/--pp): local-shard norms would diverge"
+            )
+    if args.overlap:
+        # ZeRO/FSDP/PP own their reductions (reduce_scatter / per-layer
+        # gathers / stage collectives) — the chained-bucket overlap path
+        # is the plain-DP all-reduce's.
+        bad = [
+            f for f, on in (
+                ("--zero", args.zero), ("--fsdp", args.fsdp),
+                ("--pp", args.pp > 1),
+            ) if on
+        ]
+        if bad:
+            raise SystemExit(
+                f"--overlap applies to the DP all-reduce; drop {', '.join(bad)}"
             )
     if args.generate:
         if not is_lm(args):
@@ -715,6 +735,7 @@ def train(args) -> float:
         step_fn = ddp.make_train_step(
             loss_fn, mesh=mesh, accum_steps=args.accum_steps,
             bucket_bytes=int(args.bucket_mb * 1024 * 1024) if args.bucket_mb else None,
+            overlap=args.overlap,
             with_model_state=has_ms, zero=args.zero,
             buffer_sync=args.buffer_sync,
             cp_axis="seq" if cp else None,

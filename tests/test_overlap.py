@@ -1,0 +1,222 @@
+"""Comm/compute overlap machinery (parallel/overlap.py; VERDICT r3 item 1).
+
+Three layers of coverage, matched to what each fabric can prove:
+
+- *Numerics* (CPU mesh): chained reverse-order buckets are bit-for-bit a
+  gradient mean — chain ordering and the optimization barriers move no
+  data; the overlapped train step matches the stock DP step.
+- *Schedule parser*: ``schedule_report`` extracts windows/cycles from
+  scheduled-HLO text (exercised on a canned snippet — no TPU needed).
+- *TPU schedule evidence*: AOT-compile for a multi-chip TPU topology via
+  ``jax.experimental.topologies`` and assert nonzero scheduled overlap.
+  Skipped where no TPU compiler is importable (the CI CPU mesh) — the
+  committed OVERLAP.md artifact carries the recorded result.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import distributeddataparallel_tpu as ddp
+from distributeddataparallel_tpu.parallel.data_parallel import (
+    all_reduce_gradients,
+    bucket_gradients,
+)
+from distributeddataparallel_tpu.parallel.overlap import (
+    cpu_fabric_note,
+    schedule_report,
+)
+from distributeddataparallel_tpu.runtime.distributed import make_mesh
+
+
+def _grad_tree(key):
+    sizes = ((64, 64), (7,), (33, 5), (256,), (2, 3, 4))
+    keys = jax.random.split(key, len(sizes))
+    return {
+        f"p{i}": jax.random.normal(k, s)
+        for i, (k, s) in enumerate(zip(keys, sizes))
+    }
+
+
+def test_chained_buckets_equal_plain_mean(devices):
+    mesh = make_mesh(("data",))
+    n = mesh.shape["data"]
+    trees = [_grad_tree(jax.random.PRNGKey(40 + i)) for i in range(n)]
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *trees)
+
+    def f(shard):
+        local = jax.tree.map(lambda x: x[0], shard)
+        return bucket_gradients(
+            local, "data", bucket_bytes=1024, chain=True
+        )
+
+    out = jax.jit(
+        jax.shard_map(f, mesh=mesh, in_specs=(P("data"),), out_specs=P(),
+                      check_vma=False)
+    )(stacked)
+    expected = jax.tree.map(lambda *xs: jnp.mean(jnp.stack(xs), 0), *trees)
+    for k in expected:
+        np.testing.assert_allclose(out[k], expected[k], rtol=1e-6, atol=1e-7)
+
+
+def test_chained_buckets_mixed_dtypes(devices):
+    """bf16 leaves reduce in native dtype, f32 in f32; chain still exact
+    to a plain pmean done at matching precision."""
+    mesh = make_mesh(("data",))
+    n = mesh.shape["data"]
+    trees = [
+        {
+            "a": jax.random.normal(jax.random.PRNGKey(50 + i), (64, 8)),
+            "b": jax.random.normal(
+                jax.random.PRNGKey(80 + i), (16, 16)
+            ).astype(jnp.bfloat16),
+        }
+        for i in range(n)
+    ]
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *trees)
+
+    def f(shard):
+        local = jax.tree.map(lambda x: x[0], shard)
+        return all_reduce_gradients(local, "data", chain=True)
+
+    out = jax.jit(
+        jax.shard_map(f, mesh=mesh, in_specs=(P("data"),), out_specs=P(),
+                      check_vma=False)
+    )(stacked)
+    exp_a = jnp.mean(jnp.stack([t["a"] for t in trees]), 0)
+    exp_b = (
+        sum(t["b"].astype(jnp.float32) for t in trees) / n
+    )
+    np.testing.assert_allclose(out["a"], exp_a, rtol=1e-6, atol=1e-7)
+    assert out["b"].dtype == jnp.bfloat16
+    # bf16-accumulated sum: loose tolerance, but the value must be the mean
+    np.testing.assert_allclose(
+        out["b"].astype(jnp.float32), exp_b, rtol=0.05, atol=0.05
+    )
+
+
+def test_overlap_train_step_matches_stock(devices):
+    """overlap=True is a pure schedule change: same loss, same params."""
+    mesh = make_mesh(("data",))
+
+    def loss_fn(params, batch, rng):
+        pred = batch["x"] @ params["w"] + params["b"]
+        return jnp.mean((pred - batch["y"]) ** 2), {}
+
+    params = {
+        "w": jax.random.normal(jax.random.PRNGKey(0), (16, 4)),
+        "b": jnp.zeros((4,)),
+    }
+    batch = {
+        "x": jax.random.normal(jax.random.PRNGKey(1), (32, 16)),
+        "y": jax.random.normal(jax.random.PRNGKey(2), (32, 4)),
+    }
+    dp = ddp.DataParallel(mesh)
+    sharded = dp.shard_batch(batch)
+
+    outs = {}
+    for name, kw in (
+        ("stock", {}),
+        ("overlap", {"overlap": True}),
+        ("overlap_accum", {"overlap": True, "accum_steps": 2}),
+        ("overlap_clip", {"overlap": True, "grad_clip": 0.5}),
+    ):
+        state = ddp.TrainState.create(
+            apply_fn=None, params=jax.tree.map(jnp.copy, params),
+            tx=optax.sgd(0.1),
+        )
+        state = ddp.broadcast_params(state, mesh)
+        step = ddp.make_train_step(loss_fn, mesh=mesh, donate=False, **kw)
+        new_state, metrics = step(state, sharded, jax.random.PRNGKey(3))
+        outs[name] = (new_state.params, float(metrics["loss"]))
+
+    np.testing.assert_allclose(
+        outs["stock"][1], outs["overlap"][1], rtol=1e-6
+    )
+    for k in params:
+        np.testing.assert_allclose(
+            outs["stock"][0][k], outs["overlap"][0][k], rtol=1e-6, atol=1e-7
+        )
+    # accum/clip variants: different math (by design); loss finite + params sane
+    for name in ("overlap_accum", "overlap_clip"):
+        assert np.isfinite(outs[name][1])
+
+
+def test_overlap_rejects_zero_and_nosync(devices):
+    mesh = make_mesh(("data",))
+
+    def loss_fn(params, batch, rng):
+        return jnp.sum(params["w"] * 0.0), {}
+
+    with pytest.raises(ValueError):
+        ddp.make_train_step(loss_fn, mesh=mesh, zero=True, overlap=True)
+    with pytest.raises(ValueError):
+        ddp.make_train_step(
+            loss_fn, mesh=mesh, grad_sync=False, overlap=True
+        )
+
+
+_CANNED_HLO = """\
+HloModule m
+
+%async_collective_fusion.1 (param_0.1: f32[8]) -> f32[8] {
+  %param_0.1 = f32[8]{0} parameter(0)
+  ROOT %ar = f32[8]{0} all-reduce(%param_0.1), replica_groups={}
+}
+
+%fused_computation.9 (p: f32[8]) -> f32[8] {
+  %p = f32[8]{0} parameter(0)
+  ROOT %ar2 = f32[8]{0} all-reduce(%p), replica_groups={}
+}
+
+ENTRY %main (a: f32[8]) -> f32[8] {
+  %a = f32[8]{0} parameter(0)
+  %f0 = f32[8]{0} fusion(%a), kind=kLoop, calls=%fc0, backend_config={"estimated_cycles":"100"}
+  %async-collective-start = f32[8]{0} fusion(%f0), kind=kCustom, calls=%fused_computation.9
+  %f1 = f32[8]{0} fusion(%f0), kind=kLoop, calls=%fc1, backend_config={"estimated_cycles":"250"}
+  %f2 = f32[8]{0} fusion(%f1), kind=kOutput, calls=%async_collective_fusion.1, backend_config={"estimated_cycles":"50"}
+  %async-collective-done = f32[8]{0} fusion(%async-collective-start), kind=kCustom, calls=%fused_computation.9
+  %f3 = f32[8]{0} fusion(%f2), kind=kLoop, calls=%fc2, backend_config={"estimated_cycles":"400"}
+  %ar9 = f32[8]{0} all-reduce(%f3), replica_groups={}
+  ROOT %f4 = f32[8]{0} fusion(%ar9), kind=kLoop, calls=%fc3, backend_config={"estimated_cycles":"75"}
+}
+"""
+
+
+def test_schedule_report_parser():
+    rep = schedule_report(_CANNED_HLO)
+    assert rep["n_async_windows"] == 1
+    # window holds f1 (250) + f2 (50, collective-fused compute) = 300
+    assert rep["windows"][0]["compute_cycles"] == 300
+    assert rep["n_sync_collectives"] == 1  # %ar9
+    assert rep["total_compute_cycles"] == 100 + 250 + 50 + 400 + 75
+    assert 0 < rep["overlapped_frac_of_compute"] < 1
+
+
+def test_cpu_fabric_note(devices):
+    note = cpu_fabric_note()
+    assert note["physical_cores"] >= 1
+    # On the CI CPU mesh the live-compiler check must run and confirm
+    # the synchronous-only lowering that makes overlap unmeasurable here.
+    assert note.get("cpu_hlo_sync_allreduce") is True
+    assert note.get("cpu_hlo_async_allreduce") is False
+
+
+def test_tpu_schedule_evidence():
+    """Nonzero scheduled overlap on an 8-chip TPU topology (AOT)."""
+    pytest.importorskip("jax.experimental.topologies")
+    from distributeddataparallel_tpu.parallel.overlap import (
+        grad_sync_schedule_evidence,
+    )
+
+    try:
+        rep = grad_sync_schedule_evidence(
+            n_layers=4, d_model=512, batch_per_chip=8
+        )
+    except Exception as exc:  # no TPU compiler in this process
+        pytest.skip(f"TPU topology compile unavailable: {exc!r}")
+    assert rep["n_async_windows"] >= 1
+    assert rep["overlapped_compute_cycles"] > 0
