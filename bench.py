@@ -568,6 +568,83 @@ def bench_cp_ring() -> dict:
     }
 
 
+def bench_input_pipeline() -> dict:
+    """Streaming input pipeline vs device rate (config 3's host side):
+    ImageNet-geometry batches (global batch 128) streamed from a
+    memmapped shard set (data.sharded) in the TPU-native split — host
+    does the u8 shard gather, the device does normalize in-graph.
+
+    Rates reported: ``host_gather_img_s`` (the pipeline's sustainable
+    feed rate) and ``host_to_device_img_s`` (including placement through
+    this environment's tunneled PCIe — a lower bound, the tunnel is not
+    real PCIe).  The done-bar comparison host_gather >= device rate is
+    computed in main() against bench_resnet50's img/s/chip.
+    """
+    import os
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import distributeddataparallel_tpu as ddp
+    from distributeddataparallel_tpu.data import (
+        DataLoader,
+        ShardedImageDataset,
+        write_synthetic_image_shards,
+    )
+
+    root = os.path.join(tempfile.gettempdir(), "ddp_bench_shards_v1")
+    if not os.path.exists(os.path.join(root, "index.json")):
+        write_synthetic_image_shards(
+            root, 2048, (224, 224, 3), 1000, shard_rows=512, seed=0
+        )
+    ds = ShardedImageDataset(root, device_normalize=True)
+    mesh = ddp.make_mesh(("data",))
+    n = mesh.shape["data"]
+    per = max(128 // n, 1)
+    out = {
+        "corpus_mb": round(len(ds) * np.prod(ds.image_shape) / 1e6, 1),
+        "global_batch": per * n,
+        "image_shape": list(ds.image_shape),
+    }
+
+    # Host gather rate: one full epoch of u8 shard gathers (no device).
+    loader = DataLoader(
+        ds, per_replica_batch=per, mesh=mesh, seed=0, device_feed=False
+    )
+    next(iter(loader))  # touch pages once so timing sees steady state
+    t0 = time.perf_counter()
+    rows = 0
+    for b in loader:
+        rows += b["image"].shape[0]
+    out["host_gather_img_s"] = round(rows / (time.perf_counter() - t0), 1)
+
+    # Gather + device placement (tunneled PCIe here; capped steps).
+    loader = DataLoader(
+        ds, per_replica_batch=per, mesh=mesh, seed=0, device_feed=True
+    )
+    it = iter(loader)
+    first = next(it)  # compile/placement warmup
+    jax.block_until_ready(first["image"])
+    t0 = time.perf_counter()
+    rows = 0
+    last = first
+    for _ in range(6):
+        try:
+            last = next(it)
+        except StopIteration:
+            break
+        rows += per * n
+    # value fence: tunneled block_until_ready under-reports (see _fence)
+    float(jnp.sum(last["image"].astype(jnp.int32)))
+    if rows:
+        out["host_to_device_img_s"] = round(
+            rows / (time.perf_counter() - t0), 1
+        )
+    return out
+
+
 def bench_overlap() -> dict:
     """Comm/compute overlap on the GPT-2 124M DP step (BASELINE config 5's
     "overlap demonstrated"): full step vs compute-only (grad_sync=False,
@@ -645,6 +722,14 @@ def main() -> None:
     moe = _run(bench_moe_scaling, "moe_scaling")
     cp_ring = _run(bench_cp_ring, "cp_ring")
     overlap = _run(bench_overlap, "overlap")
+    input_pipe = _run(bench_input_pipeline, "input_pipeline")
+    # Config 3's done bar: can the host pipeline feed the device?
+    if "host_gather_img_s" in input_pipe and "img_s_chip" in resnet:
+        dev_rate = resnet["img_s_chip"] * len(jax.devices())
+        input_pipe["device_img_s"] = round(dev_rate, 1)
+        input_pipe["host_over_device"] = round(
+            input_pipe["host_gather_img_s"] / max(dev_rate, 1e-9), 3
+        )
 
     img_s_chip = resnet.get("img_s_chip", 0.0)
     target = TARGET_FRACTION * A100_DDP_RESNET50_IMG_S
@@ -666,6 +751,7 @@ def main() -> None:
                     "moe_token_choice": moe,
                     "cp_ring_block": cp_ring,
                     "overlap_gpt2_dp": overlap,
+                    "input_pipeline": input_pipe,
                 },
             }
         )

@@ -4,6 +4,12 @@ from distributeddataparallel_tpu.data.datasets import (  # noqa: F401
     SyntheticLM,
     load_cifar10,
 )
+from distributeddataparallel_tpu.data.sharded import (  # noqa: F401
+    ShardedImageDataset,
+    shard_indices_for_hosts,
+    write_image_shards,
+    write_synthetic_image_shards,
+)
 from distributeddataparallel_tpu.data.loader import (  # noqa: F401
     DataLoader,
     shard_batch,

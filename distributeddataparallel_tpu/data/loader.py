@@ -223,6 +223,12 @@ class DataLoader:
         gather (the fused augment path) — every other column keeps the
         ONE normalize contract defined here.
         """
+        gather = getattr(self.dataset, "gather", None)
+        if callable(gather) and image_gather is None:
+            # Streaming datasets (data.sharded): the dataset owns the
+            # shard-aware gather; the loader contract (sampler-ordered
+            # rows, normalize-on-access) is the same as the columnar path.
+            return gather(idx)
         arrays = getattr(self.dataset, "arrays", None)
         if callable(arrays):
             from distributeddataparallel_tpu import native

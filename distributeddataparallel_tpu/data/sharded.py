@@ -1,0 +1,275 @@
+"""File-sharded streaming image dataset: the ImageNet-scale input path.
+
+The reference's data layer is `datasets.CIFAR10(...)` (ref dpp.py:33) —
+a fully-materialized in-RAM array, fine at 170 MB.  BASELINE config 3
+(ResNet-50/ImageNet multi-host DP) needs the capability that torch users
+get from `ImageFolder` + DataLoader workers: a dataset that *streams*
+from disk, keeps chips fed, and never requires the full corpus in host
+memory (SURVEY.md §7 hard-part-2).
+
+TPU-native design (one process per host feeding all local replicas):
+
+- **Shard files**: a directory of `shard_NNNNN_images.npy` (uint8,
+  N×H×W×C) + `shard_NNNNN_labels.npy` pairs with an `index.json`
+  manifest.  `.npy` because NumPy memory-maps it natively — random row
+  access is OS page-cache-backed file IO with zero deserialization (the
+  role TFRecord/grain's index files play, without a new format).
+- **Global-index semantics**: `DistributedSampler` striding/padding and
+  epoch reshuffle operate on GLOBAL indices, exactly like the in-RAM
+  path — sampler equivalence is testable batch-for-batch.  The mapping
+  global index → (shard, row) is `shard_indices_for_hosts`; each host
+  touches only the rows its replicas' sampler shards demand, so the
+  per-host working set is the batch, not the corpus.
+- **Gather**: rows are grouped per shard and fancy-gathered straight off
+  each shard's memmap through the fused native uint8
+  gather+ToTensor+Normalize kernel (`native.gather_normalize_u8` — the
+  same one the in-RAM u8 path uses), assembled into the batch in sampler
+  order.  Only batch-sized float32 buffers are ever allocated; image
+  bytes stay file-backed (anonymous-RSS tests pin this down).
+- **Prefetch**: `DataLoader(workers=1, prefetch=N)` runs gather + device
+  placement on a background thread, unchanged — the streaming dataset
+  plugs into the existing loader via the `gather(idx)` protocol.
+
+Writer utilities build shard sets from arrays or synthetically; the
+synthetic writer generates shard-by-shard so corpus size is bounded by
+disk, not RAM (used by the larger-than-RAM streaming tests and the
+bench's host-pipeline-vs-device-rate section).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Callable
+
+import numpy as np
+
+_MANIFEST = "index.json"
+
+
+def write_image_shards(
+    root: str,
+    images: np.ndarray,
+    labels: np.ndarray,
+    *,
+    shard_rows: int = 1024,
+    num_classes: int | None = None,
+) -> str:
+    """Write an in-RAM (images, labels) pair as a shard directory."""
+    if len(images) != len(labels):
+        raise ValueError("images/labels length mismatch")
+    if images.dtype != np.uint8:
+        raise ValueError(
+            f"shards store uint8 images (got {images.dtype}); quantize first"
+        )
+    if num_classes is None and len(labels):
+        # The manifest must carry the class count — consumers size the
+        # classifier head from it; silently guessing would be worse.
+        num_classes = int(np.max(labels)) + 1
+
+    def gen(lo, hi):
+        return images[lo:hi], labels[lo:hi]
+
+    return _write_shards(
+        root, len(images), images.shape[1:], gen, shard_rows=shard_rows,
+        num_classes=num_classes,
+    )
+
+
+def write_synthetic_image_shards(
+    root: str,
+    num_examples: int,
+    shape: tuple[int, ...] = (224, 224, 3),
+    num_classes: int = 1000,
+    *,
+    shard_rows: int = 1024,
+    seed: int = 0,
+    proto_seed: int = 0,
+    sparse: bool = False,
+) -> str:
+    """Synthetic class-conditional shard set, generated shard-by-shard —
+    peak RAM is one shard regardless of corpus size.
+
+    Class-conditional structure (per-class mean color from ``proto_seed``
+    + pixel noise) keeps loss learnable; prototypes are per-class COLOR
+    vectors, not full images, so prototype memory is O(classes × channels)
+    — generation peaks at one shard even for ImageNet geometry × 1000
+    classes.  ``sparse=True`` writes all-zero image shards as filesystem
+    holes (labels still real): a corpus "larger than the RAM budget"
+    costs no disk or generation time — the streaming tests use this to
+    iterate multi-GB sets in milliseconds of IO.
+    """
+    proto_rng = np.random.default_rng(proto_seed)
+    colors = proto_rng.integers(
+        32, 224, size=(num_classes, shape[-1]), dtype=np.int16
+    )
+    rng = np.random.default_rng(seed)
+
+    def gen(lo, hi):
+        n = hi - lo
+        labels = rng.integers(0, num_classes, size=(n,), dtype=np.int32)
+        if sparse:
+            return None, labels
+        noise = rng.integers(-40, 41, size=(n,) + shape, dtype=np.int16)
+        base = colors[labels].reshape(
+            (n,) + (1,) * (len(shape) - 1) + (shape[-1],)
+        )
+        imgs = np.clip(base + noise, 0, 255).astype(np.uint8)
+        return imgs, labels
+
+    return _write_shards(
+        root, num_examples, shape, gen, shard_rows=shard_rows,
+        num_classes=num_classes,
+    )
+
+
+def _write_shards(
+    root: str,
+    num_examples: int,
+    shape: tuple[int, ...],
+    gen: Callable,
+    *,
+    shard_rows: int,
+    num_classes: int | None,
+) -> str:
+    os.makedirs(root, exist_ok=True)
+    counts = []
+    for s, lo in enumerate(range(0, num_examples, shard_rows)):
+        hi = min(lo + shard_rows, num_examples)
+        imgs, labels = gen(lo, hi)
+        ipath = os.path.join(root, f"shard_{s:05d}_images.npy")
+        if imgs is None:
+            # Filesystem-hole shard: correct .npy header, zero data pages.
+            mm = np.lib.format.open_memmap(
+                ipath, mode="w+", dtype=np.uint8,
+                shape=(hi - lo,) + tuple(shape),
+            )
+            del mm  # header flushed; data stays sparse
+        else:
+            np.save(ipath, np.ascontiguousarray(imgs))
+        np.save(
+            os.path.join(root, f"shard_{s:05d}_labels.npy"),
+            np.ascontiguousarray(labels.astype(np.int32)),
+        )
+        counts.append(hi - lo)
+    manifest = {
+        "num_examples": num_examples,
+        "shape": list(shape),
+        "shard_counts": counts,
+        "num_classes": num_classes,
+    }
+    with open(os.path.join(root, _MANIFEST), "w") as fh:
+        json.dump(manifest, fh)
+    return root
+
+
+def shard_indices_for_hosts(offsets: np.ndarray, idx: np.ndarray):
+    """Map global row indices → (shard_id, local_row) under the manifest's
+    shard offsets.  This is the per-host assignment: a host resolves only
+    the indices its replicas' sampler shards demand, so which shard files
+    (and which pages of them) get touched follows the sampler, not the
+    corpus."""
+    idx = np.asarray(idx, dtype=np.int64)
+    shard_ids = np.searchsorted(offsets, idx, side="right") - 1
+    return shard_ids, idx - offsets[shard_ids]
+
+
+class ShardedImageDataset:
+    """Streaming (memmapped) image classification dataset.
+
+    Satisfies both loader protocols: `gather(idx)` for columnar batched
+    access (the fast path `data.loader.DataLoader` uses) and
+    `__getitem__` for item access.  Labels (4 B/row) load eagerly;
+    image shards are memmaps whose pages the OS faults in per gather.
+    """
+
+    def __init__(
+        self,
+        root: str,
+        *,
+        normalize_u8: bool = True,
+        device_normalize: bool = False,
+    ):
+        mpath = os.path.join(root, _MANIFEST)
+        if not os.path.exists(mpath):
+            raise FileNotFoundError(
+                f"no shard manifest at {mpath}; build one with "
+                "write_image_shards / write_synthetic_image_shards"
+            )
+        with open(mpath) as fh:
+            m = json.load(fh)
+        self.root = root
+        self.image_shape = tuple(m["shape"])
+        self.num_classes = m.get("num_classes")
+        self._counts = np.asarray(m["shard_counts"], dtype=np.int64)
+        self._offsets = np.concatenate(
+            [[0], np.cumsum(self._counts)]
+        )
+        self._n = int(m["num_examples"])
+        if self._offsets[-1] != self._n:
+            raise ValueError(
+                f"manifest inconsistent: shard counts sum {self._offsets[-1]}"
+                f" != num_examples {self._n}"
+            )
+        #: loader contract: uint8 storage normalized on access
+        self.normalize_u8 = normalize_u8 and not device_normalize
+        #: TPU-native fast path: batches carry RAW uint8 images — 4× less
+        #: host CPU work and host→device bytes — and the consumer folds
+        #: ToTensor+Normalize into the device step (``ops.normalize_u8``,
+        #: fused by XLA into the first conv's input pipeline).  The two
+        #: paths agree to 1 ulp (tests).
+        self.device_normalize = device_normalize
+        self._mmaps: dict[int, np.memmap] = {}
+        self.labels = np.concatenate(
+            [
+                np.load(os.path.join(root, f"shard_{s:05d}_labels.npy"))
+                for s in range(len(self._counts))
+            ]
+        ) if len(self._counts) else np.zeros((0,), np.int32)
+
+    def _shard(self, s: int) -> np.memmap:
+        mm = self._mmaps.get(s)
+        if mm is None:
+            mm = np.load(
+                os.path.join(self.root, f"shard_{s:05d}_images.npy"),
+                mmap_mode="r",
+            )
+            self._mmaps[s] = mm
+        return mm
+
+    def __len__(self) -> int:
+        return self._n
+
+    def touched_shards(self, idx) -> np.ndarray:
+        """Diagnostic: which shard files a set of global indices reads."""
+        shard_ids, _ = shard_indices_for_hosts(self._offsets, idx)
+        return np.unique(shard_ids)
+
+    def gather(self, idx) -> dict:
+        """Batch rows `idx` (global indices, sampler order) as
+        {"image": float32 normalized, "label": int32} — only batch-sized
+        buffers are allocated; shard bytes stay file-backed."""
+        from distributeddataparallel_tpu import native
+        from distributeddataparallel_tpu.data.datasets import (
+            normalize_images,
+        )
+
+        idx = np.asarray(idx, dtype=np.int64)
+        shard_ids, local = shard_indices_for_hosts(self._offsets, idx)
+        out = np.empty(
+            (len(idx),) + self.image_shape,
+            np.float32 if self.normalize_u8 else np.uint8,
+        )
+        for s in np.unique(shard_ids):
+            sel = shard_ids == s
+            rows = local[sel]
+            mm = self._shard(int(s))
+            if self.normalize_u8:
+                out[sel] = native.gather_normalize_u8(mm, rows)
+            else:
+                out[sel] = mm[rows]
+        return {"image": out, "label": self.labels[idx]}
+
+    def __getitem__(self, idx):
+        b = self.gather(np.asarray([idx]))
+        return b["image"][0], b["label"][0]

@@ -71,9 +71,16 @@ def random_crop(
     — torchvision pads the raw image with 0 BEFORE ToTensor/Normalize,
     and this loader augments after normalization, so the fill must be
     the normalized black, not 0 (mid-gray).
+
+    uint8 batches (the device-normalize streaming path, where the crop
+    runs BEFORE the in-graph normalize) get ``fill`` mapped back to u8
+    space — normalized -1.0 → u8 0 — so both orderings pad with the same
+    black instead of -1.0 wrapping to u8 255 (white).
     """
     if padding == 0:
         return images
+    if images.dtype == np.uint8:
+        fill = float(np.clip(round((fill * 0.5 + 0.5) * 255.0), 0, 255))
     B = images.shape[0]
     oy = rng.integers(0, 2 * padding + 1, B)
     ox = rng.integers(0, 2 * padding + 1, B)

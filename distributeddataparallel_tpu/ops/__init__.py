@@ -5,6 +5,9 @@ from distributeddataparallel_tpu.ops.losses import (  # noqa: F401
     per_example_accuracy,
     per_example_cross_entropy,
 )
+from distributeddataparallel_tpu.ops.preprocess import (  # noqa: F401
+    normalize_u8_images,
+)
 from distributeddataparallel_tpu.ops.attention import (  # noqa: F401
     attention,
     dot_product_attention,

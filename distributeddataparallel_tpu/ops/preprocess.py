@@ -1,0 +1,21 @@
+"""Device-side input preprocessing.
+
+The reference normalizes on the host inside the DataLoader workers
+(ToTensor + Normalize((0.5,),(0.5,)), ref dpp.py:32).  On TPU the better
+split ships RAW uint8 to the device — 4× fewer host→device bytes and no
+host float conversion — and folds the normalize into the compiled step,
+where XLA fuses it with the first conv's input pipeline (free VPU work
+under an MXU-bound conv).  `data.sharded.ShardedImageDataset
+(device_normalize=True)` emits uint8 batches for this path.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def normalize_u8_images(x: jnp.ndarray) -> jnp.ndarray:
+    """uint8 NHWC → float32 in [-1, 1]: the reference's ToTensor +
+    Normalize((0.5,), (0.5,)) (ref dpp.py:32), in-graph.  Matches the
+    host-side `data.datasets.normalize_images` to 1 ulp."""
+    return (x.astype(jnp.float32) / 255.0 - 0.5) / 0.5

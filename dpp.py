@@ -25,6 +25,21 @@ import os
 import sys
 
 
+def _dataset_arg(v: str) -> str:
+    """Parse-time --dataset validation (argparse choices can't express the
+    shards:DIR form): typos fail at parse for CLI and programmatic
+    train(parse_args([...])) callers alike, instead of falling through to
+    the CIFAR-10 default in build_dataset."""
+    if v in ("synthetic", "cifar10", "synthetic-lm") or v.startswith(
+        "shards:"
+    ):
+        return v
+    raise argparse.ArgumentTypeError(
+        f"{v!r} is not one of synthetic | cifar10 | synthetic-lm | "
+        "shards:DIR"
+    )
+
+
 def parse_args(argv=None):
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--device", choices=["tpu", "cpu", "cuda", "auto"], default="auto",
@@ -34,9 +49,11 @@ def parse_args(argv=None):
     p.add_argument("--model", default="cnn",
                    choices=["mlp", "cnn", "resnet18", "resnet50", "gpt2", "llama"],
                    help="model family (resnet18 matches the reference)")
-    p.add_argument("--dataset", default=None,
-                   choices=["synthetic", "cifar10", "synthetic-lm"],
-                   help="default: synthetic-lm for --model gpt2/llama, "
+    p.add_argument("--dataset", default=None, type=_dataset_arg,
+                   help="one of synthetic | cifar10 | synthetic-lm | "
+                        "shards:DIR (streaming memmapped shard directory, "
+                        "ImageNet-scale path; DIR or DIR/{train,val}); "
+                        "default: synthetic-lm for --model gpt2/llama, "
                         "synthetic otherwise")
     p.add_argument("--seq-len", type=int, default=128,
                    help="LM sequence length")
@@ -249,7 +266,7 @@ def is_lm(args) -> bool:
 
 
 def validate_args(args) -> None:
-    if is_lm(args) and args.dataset in ("cifar10", "synthetic"):
+    if is_lm(args) and args.dataset != "synthetic-lm":
         raise SystemExit(
             f"--model {args.model} is a language model; it trains on "
             f"--dataset synthetic-lm (got {args.dataset!r})"
@@ -454,6 +471,22 @@ def build_dataset(args, train=True):
         return data.SyntheticClassification(
             num_examples=args.num_examples, seed=args.seed if train else args.seed + 1
         )
+    if str(args.dataset).startswith("shards:"):
+        # Streaming memmapped shard directory (data.sharded): the
+        # ImageNet-scale path — per-batch disk reads, never full-RAM.
+        root = args.dataset.split(":", 1)[1]
+        split = os.path.join(root, "train" if train else "val")
+        if os.path.isdir(split):
+            root = split
+        elif not train:
+            raise SystemExit(
+                f"--eval with --dataset shards: needs {split} "
+                "(no val split in the shard directory)"
+            )
+        # device_normalize: ship raw u8 to the chip (4x fewer host->device
+        # bytes, no host float conversion); normalize fuses into the
+        # compiled step (ops.normalize_u8_images).
+        return data.ShardedImageDataset(root, device_normalize=True)
     from distributeddataparallel_tpu import native
 
     # u8 storage + fused native normalize-on-gather when the native lib
@@ -545,8 +578,17 @@ def train(args) -> float:
     )
 
     lm = is_lm(args)
+    num_classes = getattr(dataset, "num_classes", None)
+    if not lm and hasattr(dataset, "num_classes") and num_classes is None:
+        raise SystemExit(
+            "shard manifest lacks num_classes — rewrite the shards with "
+            "write_image_shards(..., num_classes=...) so the classifier "
+            "head can be sized"
+        )
     model = build_model(
-        args, vocab_size=getattr(dataset, "vocab_size", None)
+        args,
+        num_classes=num_classes or 10,
+        vocab_size=getattr(dataset, "vocab_size", None),
     )
     rng = jax.random.PRNGKey(args.seed)            # ref dpp.py:29 analog
     if lm:
@@ -565,7 +607,8 @@ def train(args) -> float:
             )
         variables = init_model.init(rng, sample)
     else:
-        sample = jnp.zeros((1,) + dataset.images.shape[1:], jnp.float32)
+        shape = getattr(dataset, "image_shape", None) or dataset.images.shape[1:]
+        sample = jnp.zeros((1,) + tuple(shape), jnp.float32)
         variables = model.init(rng, sample)
     if args.pretrained:
         # Fine-tune flow (ref dpp.py:14-15): replace the random init with
@@ -646,6 +689,15 @@ def train(args) -> float:
         )
         state = ddp.broadcast_params(state, mesh)   # DDP ctor broadcast analog
 
+    # Streaming shard datasets ship raw u8 images; normalize in-graph
+    # (ops.normalize_u8_images — XLA fuses it under the first conv).
+    if getattr(dataset, "device_normalize", False):
+        from distributeddataparallel_tpu.ops import normalize_u8_images
+
+        _img = lambda batch: normalize_u8_images(batch["image"])
+    else:
+        _img = lambda batch: batch["image"]
+
     if lm:
         from distributeddataparallel_tpu.ops import lm_cross_entropy
 
@@ -687,7 +739,7 @@ def train(args) -> float:
     elif has_ms:
         def loss_fn(params, ms, batch, rng):
             logits, new_vars = model.apply(
-                {"params": params, **ms}, batch["image"], train=True,
+                {"params": params, **ms}, _img(batch), train=True,
                 mutable=list(ms.keys()),
             )
             loss = cross_entropy_loss(logits, batch["label"])  # ref dpp.py:40
@@ -695,7 +747,7 @@ def train(args) -> float:
             return loss, (aux, new_vars)
     else:
         def loss_fn(params, batch, rng):
-            logits = model.apply({"params": params}, batch["image"])
+            logits = model.apply({"params": params}, _img(batch))
             loss = cross_entropy_loss(logits, batch["label"])  # ref dpp.py:40
             return loss, {"accuracy": accuracy(logits, batch["label"])}
 
@@ -934,7 +986,7 @@ def train(args) -> float:
         elif has_ms:
             def metric_fn(params, ms, batch):
                 logits = model.apply(
-                    {"params": params, **ms}, batch["image"], train=False
+                    {"params": params, **ms}, _img(batch), train=False
                 )
                 return {
                     "loss": per_example_cross_entropy(logits, batch["label"]),
@@ -942,7 +994,7 @@ def train(args) -> float:
                 }
         else:
             def metric_fn(params, batch):
-                logits = model.apply({"params": params}, batch["image"])
+                logits = model.apply({"params": params}, _img(batch))
                 return {
                     "loss": per_example_cross_entropy(logits, batch["label"]),
                     "accuracy": per_example_accuracy(logits, batch["label"]),

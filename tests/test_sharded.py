@@ -1,0 +1,253 @@
+"""Streaming file-sharded dataset (data/sharded.py; VERDICT r3 item 2).
+
+The contract under test: (1) the streaming gather is byte-identical to
+the in-RAM u8 path, (2) DistributedSampler semantics are preserved
+batch-for-batch through the DataLoader, (3) a corpus far larger than the
+RAM budget streams with only batch-sized anonymous allocations — image
+bytes stay file-backed (memmap), and (4) the dpp.py CLI trains on
+``--dataset shards:DIR`` end to end.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from distributeddataparallel_tpu.data import (
+    ArrayDataset,
+    DataLoader,
+    ShardedImageDataset,
+    shard_indices_for_hosts,
+    write_image_shards,
+    write_synthetic_image_shards,
+)
+
+
+def _toy_corpus(n=300, shape=(16, 16, 3), seed=0):
+    rng = np.random.default_rng(seed)
+    images = rng.integers(0, 256, size=(n,) + shape, dtype=np.uint8)
+    labels = rng.integers(0, 10, size=(n,), dtype=np.int32)
+    return images, labels
+
+
+def test_roundtrip_gather_matches_in_ram(tmp_path):
+    images, labels = _toy_corpus()
+    root = write_image_shards(
+        str(tmp_path / "shards"), images, labels, shard_rows=64
+    )
+    ds = ShardedImageDataset(root)
+    assert len(ds) == len(images)
+    assert ds.image_shape == images.shape[1:]
+
+    ram = ArrayDataset(images, labels, normalize_u8=True)
+    idx = np.asarray([0, 5, 63, 64, 65, 127, 128, 299, 7])  # shard borders
+    got = ds.gather(idx)
+    want_img = np.stack([ram[int(i)][0] for i in idx])
+    # native fused kernel vs NumPy normalize: identical up to 1 ulp
+    np.testing.assert_allclose(
+        got["image"], want_img.astype(np.float32), atol=1e-6
+    )
+    np.testing.assert_array_equal(got["label"], labels[idx])
+
+    img0, lab0 = ds[42]
+    np.testing.assert_allclose(img0, ram[42][0], atol=1e-6)
+    assert lab0 == labels[42]
+
+
+def test_shard_indices_for_hosts():
+    offsets = np.asarray([0, 64, 128, 150])
+    sid, local = shard_indices_for_hosts(offsets, [0, 63, 64, 149, 100])
+    np.testing.assert_array_equal(sid, [0, 0, 1, 2, 1])
+    np.testing.assert_array_equal(local, [0, 63, 0, 21, 36])
+
+
+def test_loader_batches_match_in_ram_dataset(devices):
+    """Sampler semantics preserved: the streaming dataset yields the
+    exact batches the in-RAM dataset does — shuffle, epoch reshuffle,
+    pad masking and all."""
+    import distributeddataparallel_tpu as ddp
+
+    images, labels = _toy_corpus(n=275)  # non-multiple of replicas: pads
+    mesh = ddp.make_mesh(("data",))
+
+    def batches(dataset, epoch):
+        loader = DataLoader(
+            dataset, per_replica_batch=4, mesh=mesh, seed=3,
+            drop_last=False, with_mask=True, device_feed=False,
+        )
+        loader.set_epoch(epoch)
+        return list(loader)
+
+    for epoch in (0, 1):
+        for sharded_root_rows in (64,):
+            root = write_image_shards(
+                f"/tmp/_ddp_shard_eq_{epoch}", images, labels,
+                shard_rows=sharded_root_rows,
+            )
+            a = batches(ShardedImageDataset(root), epoch)
+            b = batches(ArrayDataset(images, labels, normalize_u8=True), epoch)
+            assert len(a) == len(b) and len(a) > 0
+            for x, y in zip(a, b):
+                np.testing.assert_array_equal(x["image"], y["image"])
+                np.testing.assert_array_equal(x["label"], y["label"])
+                np.testing.assert_array_equal(x["valid"], y["valid"])
+
+
+def _rss_anon_kb() -> int:
+    with open("/proc/self/status") as fh:
+        for line in fh:
+            if line.startswith("RssAnon"):
+                return int(line.split()[1])
+    raise RuntimeError("no RssAnon in /proc/self/status")
+
+
+def test_streams_larger_than_ram_budget(tmp_path, devices):
+    """A 4 GB corpus (sparse shard files: real .npy layout, hole-backed
+    pages) streams with anonymous-RSS growth bounded by batch buffers —
+    nothing resembling the corpus is ever materialized in RAM."""
+    import distributeddataparallel_tpu as ddp
+
+    shape = (224, 224, 3)  # ImageNet geometry: ~150 KB/row
+    n = 28000              # ~4.2 GB of image bytes
+    root = write_synthetic_image_shards(
+        str(tmp_path / "big"), n, shape, 1000, shard_rows=4096, sparse=True,
+    )
+    ds = ShardedImageDataset(root)
+    assert len(ds) == n
+
+    mesh = ddp.make_mesh(("data",))
+    loader = DataLoader(
+        ds, per_replica_batch=16, mesh=mesh, seed=0, device_feed=False,
+    )
+    base = _rss_anon_kb()
+    it = iter(loader)
+    seen = 0
+    for _ in range(12):  # 12 × 128-row batches ≈ 230 MB of corpus touched
+        batch = next(it)
+        assert batch["image"].shape == (128,) + shape
+        seen += batch["image"].shape[0]
+    grown_mb = (_rss_anon_kb() - base) / 1024
+    touched_mb = seen * int(np.prod(shape)) / 1e6
+    # Anonymous growth must be batch-scale (float32 batch ≈ 77 MB plus
+    # allocator slack), nowhere near the ~1.7 GB of (normalized f32)
+    # corpus already consumed, let alone the 4 GB corpus.
+    assert grown_mb < 500, (grown_mb, touched_mb)
+
+
+def test_cli_trains_on_shards(tmp_path, devices):
+    import sys
+
+    sys.path.insert(0, "/root/repo")
+    import dpp
+
+    rng = np.random.default_rng(0)
+    # small learnable corpus: class-conditional synthetic, real bytes
+    root = write_synthetic_image_shards(
+        str(tmp_path / "cli"), 256, (16, 16, 3), 10, shard_rows=100,
+        sparse=False,
+    )
+    # train split layout: bare directory (no train/ subdir)
+    args = dpp.parse_args(
+        [
+            "--device", "cpu",
+            "--model", "cnn",
+            "--dataset", f"shards:{root}",
+            "--epochs", "2",
+            "--batch-size", "4",
+            "--lr", "0.05",
+            "--log-every", "1000",
+        ]
+    )
+    final_loss = dpp.train(args)
+    assert final_loss == final_loss and final_loss < 2.5  # finite, learning
+
+
+def test_device_normalize_path(tmp_path, devices):
+    """device_normalize=True ships raw u8; in-graph normalize matches the
+    host-side fused kernel to 1 ulp."""
+    import jax
+
+    from distributeddataparallel_tpu.ops import normalize_u8_images
+
+    images, labels = _toy_corpus(n=64)
+    root = write_image_shards(str(tmp_path / "u8"), images, labels,
+                              shard_rows=32)
+    dev = ShardedImageDataset(root, device_normalize=True)
+    host = ShardedImageDataset(root)
+    idx = np.arange(0, 64, 3)
+    raw = dev.gather(idx)
+    assert raw["image"].dtype == np.uint8
+    np.testing.assert_array_equal(raw["image"], images[idx])
+    normed = jax.jit(normalize_u8_images)(raw["image"])
+    np.testing.assert_allclose(
+        np.asarray(normed), host.gather(idx)["image"], atol=1e-6
+    )
+
+
+def test_cli_trains_on_shards_with_eval(tmp_path, devices):
+    """shards:DIR with train/val split layout + --eval end to end."""
+    import sys
+
+    sys.path.insert(0, "/root/repo")
+    import dpp
+
+    base = tmp_path / "split"
+    write_synthetic_image_shards(
+        str(base / "train"), 256, (16, 16, 3), 10, shard_rows=100
+    )
+    write_synthetic_image_shards(
+        str(base / "val"), 64, (16, 16, 3), 10, shard_rows=100, seed=9
+    )
+    args = dpp.parse_args(
+        [
+            "--device", "cpu", "--model", "cnn",
+            "--dataset", f"shards:{base}",
+            "--epochs", "1", "--batch-size", "4", "--lr", "0.05",
+            "--log-every", "1000", "--eval",
+        ]
+    )
+    final_loss = dpp.train(args)
+    assert final_loss == final_loss
+
+
+def test_u8_augment_fill_matches_float_path():
+    """random_crop on uint8 (device-normalize streaming path) pads with
+    u8 black (0), agreeing with the float path's normalized -1.0 fill
+    after in-graph normalize — not wrapping -1.0 to white 255."""
+    from distributeddataparallel_tpu.data import random_crop
+    from distributeddataparallel_tpu.data.datasets import normalize_images
+
+    rng_img = np.random.default_rng(0)
+    u8 = rng_img.integers(0, 256, size=(4, 8, 8, 3), dtype=np.uint8)
+    f32 = normalize_images(u8)
+    out_u8 = random_crop(u8, np.random.default_rng(7), padding=4)
+    out_f32 = random_crop(f32, np.random.default_rng(7), padding=4)
+    np.testing.assert_allclose(
+        normalize_images(out_u8), out_f32, atol=1e-6
+    )
+
+
+def test_write_image_shards_infers_num_classes(tmp_path):
+    images, labels = _toy_corpus(n=40)
+    root = write_image_shards(str(tmp_path / "nc"), images, labels)
+    assert ShardedImageDataset(root).num_classes == int(labels.max()) + 1
+
+
+def test_dataset_arg_rejected_at_parse_time():
+    import sys
+
+    sys.path.insert(0, "/root/repo")
+    import dpp
+
+    with pytest.raises(SystemExit):
+        dpp.parse_args(["--dataset", "cifar"])  # typo: parse-time error
+
+
+def test_manifest_validation(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        ShardedImageDataset(str(tmp_path / "nope"))
+    images, labels = _toy_corpus(n=10)
+    with pytest.raises(ValueError):
+        write_image_shards(
+            str(tmp_path / "f32"), images.astype(np.float32), labels
+        )
