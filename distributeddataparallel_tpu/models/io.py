@@ -164,6 +164,19 @@ def load_pretrained(path: str, model, variables: Pytree) -> Pytree:
             bottleneck=model.block_cls is BottleneckBlock,
         )
     cfg = getattr(model, "cfg", None)
+    lm_ckpt = (
+        "wte.weight" in flat
+        or "transformer.wte.weight" in flat
+        or "model.embed_tokens.weight" in flat
+    )
+    if lm_ckpt and cfg is None:
+        # e.g. --model resnet18 --pretrained gpt2.safetensors: a clear
+        # format mismatch beats an AttributeError on cfg.scan_layers.
+        raise ValueError(
+            f"{path!r} looks like a GPT-2/Llama LM checkpoint, but the "
+            f"target model ({type(model).__name__}) has no "
+            "TransformerConfig — pass a matching --model"
+        )
     if "wte.weight" in flat or "transformer.wte.weight" in flat:
         params = convert_gpt2_hf(flat, cfg)
         if cfg.scan_layers:

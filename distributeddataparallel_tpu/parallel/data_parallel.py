@@ -176,6 +176,75 @@ def sumsq_f32(tree: Pytree):
     )
 
 
+def spec_axes(spec) -> tuple:
+    """Mesh axis names a PartitionSpec shards over (flattened, deduped)."""
+    axes = []
+    for part in tuple(spec):
+        if part is None:
+            continue
+        for ax in (part if isinstance(part, tuple) else (part,)):
+            if ax is not None and ax not in axes:
+                axes.append(ax)
+    return tuple(axes)
+
+
+def model_axes_sumsq(grads: Pytree, specs: Pytree):
+    """Exact global gradient sum-of-squares under model-axis sharding
+    (inside shard_map) — the global-norm-clip building block for
+    TP/EP/PP layouts.
+
+    Per leaf: the local shard's f32 sumsq, psum'd over every mesh axis
+    the leaf's PartitionSpec shards it on.  Leaves replicated over an
+    axis are identical there (the conjugate custom-VJP ops complete
+    their grads per position), so no psum — adding them once per
+    position is the de-duplication.  The total is identical on every
+    mesh position, which is what makes a uniform clip scale safe.
+    """
+    gl = jax.tree.leaves(grads)
+    sl = jax.tree.leaves(specs, is_leaf=lambda x: isinstance(x, P))
+    if len(gl) != len(sl):
+        raise ValueError(
+            f"grads/specs leaf count mismatch: {len(gl)} vs {len(sl)}"
+        )
+    total = jnp.zeros((), jnp.float32)
+    for g, sp in zip(gl, sl):
+        s = jnp.sum(g.astype(jnp.float32) ** 2)
+        for ax in spec_axes(sp):
+            s = lax.psum(s, ax)
+        total = total + s
+    return total
+
+
+def flat_chunk_sumsq(
+    chunk,
+    chunk_start,
+    leaf_sizes: Sequence[int],
+    leaf_dup: Sequence[int],
+):
+    """Sum-of-squares of one flat-layout gradient chunk with duplicate
+    de-weighting — the ZeRO/FSDP-side counterpart of
+    ``model_axes_sumsq``.
+
+    The flat vector concatenates leaves (``leaf_sizes`` elements each,
+    then zero padding); ``leaf_dup[i]`` is how many model-axis positions
+    hold an identical copy of leaf i (1 = sharded/unique).  Elements of
+    duplicated leaves contribute ``x²/dup`` so that the subsequent psum
+    over the model axes counts them exactly once.  ``chunk_start`` may
+    be traced (``axis_index * chunk``).
+    """
+    x2 = chunk.astype(jnp.float32) ** 2
+    pos = chunk_start + jnp.arange(chunk.shape[0])
+    w = jnp.ones_like(x2)
+    off = 0
+    for size, dup in zip(leaf_sizes, leaf_dup):
+        if dup != 1:
+            w = jnp.where(
+                (pos >= off) & (pos < off + size), 1.0 / dup, w
+            )
+        off += size
+    return jnp.sum(x2 * w)
+
+
 def clip_scale(gnorm, clip_norm: float):
     """min(1, clip/norm): the torch clip_grad_norm_ scale factor — ONE
     definition (epsilon included) shared by the replicated, ZeRO, and

@@ -51,8 +51,10 @@ v2 additions over the round-2 v1:
   and generation at scales where a device-side gather would OOM.
 
 v2 scope: scanned TransformerLM configs (``scan_layers=True``, no
-dropout), DP x TP meshes — no CP/EP composition (rejected loudly), no
-grad_clip under TP (per-model-position flat norms would differ).
+dropout), DP x TP meshes — no CP/EP composition (rejected loudly).
+grad_clip composes with TP via a duplicate-de-weighted flat norm (each
+position's flat holds a full copy of the replicated leaves and the rest
+flat; those elements count 1/n_tp before the (data, tp) psum).
 """
 
 from __future__ import annotations
@@ -362,14 +364,48 @@ def fsdp_gather_params(
     meta = _Meta(cfg, mesh.shape[axis_name], tp_axis, n_tp)
     if host:
         if jax.process_count() > 1:
-            # A multi-host host-RAM gather needs a HOST-side exchange
-            # (device_get cannot fetch non-addressable shards, and a
-            # device-side allgather would reintroduce the HBM spike this
-            # path exists to avoid).  Until that exists: checkpoint the
-            # sharded state (training.checkpoint) and reload where needed.
-            raise NotImplementedError(
-                "fsdp_gather_params(host=True) is single-process; "
-                "save a sharded checkpoint instead on multi-host runs"
+            # Multi-host host-RAM gather: host assembly fed by BOUNDED
+            # device resharding.  device_get cannot fetch non-addressable
+            # shards, and replicating the whole flats on device would
+            # reintroduce the HBM spike this path exists to avoid — so
+            # the exchange is chunked: one layer row (resp. one data
+            # chunk of the rest flat) per collective, replicated to every
+            # process and pulled straight to numpy.  Peak HBM = one
+            # layer's full flat — the same granularity the training
+            # step's per-layer all_gather already commits to.
+            # COLLECTIVE: every process must call this together (it
+            # compiles and runs resharding programs), exactly like a
+            # training step.
+            rep = NamedSharding(mesh, P())
+            take_row = jax.jit(
+                lambda a, i: lax.dynamic_index_in_dim(
+                    a, i, 0, keepdims=False
+                ),
+                out_shardings=rep,
+            )
+            lay = np.stack([
+                np.asarray(
+                    take_row(state.params["layers"], i).addressable_data(0)
+                )
+                for i in range(meta.L)
+            ])
+            take_chunk = jax.jit(
+                lambda a, k: lax.dynamic_slice(
+                    a, (k * meta.rest_chunk,), (meta.rest_chunk,)
+                ),
+                out_shardings=rep,
+            )
+            rest = np.concatenate([
+                np.asarray(
+                    take_chunk(state.params["rest"], k).addressable_data(0)
+                )
+                for k in range(meta.n)
+            ])
+            # unflatten_full reads only tp-position 0's rest block, which
+            # is exactly what `rest` holds.
+            return jax.tree.map(
+                np.asarray,
+                meta.unflatten_full({"layers": lay, "rest": rest}),
             )
         full_flat = jax.tree.map(
             lambda x: np.asarray(jax.device_get(x)), state.params
@@ -496,11 +532,6 @@ def make_fsdp_train_step(
         raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
     if (tp_axis is None) != (cfg.tp_axis is None):
         raise ValueError("pass tp_axis to BOTH the config and the factory")
-    if grad_clip is not None and tp_axis is not None:
-        raise ValueError(
-            "grad_clip under FSDP x TP needs a model-axis-aware norm "
-            "(per-position flat norms differ); drop one of the two"
-        )
     from distributeddataparallel_tpu.ops.losses import lm_cross_entropy
 
     n = mesh.shape[data_axis]
@@ -559,14 +590,48 @@ def make_fsdp_train_step(
             lambda g, p: g.astype(p.dtype) / n, gflat, state.params
         )
         if grad_clip is not None:
-            # The flat shards partition the gradient vector: global
-            # norm² is one psum of local sum-of-squares — exact.
             from distributeddataparallel_tpu.parallel.data_parallel import (
                 clip_scale,
                 sumsq_f32,
             )
 
-            gnorm = jnp.sqrt(lax.psum(sumsq_f32(gflat), data_axis))
+            if meta.n_tp == 1:
+                # The flat shards partition the gradient vector: global
+                # norm² is one psum of local sum-of-squares — exact.
+                gnorm = jnp.sqrt(lax.psum(sumsq_f32(gflat), data_axis))
+            else:
+                # FSDP x TP: each model position's flats hold its
+                # Megatron shard for sharded leaves but a FULL copy of
+                # replicated leaves (and of the whole rest flat), so a
+                # plain psum over (data, tp) would count those n_tp
+                # times.  De-weight replicated-leaf elements by 1/n_tp
+                # via the static row layout (leaf offsets in the layer
+                # row are trace-time constants; the zero pad tail is
+                # weight-agnostic), then psum over BOTH axes.
+                k = lax.axis_index(data_axis)
+                pos = k * meta.layer_chunk + jnp.arange(meta.layer_chunk)
+                w = jnp.ones((meta.layer_chunk,), jnp.float32)
+                off = 0
+                for path, leaf in jax.tree_util.tree_flatten_with_path(
+                    meta.layer_template
+                )[0]:
+                    size = int(np.prod(leaf.shape))
+                    # stacked-view ndim (+1 for the leading L) — the
+                    # same rule flatten_full slices with.
+                    if meta._model_dim(
+                        _path_names(path), leaf.ndim + 1
+                    ) is None:
+                        w = jnp.where(
+                            (pos >= off) & (pos < off + size),
+                            1.0 / meta.n_tp, w,
+                        )
+                    off += size
+                s = jnp.sum(
+                    gflat["layers"].astype(jnp.float32) ** 2 * w[None, :]
+                ) + sumsq_f32(gflat["rest"]) / meta.n_tp
+                s = lax.psum(s, data_axis)
+                s = lax.psum(s, tp_axis)
+                gnorm = jnp.sqrt(s)
             gflat = jax.tree.map(
                 lambda g: g * clip_scale(gnorm, grad_clip), gflat
             )

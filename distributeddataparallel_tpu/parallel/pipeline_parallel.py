@@ -377,6 +377,7 @@ def _pp_1f1b_loss_and_grads(
     pp_axis: str,
     n: int,
     microbatches: int,
+    moe_aux_weight: float = 0.0,
 ):
     """1F1B schedule with a MANUAL backward: returns ``(loss, grads)``
     shaped exactly like ``value_and_grad(pp_loss)`` so the surrounding
@@ -405,10 +406,24 @@ def _pp_1f1b_loss_and_grads(
     every accumulation is masked — exactly the trick the GPipe path uses
     for its bubble ticks.
 
-    v1 restrictions (the GPipe path remains for these): no ``cp_axis``
-    and no MoE aux loss (the manual vjp has no mutable-intermediates
-    channel).  TP composes: the stage body's Megatron collectives sit
-    inside ``jax.vjp``, which transposes them exactly as AD does.
+    MoE aux loss (``moe_aux_weight > 0``): the B-tick's ``jax.vjp`` of
+    the stage already recomputes the stage forward, so the router's
+    sown aux value rides along free — the stage function returns
+    ``(y, aux)`` (``mutable=["intermediates"]`` inside the vjp) and the
+    aux output's cotangent is the constant ``moe_aux_weight/(n·M)``,
+    matching GPipe's ``psum(aux_acc)/(n·M)`` term exactly.
+
+    v1 restriction (the GPipe path remains for it): no ``cp_axis``.
+    TP composes: the stage body's Megatron collectives sit inside
+    ``jax.vjp``, which transposes them exactly as AD does.
+
+    Head/embed vjps are gated on the owning stage with ``lax.cond``
+    (ADVICE r3): at Llama-scale vocab the d×V head matmuls rival a
+    stage's layer compute, so running them masked-to-zero on every
+    stage would cost ~n_stages× redundant FLOPs per tick.  The
+    predicate depends only on the pipe index, so model-axis peers
+    always agree — any Megatron collective inside the branch stays
+    matched.
     """
     from distributeddataparallel_tpu.models.transformer import (
         rope_frequencies,
@@ -437,9 +452,22 @@ def _pp_1f1b_loss_and_grads(
         ("pos_embed",) if cfg.positional == "learned" else ()
     )
 
+    use_aux = cfg.moe_experts > 0 and moe_aux_weight > 0.0
+
     def stage_fn(layer_params, x):
         y, _ = stack.apply({"params": layer_params}, x, None, rope, True)
         return y
+
+    def stage_fn_aux(layer_params, x):
+        from distributeddataparallel_tpu.models.transformer import (
+            moe_aux_from_intermediates,
+        )
+
+        (y, _), col = stack.apply(
+            {"params": layer_params}, x, None, rope, True,
+            mutable=["intermediates"],
+        )
+        return y, moe_aux_from_intermediates(col)
 
     def head_loss(hparams, y, tgt):
         return lm_cross_entropy(_head(cfg, hparams, y), tgt)
@@ -476,7 +504,7 @@ def _pp_1f1b_loss_and_grads(
     # every B-tick's recompute ahead of the backwards (which would
     # resurrect the O(M) liveness this schedule exists to kill).
     def tick(carry, i):
-        saved, fbuf, bbuf, gacc, loss_acc = carry
+        saved, fbuf, bbuf, gacc, loss_acc, aux_acc = carry
         # --- F-tick i: stage s runs forward of microbatch i - s -------
         # (0 <= m < M subsumes the tick-range bound: i < T implies the
         # per-stage microbatch index is already past M when off-schedule)
@@ -494,43 +522,91 @@ def _pp_1f1b_loss_and_grads(
         mc = jnp.clip(m, 0, M - 1)
         slot = jnp.where(valid, mc % (2 * n), 2 * n)
         xb = lax.dynamic_index_in_dim(saved, slot, 0, keepdims=False)
-        y, stage_vjp = jax.vjp(stage_fn, params["layers"], xb)
+        if use_aux:
+            (y, aux), stage_vjp = jax.vjp(
+                stage_fn_aux, params["layers"], xb
+            )
+        else:
+            y, stage_vjp = jax.vjp(stage_fn, params["layers"], xb)
+            aux = jnp.zeros((), jnp.float32)
         tgt = lax.dynamic_index_in_dim(mbs_tgt, mc, 0, keepdims=False)
-        lval, head_vjp = jax.vjp(
-            lambda hp, y_: head_loss(hp, y_, tgt),
-            {kk: params[kk] for kk in head_keys}, y,
-        )
-        # Seed 1/M: the step's loss is the microbatch MEAN, so each
-        # microbatch's cotangent carries the mean's scaling.
-        dhp, dy_head = head_vjp(jnp.full((), 1.0 / M, lval.dtype))
         on_last = (s == n - 1)
+        head_params = {kk: params[kk] for kk in head_keys}
+
+        # Gated head vjp (ADVICE r3): only the last stage pays the d×V
+        # matmuls; other stages take the zeros branch.  The predicate is
+        # uniform across non-pipe axes, so branch collectives match.
+        def do_head(y_):
+            lval, head_vjp = jax.vjp(
+                lambda hp, yy: head_loss(hp, yy, tgt), head_params, y_
+            )
+            # Seed 1/M: the step's loss is the microbatch MEAN, so each
+            # microbatch's cotangent carries the mean's scaling.
+            dhp_, dy_ = head_vjp(jnp.full((), 1.0 / M, lval.dtype))
+            return lval, dhp_, dy_
+
+        def skip_head(y_):
+            return jax.tree.map(
+                lambda t: jnp.zeros(t.shape, t.dtype),
+                jax.eval_shape(do_head, y_),
+            )
+
+        lval, dhp, dy_head = lax.cond(on_last, do_head, skip_head, y)
         gy = jnp.where(on_last, dy_head.astype(fbuf.dtype), bbuf)
-        dlayers, dx = stage_vjp(gy)
+        if use_aux:
+            # The aux output's cotangent: GPipe adds
+            # moe_aux_weight * psum(aux_acc) / (n*M) to the loss, so
+            # every valid (stage, microbatch) aux value carries this
+            # constant derivative.  Invalid ticks are masked by w below.
+            dlayers, dx = stage_vjp(
+                (gy, jnp.asarray(moe_aux_weight / (n * M), aux.dtype))
+            )
+        else:
+            dlayers, dx = stage_vjp(gy)
         toksb = lax.dynamic_index_in_dim(mbs_in, mc, 0, keepdims=False)
-        _, embed_vjp = jax.vjp(
-            lambda ep: embed_fn(ep, toksb),
-            {kk: params[kk] for kk in embed_keys},
-        )
-        # Stage 0's outgoing cotangent is the embedding's; a zero
-        # cotangent elsewhere makes the vjp contribute nothing.
-        (dep,) = embed_vjp(jnp.where(s == 0, dx, jnp.zeros_like(dx)))
+
+        # Gated embed vjp: only stage 0's outgoing cotangent feeds it.
+        def do_embed(dx_):
+            _, embed_vjp = jax.vjp(
+                lambda ep: embed_fn(ep, toksb),
+                {kk: params[kk] for kk in embed_keys},
+            )
+            (dep_,) = embed_vjp(dx_)
+            return dep_
+
+        def skip_embed(dx_):
+            return jax.tree.map(
+                lambda t: jnp.zeros(t.shape, t.dtype),
+                jax.eval_shape(do_embed, dx_),
+            )
+
+        dep = lax.cond(s == 0, do_embed, skip_embed, dx)
         w = valid.astype(jnp.float32)
         gacc = _acc(gacc, ("layers",), {"layers": dlayers}, w)
         gacc = _acc(gacc, head_keys, dhp, w * on_last.astype(jnp.float32))
         gacc = _acc(gacc, embed_keys, dep, w)
         loss_acc = loss_acc + jnp.where(valid & on_last, lval, 0.0)
+        aux_acc = aux_acc + jnp.where(valid, aux, 0.0)
         bbuf = lax.ppermute(dx, pp_axis, perm_b)
-        return (saved, fbuf, bbuf, gacc, loss_acc), None
+        return (saved, fbuf, bbuf, gacc, loss_acc, aux_acc), None
 
-    (saved, fbuf, bbuf, gacc, loss_acc), _ = lax.scan(
+    aux_acc = jnp.zeros((), jnp.float32)
+    (saved, fbuf, bbuf, gacc, loss_acc, aux_acc), _ = lax.scan(
         tick,
-        (saved, fbuf, bbuf, gacc, loss_acc),
+        (saved, fbuf, bbuf, gacc, loss_acc, aux_acc),
         jnp.arange(T, dtype=jnp.int32),
     )
 
     # Only the last stage accumulated loss; psum-fwd/identity-bwd is
     # irrelevant here (no AD through this), plain psum replicates it.
-    return lax.psum(loss_acc, pp_axis) / M, gacc
+    loss = lax.psum(loss_acc, pp_axis) / M
+    if use_aux:
+        # Mirror pp_loss: per-stage aux summed over the pipe, averaged
+        # over stages × microbatches.
+        loss = loss + moe_aux_weight * (
+            lax.psum(aux_acc, pp_axis) / (n * M)
+        )
+    return loss, gacc
 
 
 def make_pp_train_step(
@@ -545,6 +621,7 @@ def make_pp_train_step(
     moe_aux_weight: float = 0.01,
     zero: bool = False,
     schedule: str = "gpipe",
+    grad_clip: float | None = None,
 ):
     """Compiled DP x PP train step for a scanned TransformerLM config.
 
@@ -594,18 +671,17 @@ def make_pp_train_step(
         # Same contract as make_train_step: the ZeRO reduce_scatter IS
         # the sync — it cannot be skipped.
         raise ValueError("grad_sync=False does not compose with zero=True")
+    if grad_clip is not None and not grad_sync:
+        # Same contract as make_train_step: unsynced per-replica grads
+        # have per-replica norms — clipping would scale each data-axis
+        # replica differently and params would drift.
+        raise ValueError("grad_clip requires grad_sync=True")
     if schedule not in ("gpipe", "1f1b"):
         raise ValueError(f"unknown pipeline schedule {schedule!r}")
-    if schedule == "1f1b":
-        if cfg.cp_axis is not None:
-            raise ValueError(
-                "1f1b v1 does not compose with cp_axis (use gpipe)"
-            )
-        if cfg.moe_experts > 0 and moe_aux_weight > 0.0:
-            raise ValueError(
-                "1f1b v1 has no mutable-intermediates channel for the MoE "
-                "aux loss (use gpipe, or moe_aux_weight=0)"
-            )
+    if schedule == "1f1b" and cfg.cp_axis is not None:
+        raise ValueError(
+            "1f1b v1 does not compose with cp_axis (use gpipe)"
+        )
     n_stages = mesh.shape[pp_axis]
     M = microbatches
     stack = _stage_stack(cfg, n_stages)
@@ -705,6 +781,7 @@ def make_pp_train_step(
             loss, grads = _pp_1f1b_loss_and_grads(
                 cfg, stack, state.params, inputs, targets,
                 pp_axis=pp_axis, n=n_stages, microbatches=M,
+                moe_aux_weight=moe_aux_weight,
             )
         else:
             loss, grads = jax.value_and_grad(pp_loss)(
@@ -725,11 +802,17 @@ def make_pp_train_step(
                 lambda g: lax.pmean(g, cfg.cp_axis), grads
             )
             loss = lax.pmean(loss, cfg.cp_axis)
+        model_axes = tuple(
+            ax for ax in (pp_axis, cfg.tp_axis, cfg.ep_axis)
+            if ax is not None
+        )
         if zero:
             from distributeddataparallel_tpu.parallel.zero import zero_update
 
             new_params, new_opt = zero_update(
-                grads, state, data_axis, mesh.shape[data_axis]
+                grads, state, data_axis, mesh.shape[data_axis],
+                clip_norm=grad_clip, model_axes=model_axes,
+                local_specs=gspecs if grad_clip is not None else None,
             )
             new_state = state.replace(
                 step=state.step + 1, params=new_params, opt_state=new_opt
@@ -737,6 +820,20 @@ def make_pp_train_step(
         else:
             if grad_sync:
                 grads = all_reduce_gradients(grads, data_axis, op="mean")
+            if grad_clip is not None:
+                # Axis-aware global norm: stage-local layer slices psum
+                # over the pipe (and Megatron/expert) axes, replicated
+                # leaves (complete per position after the psum above)
+                # count once — identical on every position.
+                from distributeddataparallel_tpu.parallel.data_parallel import (
+                    clip_scale,
+                    model_axes_sumsq,
+                )
+
+                scale = clip_scale(
+                    jnp.sqrt(model_axes_sumsq(grads, gspecs)), grad_clip
+                )
+                grads = jax.tree.map(lambda g: g * scale, grads)
             new_state = state.apply_gradients(grads)
         return new_state, {"loss": lax.pmean(loss, data_axis)}
 

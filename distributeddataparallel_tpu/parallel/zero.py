@@ -249,6 +249,8 @@ def zero_update(
     axis_name: str,
     num_shards: int,
     clip_norm: float | None = None,
+    model_axes: tuple = (),
+    local_specs: Pytree | None = None,
 ):
     """The sharded-update step body (runs inside shard_map).
 
@@ -260,7 +262,14 @@ def zero_update(
     ``clip_norm``: clip the (synced) gradient to this global L2 norm —
     EXACT despite the sharded layout: the chunks partition the full
     gradient vector, so the global norm² is one psum of local chunk
-    norm²s.
+    norm²s.  Under model-axis composition, pass ``model_axes`` (the
+    tp/ep/pp mesh axis names) and ``local_specs`` (the per-leaf
+    PartitionSpec tree for the local grads — the same tree the caller's
+    in_specs came from): each position's flat holds its LOCAL tree, so
+    model-sharded leaves appear once across positions while leaves
+    replicated over an axis appear size(axis) times; elements are
+    de-weighted by that duplicate count (``flat_chunk_sumsq``) before
+    psumming over the data axis AND every model axis.
     """
     n = num_shards
     idx = lax.axis_index(axis_name)
@@ -275,10 +284,39 @@ def zero_update(
     if clip_norm is not None:
         from distributeddataparallel_tpu.parallel.data_parallel import (
             clip_scale,
+            flat_chunk_sumsq,
+            spec_axes,
             sumsq_f32,
         )
 
-        gnorm = jnp.sqrt(lax.psum(sumsq_f32(g_shard), axis_name))
+        if model_axes:
+            if local_specs is None:
+                raise ValueError(
+                    "clip under model_axes needs local_specs (the "
+                    "per-leaf PartitionSpec tree of the local grads)"
+                )
+            # Per-leaf duplicate count: product of the model-axis sizes
+            # the leaf is NOT sharded over (its copies across those
+            # positions are identical).  Static at trace time.
+            sizes = [l.size for l in jax.tree.leaves(grads)]
+            dups = [
+                int(np.prod([
+                    lax.axis_size(ax)
+                    for ax in model_axes
+                    if ax not in spec_axes(sp)
+                ] or [1]))
+                for sp in jax.tree.leaves(
+                    local_specs,
+                    is_leaf=lambda x: isinstance(x, P),
+                )
+            ]
+            s = flat_chunk_sumsq(g_shard, idx * chunk, sizes, dups)
+            s = lax.psum(s, axis_name)
+            for ax in model_axes:
+                s = lax.psum(s, ax)
+            gnorm = jnp.sqrt(s)
+        else:
+            gnorm = jnp.sqrt(lax.psum(sumsq_f32(g_shard), axis_name))
         g_shard = g_shard * clip_scale(gnorm, clip_norm)
 
     flat_p = flatten_f32(state.params, padded)

@@ -40,22 +40,41 @@ class Checkpointer:
         pytree: orbax owns the step dir's contents and atomicity)."""
         self._mgr.save(epoch, args=ocp.args.StandardSave(_arrays_only(state)))
         if meta is not None and jax.process_index() == 0:
+            # Multi-host note: only process 0 writes sidecars, so
+            # read_meta on other hosts assumes the checkpoint directory
+            # is a SHARED filesystem (the standard Cloud TPU setup: GCS
+            # or NFS — the same assumption orbax itself makes for the
+            # step dirs).
             tmp = os.path.join(self._dir, f".meta_{epoch}.tmp")
             with open(tmp, "w") as fh:
                 json.dump(meta, fh)
             os.replace(tmp, os.path.join(self._dir, f"meta_{epoch}.json"))
-            # Prune sidecars for steps the manager has garbage-collected
-            # (max_to_keep) so the directory doesn't accumulate orphans.
-            live = set(self._mgr.all_steps()) | {epoch}
-            import glob
+            self._prune_sidecars(keep={epoch})
 
-            for p in glob.glob(os.path.join(self._dir, "meta_*.json")):
+    def _prune_sidecars(self, keep: set | None = None) -> None:
+        """Remove meta sidecars for steps the manager no longer tracks.
+
+        Called after saves AND after ``wait()``/restore — an async save's
+        garbage collection may finish after the save-time prune ran, so
+        orphans are swept again at the points where the manager's step
+        list is settled."""
+        if jax.process_index() != 0:
+            return
+        import glob
+
+        # keep: a step mid-async-save may not appear in all_steps() yet —
+        # never sweep its just-written sidecar.
+        live = set(self._mgr.all_steps()) | (keep or set())
+        for p in glob.glob(os.path.join(self._dir, "meta_*.json")):
+            try:
+                s = int(os.path.basename(p)[5:-5])
+            except ValueError:
+                continue
+            if s not in live:
                 try:
-                    s = int(os.path.basename(p)[5:-5])
-                except ValueError:
-                    continue
-                if s not in live:
                     os.remove(p)
+                except OSError:
+                    pass
 
     def latest_step(self) -> int | None:
         return self._mgr.latest_step()
@@ -93,6 +112,9 @@ class Checkpointer:
 
     def wait(self) -> None:
         self._mgr.wait_until_finished()
+        # Async GC has settled: sweep sidecars it may have orphaned
+        # after the save-time prune ran.
+        self._prune_sidecars()
 
 
 def _arrays_only(state: Pytree) -> Pytree:

@@ -138,9 +138,11 @@ def make_train_step(
     ``grad_clip`` clips the synced gradient to a global L2 norm (the
     ``torch.nn.utils.clip_grad_norm_`` analog, applied after the
     all-reduce exactly as DDP users do).  Under ``zero=True`` the norm
-    is computed psum-exactly over the flat chunks.  Rejected with
-    tp/ep_axis: each position's local-shard norm would differ and scale
-    replicated leaves divergently.
+    is computed psum-exactly over the flat chunks.  Under tp/ep_axis the
+    norm is axis-aware (``model_axes_sumsq`` / duplicate-de-weighted
+    flat chunks): sharded leaves psum over their model axes, replicated
+    leaves count once — every position computes the same global norm, so
+    the scale stays uniform.
 
     ``ep_axis`` adds expert parallelism for MoE configs
     (``parallel.expert_parallel``): expert weight stacks shard over the
@@ -156,14 +158,6 @@ def make_train_step(
     if not grad_sync and (zero or bucket_bytes is not None or overlap):
         raise ValueError("grad_sync=False skips the reduction entirely; "
                          "it does not compose with zero/bucket_bytes/overlap")
-    if grad_clip is not None and (tp_axis is not None or ep_axis is not None):
-        # Local Megatron/expert shards would each compute a DIFFERENT
-        # "global" norm and scale the replicated leaves divergently —
-        # reject rather than silently corrupt training.
-        raise ValueError(
-            "grad_clip under tp_axis/ep_axis needs an axis-aware norm; "
-            "not supported"
-        )
     if grad_clip is not None and not grad_sync:
         # Unsynced per-replica grads have per-replica norms: clipping
         # would scale each replica differently (same divergence as the
@@ -261,9 +255,19 @@ def make_train_step(
             # ZeRO-1: reduce_scatter + sharded update + all_gather.
             from distributeddataparallel_tpu.parallel.zero import zero_update
 
+            maxes = tuple(
+                ax for ax in (tp_axis, ep_axis) if ax is not None
+            )
+            lspecs = None
+            if maxes and grad_clip is not None:
+                from distributeddataparallel_tpu.parallel.expert_parallel import (
+                    model_axes_param_specs,
+                )
+
+                lspecs = model_axes_param_specs(grads, tp_axis, ep_axis)
             new_params, new_opt_state = zero_update(
                 grads, state, axis_name, mesh.shape[axis_name],
-                clip_norm=grad_clip,
+                clip_norm=grad_clip, model_axes=maxes, local_specs=lspecs,
             )
             new_state = state.replace(
                 step=state.step + 1, params=new_params,
@@ -287,14 +291,31 @@ def make_train_step(
                     chain=overlap,
                 )
             if grad_clip is not None:
-                # Grads are complete per position here (post sync / cp
-                # pmean), so the local norm IS the global norm.
                 from distributeddataparallel_tpu.parallel.data_parallel import (
                     clip_scale,
+                    model_axes_sumsq,
                     sumsq_f32,
                 )
 
-                scale = clip_scale(jnp.sqrt(sumsq_f32(grads)), grad_clip)
+                if tp_axis is not None or ep_axis is not None:
+                    # Megatron/expert shards: per-leaf-spec-aware global
+                    # norm — sharded leaves psum over their model axes,
+                    # replicated leaves (complete per position) count
+                    # once.  The result is identical on every position,
+                    # so the scale is uniform.
+                    from distributeddataparallel_tpu.parallel.expert_parallel import (
+                        model_axes_param_specs,
+                    )
+
+                    sumsq = model_axes_sumsq(
+                        grads,
+                        model_axes_param_specs(grads, tp_axis, ep_axis),
+                    )
+                else:
+                    # Grads are complete per position here (post sync /
+                    # cp pmean), so the local norm IS the global norm.
+                    sumsq = sumsq_f32(grads)
+                scale = clip_scale(jnp.sqrt(sumsq), grad_clip)
                 grads = jax.tree.map(lambda g: g * scale, grads)
             new_state = state.apply_gradients(grads)
         if with_model_state:

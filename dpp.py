@@ -92,8 +92,9 @@ def parse_args(argv=None):
                    help="floor the cosine/linear decay at this LR")
     p.add_argument("--grad-clip", type=float, default=None,
                    help="clip the synced gradient to this global L2 norm "
-                        "(torch clip_grad_norm_ analog; psum-exact under "
-                        "--zero/--fsdp; not with --tp/--ep/--pp)")
+                        "(torch clip_grad_norm_ analog; axis-aware exact "
+                        "norm under every composition: --zero/--fsdp flat "
+                        "chunks, --tp/--ep/--pp model-axis shards)")
     p.add_argument("--seed", type=int, default=0)            # ref dpp.py:29
     p.add_argument("--accum-steps", type=int, default=1,
                    help="gradient accumulation (DDP no_sync analog)")
@@ -300,16 +301,10 @@ def validate_args(args) -> None:
             raise SystemExit(
                 f"--layers {args.layers} must be divisible by --pp {args.pp}"
             )
-        if args.pp_schedule == "1f1b":
-            if args.cp > 1:
-                raise SystemExit(
-                    "--pp-schedule 1f1b does not support --cp (use gpipe)"
-                )
-            if args.moe_experts and args.moe_aux_weight > 0:
-                raise SystemExit(
-                    "--pp-schedule 1f1b does not support the MoE aux loss; "
-                    "use gpipe or --moe-aux-weight 0"
-                )
+        if args.pp_schedule == "1f1b" and args.cp > 1:
+            raise SystemExit(
+                "--pp-schedule 1f1b does not support --cp (use gpipe)"
+            )
     if args.fsdp:
         if not is_lm(args):
             raise SystemExit("--fsdp requires an LM model (--model gpt2|llama)")
@@ -325,21 +320,10 @@ def validate_args(args) -> None:
             raise SystemExit(
                 f"--fsdp composes with --tp only; drop {', '.join(bad)}"
             )
-        if args.grad_clip is not None and args.tp > 1:
-            raise SystemExit(
-                "--fsdp --tp does not support --grad-clip (per-position "
-                "flat norms differ)"
-            )
     if args.augment and is_lm(args):
         raise SystemExit("--augment is for image datasets only")
-    if args.grad_clip is not None:
-        if args.grad_clip <= 0:
-            raise SystemExit("--grad-clip must be > 0")
-        if args.tp > 1 or args.ep > 1 or args.pp > 1:
-            raise SystemExit(
-                "--grad-clip needs complete per-position grads "
-                "(no --tp/--ep/--pp): local-shard norms would diverge"
-            )
+    if args.grad_clip is not None and args.grad_clip <= 0:
+        raise SystemExit("--grad-clip must be > 0")
     if args.overlap:
         # ZeRO/FSDP/PP own their reductions (reduce_scatter / per-layer
         # gathers / stage collectives) — the chained-bucket overlap path
@@ -779,7 +763,7 @@ def train(args) -> float:
         step_fn = ddp.make_pp_train_step(
             model.cfg, mesh=mesh, microbatches=M, zero=args.zero,
             moe_aux_weight=args.moe_aux_weight if args.moe_experts else 0.0,
-            schedule=args.pp_schedule,
+            schedule=args.pp_schedule, grad_clip=args.grad_clip,
         )
     else:
         # One factory for the other compositions: DP × {accum, buckets,

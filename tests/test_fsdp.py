@@ -155,11 +155,8 @@ def test_fsdp_guards(devices):
         make_fsdp_train_step(
             dataclasses.replace(_cfg(), tp_axis="model"), mesh=mesh
         )
-    with pytest.raises(ValueError, match="grad_clip under FSDP x TP"):
-        make_fsdp_train_step(
-            dataclasses.replace(_cfg(), tp_axis="model"), mesh=mesh,
-            tp_axis="model", grad_clip=1.0,
-        )
+    # grad_clip under FSDP x TP is SUPPORTED now (duplicate-de-weighted
+    # flat norm) — equivalence pinned by test_grad_clip.test_clip_fsdp_tp.
 
 
 def test_fsdp_accum_matches_single_big_batch(devices):
@@ -378,3 +375,145 @@ def test_entrypoint_fsdp_tp_cli(devices):
     )
     loss = dpp.train(args)
     assert loss == loss
+
+
+# --- multi-host host gather (VERDICT r3 item 3) ------------------------------
+
+
+def _mp_fsdp_gather_worker(process_id: int, world: int, tmpdir: str):
+    """2 OS processes x 2 CPU devices: FSDP train step, then the
+    multi-host host=True gather — must equal the device-side (host=False)
+    gather exactly, on every process."""
+    import json
+    import os
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 2)
+
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    import distributeddataparallel_tpu as ddp
+    from distributeddataparallel_tpu.models import TransformerLM, tiny_lm
+    from distributeddataparallel_tpu.ops import lm_cross_entropy
+    from distributeddataparallel_tpu.parallel.fsdp import (
+        fsdp_gather_params,
+        fsdp_state,
+        make_fsdp_train_step,
+    )
+
+    ddp.init_process_group("cpu")
+    assert jax.process_count() == world
+    mesh = ddp.make_mesh(("data",))
+    cfg = tiny_lm(
+        num_layers=2, num_heads=2, d_model=32, d_ff=64, max_seq_len=32,
+        vocab_size=64, scan_layers=True, dtype=jnp.float32, remat=True,
+    )
+    params = TransformerLM(cfg).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    state = fsdp_state(cfg, params, optax.adam(1e-3), mesh)
+    step = make_fsdp_train_step(cfg, mesh=mesh)
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, 64, size=(4 * mesh.shape["data"] // 4 * 4, 17))
+    from distributeddataparallel_tpu.data.loader import shard_batch
+
+    batch = shard_batch({"tokens": toks.astype(np.int32)}, mesh)
+    state, metrics = step(state, batch, jax.random.PRNGKey(1))
+    jax.block_until_ready(state.params)
+
+    host_tree = fsdp_gather_params(cfg, state, mesh, host=True)
+    dev_tree = fsdp_gather_params(cfg, state, mesh, host=False)
+    mismatch = 0
+    for h, d in zip(jax.tree.leaves(host_tree), jax.tree.leaves(dev_tree)):
+        if not np.array_equal(np.asarray(h), np.asarray(d.addressable_data(0))):
+            mismatch += 1
+    checksum = float(
+        sum(np.sum(np.asarray(l, np.float64)) for l in jax.tree.leaves(host_tree))
+    )
+    with open(os.path.join(tmpdir, f"g{process_id}.json"), "w") as f:
+        json.dump(
+            {"loss": float(metrics["loss"]), "mismatch": mismatch,
+             "checksum": checksum},
+            f,
+        )
+    ddp.destroy_process_group()
+
+
+def test_multihost_fsdp_host_gather(tmp_path, devices):
+    import json
+
+    from distributeddataparallel_tpu.runtime.launcher import spawn
+
+    procs = spawn(
+        _mp_fsdp_gather_worker, args=(2, str(tmp_path)), nprocs=2, join=False
+    )
+    for p in procs:
+        p.join(timeout=300)
+    codes = [p.exitcode for p in procs]
+    for p in procs:
+        if p.is_alive():
+            p.terminate()
+    assert codes == [0, 0], f"child exit codes {codes}"
+    r = [json.load(open(tmp_path / f"g{i}.json")) for i in range(2)]
+    assert r[0]["mismatch"] == 0 and r[1]["mismatch"] == 0
+    assert r[0]["checksum"] == pytest.approx(r[1]["checksum"], rel=1e-12)
+    assert r[0]["loss"] == pytest.approx(r[1]["loss"], abs=1e-6)
+
+
+def _mp_fsdp_generate_worker(process_id: int, tmpdir: str):
+    """The end-to-end bar: dpp.py --fsdp --eval --generate across 2 real
+    processes — exercises the multi-host host gather inside the CLI's
+    full_params() path (generation) and the streaming masked eval."""
+    import os
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 2)
+
+    import sys
+
+    sys.path.insert(0, "/root/repo")
+    import dpp
+
+    args = dpp.parse_args(
+        [
+            "--device", "cpu",
+            "--model", "llama",
+            "--layers", "2",
+            "--d-model", "32",
+            "--seq-len", "16",
+            "--vocab-size", "64",
+            "--fsdp",
+            "--eval",
+            "--generate", "8",
+            "--epochs", "1",
+            "--num-examples", "32",
+            "--batch-size", "4",
+            "--log-every", "1000",
+        ]
+    )
+    loss = dpp.train(args)
+    assert loss == loss
+    with open(os.path.join(tmpdir, f"ok{process_id}"), "w") as f:
+        f.write(str(loss))
+
+
+def test_multihost_fsdp_generate_cli(tmp_path, devices):
+    from distributeddataparallel_tpu.runtime.launcher import spawn
+
+    procs = spawn(
+        _mp_fsdp_generate_worker, args=(str(tmp_path),), nprocs=2, join=False
+    )
+    for p in procs:
+        p.join(timeout=300)
+    codes = [p.exitcode for p in procs]
+    for p in procs:
+        if p.is_alive():
+            p.terminate()
+    assert codes == [0, 0], f"child exit codes {codes}"
+    assert (tmp_path / "ok0").exists() and (tmp_path / "ok1").exists()

@@ -583,17 +583,53 @@ def test_1f1b_activation_memory_flat_in_microbatches(devices):
     assert f16 < g16 / 2, (f16, g16)
 
 
-def test_1f1b_rejects_cp_and_moe_aux(devices):
+def test_1f1b_rejects_cp(devices):
     mesh = ddp.make_mesh(("data", "pipe"), shape=(2, 4))
     with pytest.raises(ValueError, match="cp_axis"):
         make_pp_train_step(
             _scan_cfg(cp_axis="seq"), mesh=mesh, microbatches=4,
             schedule="1f1b",
         )
-    with pytest.raises(ValueError, match="aux"):
-        make_pp_train_step(
-            _scan_cfg(moe_experts=4), mesh=mesh, microbatches=4,
-            schedule="1f1b", moe_aux_weight=0.01,
+
+
+def test_1f1b_moe_aux_matches_gpipe(devices):
+    """The MoE aux loss under 1F1B (aux value + cotangent riding the
+    B-tick's stage recompute) equals GPipe's mutable-intermediates path:
+    same loss, same updated params."""
+    cfg = _scan_cfg(moe_experts=4)
+    mesh = ddp.make_mesh(("data", "pipe"), shape=(2, 4))
+    rng = np.random.default_rng(21)
+    tokens = rng.integers(0, 256, size=(8, 33)).astype(np.int32)
+    params = TransformerLM(cfg).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 32), jnp.int32)
+    )["params"]
+    tx = optax.adam(1e-2)
+
+    def run(schedule):
+        step = make_pp_train_step(
+            cfg, mesh=mesh, microbatches=4, donate=False,
+            schedule=schedule, moe_aux_weight=0.01,
+        )
+        state = ddp.TrainState.create(apply_fn=None, params=params, tx=tx)
+        state = shard_state_pp(state, mesh)
+        state, metrics = step(
+            state, shard_batch({"tokens": tokens}, mesh),
+            jax.random.PRNGKey(0),
+        )
+        return float(metrics["loss"]), state.params
+
+    loss_g, params_g = run("gpipe")
+    loss_1, params_1 = run("1f1b")
+    assert loss_1 == pytest.approx(loss_g, rel=1e-5)
+    # aux actually contributes (switch aux >= 1 at any routing)
+    assert loss_1 > 0.0
+    for (path, a), b in zip(
+        jax.tree_util.tree_flatten_with_path(params_1)[0],
+        jax.tree.leaves(params_g),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=5e-5,
+            err_msg="/".join(str(getattr(k, "key", k)) for k in path),
         )
 
 

@@ -61,7 +61,7 @@ def test_shard_indices_for_hosts():
     np.testing.assert_array_equal(local, [0, 63, 0, 21, 36])
 
 
-def test_loader_batches_match_in_ram_dataset(devices):
+def test_loader_batches_match_in_ram_dataset(devices, tmp_path):
     """Sampler semantics preserved: the streaming dataset yields the
     exact batches the in-RAM dataset does — shuffle, epoch reshuffle,
     pad masking and all."""
@@ -81,7 +81,7 @@ def test_loader_batches_match_in_ram_dataset(devices):
     for epoch in (0, 1):
         for sharded_root_rows in (64,):
             root = write_image_shards(
-                f"/tmp/_ddp_shard_eq_{epoch}", images, labels,
+                str(tmp_path / f"eq_{epoch}"), images, labels,
                 shard_rows=sharded_root_rows,
             )
             a = batches(ShardedImageDataset(root), epoch)
