@@ -10,6 +10,11 @@ from distributeddataparallel_tpu.data.sharded import (  # noqa: F401
     write_image_shards,
     write_synthetic_image_shards,
 )
+from distributeddataparallel_tpu.data.tokens import (  # noqa: F401
+    TokenFileDataset,
+    encode_bytes,
+    write_token_file,
+)
 from distributeddataparallel_tpu.data.loader import (  # noqa: F401
     DataLoader,
     shard_batch,

@@ -1,0 +1,123 @@
+"""Memmapped token-file dataset: the real-token LM data path.
+
+The reference's data layer reads a real on-disk dataset (ref dpp.py:33);
+configs 4-5 apply that capability to language models.  ``SyntheticLM``
+covers plumbing/benchmarks; this module makes ``--pretrained`` GPT-2
+fine-tuning meaningful end to end: a corpus tokenized ONCE into a flat
+``.npy`` stream (the nanoGPT/memmap convention), windowed into
+next-token training rows on the fly.
+
+- **Storage**: one ``.npy`` integer array, either a flat stream ``(N,)``
+  or pre-chunked rows ``(n, seq_len+1)``.  ``np.load(mmap_mode="r")``:
+  reads are OS page-cache-backed file IO, the corpus is never resident.
+- **Windowing**: flat streams yield ``(N-1)//seq_len`` non-overlapping
+  windows; window ``i`` is ``stream[i*S : i*S + S + 1]`` — the +1
+  carries the next-token target for the last position (the same
+  host-side shift contract as ``SyntheticLM``/``shard_lm_batch``).
+- **Sampler semantics**: ``DistributedSampler`` operates on window
+  indices exactly as on any dataset — padding to ``ceil(n/W)×W``,
+  ``rank::W`` striding, epoch reshuffle — and the loader's
+  ``with_mask=True`` masked-eval contract applies unchanged (windows
+  are rows).
+- **Vocab**: an optional ``FILE.json`` sidecar (``{"vocab_size": V}``)
+  pins the vocab; otherwise the model's ``--vocab-size`` governs and
+  out-of-range ids fail fast at the embedding lookup contract below.
+
+``encode_bytes`` gives a dependency-free real-text tokenizer (byte-level,
+vocab 256 — every byte id is a valid GPT-2-range token id) used by the
+fine-tuning fixtures; production corpora bring their own tokenizer and
+just save the ids.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+
+def encode_bytes(text: str) -> np.ndarray:
+    """Byte-level tokenization: UTF-8 bytes as token ids (vocab 256)."""
+    return np.frombuffer(text.encode("utf-8"), dtype=np.uint8).astype(
+        np.int32
+    )
+
+
+def write_token_file(
+    path: str, tokens: np.ndarray, *, vocab_size: int | None = None
+) -> str:
+    """Save a token stream/rows as the dataset's .npy (+ vocab sidecar).
+
+    Smallest lossless integer dtype is chosen automatically (uint16
+    covers GPT-2's 50257-token vocab at half the int32 bytes).
+    """
+    tokens = np.asarray(tokens)
+    if not np.issubdtype(tokens.dtype, np.integer):
+        raise ValueError(f"tokens must be integers, got {tokens.dtype}")
+    if tokens.size and int(tokens.min()) < 0:
+        raise ValueError("negative token ids")
+    hi = int(tokens.max()) if tokens.size else 0
+    dt = np.uint16 if hi < 2**16 else np.int32
+    np.save(path, np.ascontiguousarray(tokens.astype(dt)))
+    if not path.endswith(".npy"):
+        path += ".npy"
+    if vocab_size is not None:
+        with open(path + ".json", "w") as fh:
+            json.dump({"vocab_size": int(vocab_size)}, fh)
+    return path
+
+
+class TokenFileDataset:
+    """Next-token LM windows over a memmapped token file."""
+
+    def __init__(self, path: str, *, seq_len: int):
+        if not os.path.exists(path):
+            raise FileNotFoundError(f"no token file at {path}")
+        arr = np.load(path, mmap_mode="r")
+        if not np.issubdtype(arr.dtype, np.integer):
+            raise ValueError(
+                f"{path}: token files hold integers, got {arr.dtype}"
+            )
+        self.seq_len = seq_len
+        self._arr = arr
+        if arr.ndim == 1:
+            if len(arr) < seq_len + 1:
+                raise ValueError(
+                    f"{path}: stream of {len(arr)} tokens is shorter than "
+                    f"one window (seq_len+1 = {seq_len + 1})"
+                )
+            self._n = (len(arr) - 1) // seq_len
+            self._rows = False
+        elif arr.ndim == 2:
+            if arr.shape[1] != seq_len + 1:
+                raise ValueError(
+                    f"{path}: rows are {arr.shape[1]} wide, need "
+                    f"seq_len+1 = {seq_len + 1}"
+                )
+            self._n = arr.shape[0]
+            self._rows = True
+        else:
+            raise ValueError(f"{path}: rank-{arr.ndim} token array")
+        self.vocab_size = None
+        sidecar = path + ".json"
+        if os.path.exists(sidecar):
+            with open(sidecar) as fh:
+                self.vocab_size = json.load(fh).get("vocab_size")
+
+    def __len__(self) -> int:
+        return self._n
+
+    def gather(self, idx) -> dict:
+        """Batch of windows (loader fast path): {"tokens": (B, S+1) i32}."""
+        idx = np.asarray(idx, dtype=np.int64)
+        if self._rows:
+            return {"tokens": np.asarray(self._arr[idx], np.int32)}
+        S = self.seq_len
+        out = np.empty((len(idx), S + 1), np.int32)
+        for j, i in enumerate(idx):  # window reads: S+1 contiguous tokens
+            out[j] = self._arr[i * S : i * S + S + 1]
+        return {"tokens": out}
+
+    def __getitem__(self, idx):
+        return {"tokens": self.gather([idx])["tokens"][0]}

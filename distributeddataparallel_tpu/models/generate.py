@@ -76,6 +76,18 @@ def _generate_jit(
     cfg = model.cfg
     B, P = prompt.shape
 
+    if cfg.dtype != jnp.float32:
+        # Decode is weight-streaming-bound: every step reads the whole
+        # matrix stack from HBM.  Cast f32 masters to the compute dtype
+        # ONCE here (inside the jit: one fused device pass, amortized
+        # over the whole generation) so the scan streams half the bytes;
+        # compute ran in cfg.dtype regardless.
+        params = jax.tree.map(
+            lambda p: p.astype(cfg.dtype)
+            if p.dtype == jnp.float32 else p,
+            params,
+        )
+
     # Cache allocation: init on a 1-token input (shapes depend only on B
     # and cfg.max_seq_len), params discarded — the caller's are used.
     cache = model.init(

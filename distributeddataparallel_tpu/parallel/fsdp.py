@@ -604,31 +604,31 @@ def make_fsdp_train_step(
                 # Megatron shard for sharded leaves but a FULL copy of
                 # replicated leaves (and of the whole rest flat), so a
                 # plain psum over (data, tp) would count those n_tp
-                # times.  De-weight replicated-leaf elements by 1/n_tp
-                # via the static row layout (leaf offsets in the layer
-                # row are trace-time constants; the zero pad tail is
-                # weight-agnostic), then psum over BOTH axes.
-                k = lax.axis_index(data_axis)
-                pos = k * meta.layer_chunk + jnp.arange(meta.layer_chunk)
-                w = jnp.ones((meta.layer_chunk,), jnp.float32)
-                off = 0
-                for path, leaf in jax.tree_util.tree_flatten_with_path(
+                # times.  flat_chunk_sumsq de-weights duplicated
+                # elements (the ONE implementation of this numerics,
+                # shared with the ZeRO clip); every layer row shares the
+                # same leaf layout, so one vmap covers the stack.  The
+                # zero pad tail is weight-agnostic.
+                from distributeddataparallel_tpu.parallel.data_parallel import (
+                    flat_chunk_sumsq,
+                )
+
+                flat = jax.tree_util.tree_flatten_with_path(
                     meta.layer_template
-                )[0]:
-                    size = int(np.prod(leaf.shape))
+                )[0]
+                sizes = [int(np.prod(leaf.shape)) for _, leaf in flat]
+                dups = [
                     # stacked-view ndim (+1 for the leading L) — the
                     # same rule flatten_full slices with.
-                    if meta._model_dim(
+                    1 if meta._model_dim(
                         _path_names(path), leaf.ndim + 1
-                    ) is None:
-                        w = jnp.where(
-                            (pos >= off) & (pos < off + size),
-                            1.0 / meta.n_tp, w,
-                        )
-                    off += size
-                s = jnp.sum(
-                    gflat["layers"].astype(jnp.float32) ** 2 * w[None, :]
-                ) + sumsq_f32(gflat["rest"]) / meta.n_tp
+                    ) is not None else meta.n_tp
+                    for path, leaf in flat
+                ]
+                start = lax.axis_index(data_axis) * meta.layer_chunk
+                s = jnp.sum(jax.vmap(
+                    lambda row: flat_chunk_sumsq(row, start, sizes, dups)
+                )(gflat["layers"])) + sumsq_f32(gflat["rest"]) / meta.n_tp
                 s = lax.psum(s, data_axis)
                 s = lax.psum(s, tp_axis)
                 gnorm = jnp.sqrt(s)

@@ -27,16 +27,16 @@ import sys
 
 def _dataset_arg(v: str) -> str:
     """Parse-time --dataset validation (argparse choices can't express the
-    shards:DIR form): typos fail at parse for CLI and programmatic
-    train(parse_args([...])) callers alike, instead of falling through to
-    the CIFAR-10 default in build_dataset."""
+    shards:DIR / tokens:FILE forms): typos fail at parse for CLI and
+    programmatic train(parse_args([...])) callers alike, instead of
+    falling through to the CIFAR-10 default in build_dataset."""
     if v in ("synthetic", "cifar10", "synthetic-lm") or v.startswith(
-        "shards:"
+        ("shards:", "tokens:")
     ):
         return v
     raise argparse.ArgumentTypeError(
         f"{v!r} is not one of synthetic | cifar10 | synthetic-lm | "
-        "shards:DIR"
+        "shards:DIR | tokens:FILE"
     )
 
 
@@ -51,9 +51,11 @@ def parse_args(argv=None):
                    help="model family (resnet18 matches the reference)")
     p.add_argument("--dataset", default=None, type=_dataset_arg,
                    help="one of synthetic | cifar10 | synthetic-lm | "
-                        "shards:DIR (streaming memmapped shard directory, "
-                        "ImageNet-scale path; DIR or DIR/{train,val}); "
-                        "default: synthetic-lm for --model gpt2/llama, "
+                        "shards:DIR (streaming memmapped image shards, "
+                        "ImageNet-scale path; DIR or DIR/{train,val}) | "
+                        "tokens:FILE (memmapped real-token LM corpus, "
+                        ".npy stream or rows; eval reads the sibling val "
+                        "split); default: synthetic-lm for gpt2/llama, "
                         "synthetic otherwise")
     p.add_argument("--seq-len", type=int, default=128,
                    help="LM sequence length")
@@ -267,14 +269,17 @@ def is_lm(args) -> bool:
 
 
 def validate_args(args) -> None:
-    if is_lm(args) and args.dataset != "synthetic-lm":
+    lm_ds = args.dataset == "synthetic-lm" or str(args.dataset).startswith(
+        "tokens:"
+    )
+    if is_lm(args) and not lm_ds:
         raise SystemExit(
             f"--model {args.model} is a language model; it trains on "
-            f"--dataset synthetic-lm (got {args.dataset!r})"
+            f"--dataset synthetic-lm or tokens:FILE (got {args.dataset!r})"
         )
-    if not is_lm(args) and args.dataset == "synthetic-lm":
+    if not is_lm(args) and lm_ds:
         raise SystemExit(
-            f"--dataset synthetic-lm requires an LM model "
+            f"--dataset {args.dataset} requires an LM model "
             f"(--model gpt2|llama), got --model {args.model}"
         )
     if args.cp > 1:
@@ -445,6 +450,24 @@ def build_model(args, num_classes: int = 10, vocab_size: int | None = None):
 def build_dataset(args, train=True):
     from distributeddataparallel_tpu import data
 
+    if str(args.dataset).startswith("tokens:"):
+        # Memmapped real-token corpus (data.tokens).  FILE trains; eval
+        # reads FILE's sibling val split: DIR/val.npy when FILE is
+        # DIR/train.npy, else STEM.val.npy next to STEM.npy.
+        path = args.dataset.split(":", 1)[1]
+        if not train:
+            base = os.path.basename(path)
+            if base in ("train.npy", "train"):
+                path = os.path.join(os.path.dirname(path), "val.npy")
+            else:
+                path = (path[:-4] if path.endswith(".npy") else path) \
+                    + ".val.npy"
+            if not os.path.exists(path):
+                raise SystemExit(
+                    f"--eval with --dataset tokens: needs a val split at "
+                    f"{path}"
+                )
+        return data.TokenFileDataset(path, seq_len=args.seq_len)
     if is_lm(args) or args.dataset == "synthetic-lm":
         return data.SyntheticLM(
             num_examples=args.num_examples, seq_len=args.seq_len,

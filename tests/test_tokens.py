@@ -1,0 +1,173 @@
+"""Real-token LM data path (data/tokens.py; VERDICT r3 item 6).
+
+Contracts under test: flat-stream windowing (the +1 next-token overlap),
+pre-chunked rows, memmapped access, DistributedSampler semantics through
+the DataLoader (identical batches to an in-RAM dataset of the same
+windows), the masked-eval mask riding along, and the end-to-end bar —
+dpp.py fine-tuning ``--pretrained`` GPT-2 weights on a real-text corpus
+via ``--dataset tokens:FILE``.
+"""
+
+import numpy as np
+import pytest
+
+from distributeddataparallel_tpu.data import (
+    DataLoader,
+    TokenFileDataset,
+    encode_bytes,
+    write_token_file,
+)
+
+CORPUS = (
+    "It is a truth universally acknowledged, that a single model in "
+    "possession of a good optimizer, must be in want of data. "
+    "We hold these truths to be self-evident, that all gradients are "
+    "created equal, that they are endowed by their loss with certain "
+    "unalienable parameters, that among these are weights, biases and "
+    "the pursuit of convergence. "
+) * 12
+
+
+def test_flat_stream_windowing(tmp_path):
+    toks = np.arange(101, dtype=np.int32)
+    path = write_token_file(str(tmp_path / "t.npy"), toks)
+    ds = TokenFileDataset(path, seq_len=10)
+    assert len(ds) == 10  # (101-1)//10
+    row = ds[3]["tokens"]
+    np.testing.assert_array_equal(row, np.arange(30, 41))
+    batch = ds.gather([0, 9])
+    np.testing.assert_array_equal(batch["tokens"][0], np.arange(0, 11))
+    np.testing.assert_array_equal(batch["tokens"][1], np.arange(90, 101))
+    assert batch["tokens"].dtype == np.int32
+
+
+def test_prechunked_rows_and_sidecar(tmp_path):
+    rows = np.arange(60, dtype=np.int64).reshape(6, 10)
+    path = write_token_file(
+        str(tmp_path / "rows.npy"), rows, vocab_size=60
+    )
+    ds = TokenFileDataset(path, seq_len=9)
+    assert len(ds) == 6 and ds.vocab_size == 60
+    np.testing.assert_array_equal(ds.gather([5])["tokens"][0], rows[5])
+    with pytest.raises(ValueError, match="rows are 10 wide"):
+        TokenFileDataset(path, seq_len=20)
+
+
+def test_validation(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        TokenFileDataset(str(tmp_path / "nope.npy"), seq_len=4)
+    p = str(tmp_path / "f.npy")
+    np.save(p, np.zeros((8,), np.float32))
+    with pytest.raises(ValueError, match="integers"):
+        TokenFileDataset(p, seq_len=4)
+    with pytest.raises(ValueError, match="shorter than one window"):
+        toks = np.arange(5, dtype=np.int32)
+        TokenFileDataset(
+            write_token_file(str(tmp_path / "s.npy"), toks), seq_len=10
+        )
+    with pytest.raises(ValueError, match="negative"):
+        write_token_file(str(tmp_path / "n.npy"), np.asarray([-1, 2]))
+
+
+def test_loader_matches_in_ram_windows(devices, tmp_path):
+    """Sampler semantics: the memmapped dataset yields the exact batches
+    an in-RAM dataset of the same windows does — shuffle, epoch
+    reshuffle, pad mask included."""
+    import distributeddataparallel_tpu as ddp
+
+    toks = encode_bytes(CORPUS)
+    S = 16
+    path = write_token_file(str(tmp_path / "c.npy"), toks)
+    ds = TokenFileDataset(path, seq_len=S)
+    n = len(ds)
+    assert n > 40
+
+    class InRam:
+        def __init__(self):
+            self.rows = np.stack(
+                [toks[i * S : i * S + S + 1] for i in range(n)]
+            )
+
+        def __len__(self):
+            return n
+
+        def arrays(self):
+            return {"tokens": self.rows}
+
+    mesh = ddp.make_mesh(("data",))
+    for epoch in (0, 1):
+        outs = []
+        for dataset in (ds, InRam()):
+            loader = DataLoader(
+                dataset, per_replica_batch=2, mesh=mesh, seed=7,
+                drop_last=False, with_mask=True, device_feed=False,
+            )
+            loader.set_epoch(epoch)
+            outs.append(list(loader))
+        assert len(outs[0]) == len(outs[1]) > 0
+        for a, b in zip(*outs):
+            np.testing.assert_array_equal(a["tokens"], b["tokens"])
+            np.testing.assert_array_equal(a["valid"], b["valid"])
+
+
+def test_memmapped_not_loaded(tmp_path):
+    toks = np.arange(100_000, dtype=np.int32)
+    path = write_token_file(str(tmp_path / "m.npy"), toks)
+    ds = TokenFileDataset(path, seq_len=64)
+    assert isinstance(ds._arr, np.memmap)
+
+
+def test_cli_finetunes_pretrained_gpt2_on_real_corpus(devices, tmp_path):
+    """The end-to-end bar: --pretrained GPT-2-family weights fine-tuned
+    on a real-text byte-level corpus via --dataset tokens:FILE, with
+    masked eval on the val split.  Loss must improve over training."""
+    import sys
+
+    sys.path.insert(0, "/root/repo")
+    import jax
+    import jax.numpy as jnp
+
+    import dpp
+    from distributeddataparallel_tpu.models import TransformerLM
+    from distributeddataparallel_tpu.models.io import save_params
+    from distributeddataparallel_tpu.models.transformer import gpt2_124m
+
+    S, V = 32, 256
+    # "Pretrained" checkpoint: a tiny GPT-2-family model saved in the
+    # framework's safetensors interchange (the --pretrained flow;
+    # HF-format conversion parity is pinned in test_io).
+    # geometry matches the CLI's --d-model 32 derivation (heads =
+    # d_model//16, d_ff = 4*d_model)
+    cfg = gpt2_124m(
+        num_layers=2, d_model=32, d_ff=128, num_heads=2,
+        vocab_size=V, max_seq_len=S, dtype=jnp.float32,
+    )
+    params = TransformerLM(cfg).init(
+        jax.random.PRNGKey(7), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    ckpt = str(tmp_path / "w.safetensors")
+    save_params(params, ckpt)
+
+    toks = encode_bytes(CORPUS)
+    cut = int(len(toks) * 0.85)
+    train_path = write_token_file(
+        str(tmp_path / "corpus.npy"), toks[:cut], vocab_size=V
+    )
+    write_token_file(str(tmp_path / "corpus.val.npy"), toks[cut:])
+
+    args = dpp.parse_args(
+        [
+            "--device", "cpu",
+            "--model", "gpt2",
+            "--layers", "2", "--d-model", "32",
+            "--seq-len", str(S), "--vocab-size", str(V),
+            "--dataset", f"tokens:{train_path}",
+            "--pretrained", ckpt,
+            "--epochs", "4", "--batch-size", "2", "--lr", "0.01",
+            "--optimizer", "adamw",
+            "--log-every", "1000", "--eval",
+        ]
+    )
+    final_loss = dpp.train(args)
+    # byte-level chance is ln(256) ~ 5.55; real text must beat it.
+    assert final_loss < 5.0, final_loss
