@@ -20,8 +20,11 @@ next-token training rows on the fly.
   ``with_mask=True`` masked-eval contract applies unchanged (windows
   are rows).
 - **Vocab**: an optional ``FILE.json`` sidecar (``{"vocab_size": V}``)
-  pins the vocab; otherwise the model's ``--vocab-size`` governs and
-  out-of-range ids fail fast at the embedding lookup contract below.
+  pins the vocab (the CLI sizes the model from it and rejects a
+  too-small ``--vocab-size``); with a sidecar present every gathered
+  batch is range-checked — without one, note that XLA embedding
+  lookups CLAMP out-of-range ids silently, so bring the sidecar for
+  untrusted corpora.
 
 ``encode_bytes`` gives a dependency-free real-text tokenizer (byte-level,
 vocab 256 — every byte id is a valid GPT-2-range token id) used by the
@@ -112,11 +115,21 @@ class TokenFileDataset:
         """Batch of windows (loader fast path): {"tokens": (B, S+1) i32}."""
         idx = np.asarray(idx, dtype=np.int64)
         if self._rows:
-            return {"tokens": np.asarray(self._arr[idx], np.int32)}
-        S = self.seq_len
-        out = np.empty((len(idx), S + 1), np.int32)
-        for j, i in enumerate(idx):  # window reads: S+1 contiguous tokens
-            out[j] = self._arr[i * S : i * S + S + 1]
+            out = np.asarray(self._arr[idx], np.int32)
+        else:
+            S = self.seq_len
+            out = np.empty((len(idx), S + 1), np.int32)
+            for j, i in enumerate(idx):  # S+1 contiguous tokens per window
+                out[j] = self._arr[i * S : i * S + S + 1]
+        if self.vocab_size is not None and out.size:
+            hi = int(out.max())
+            if hi >= self.vocab_size:
+                # Without this, the embedding lookup would CLAMP the id
+                # silently and train on corrupted inputs.
+                raise ValueError(
+                    f"token id {hi} >= sidecar vocab_size "
+                    f"{self.vocab_size} — corpus/sidecar mismatch"
+                )
         return {"tokens": out}
 
     def __getitem__(self, idx):
