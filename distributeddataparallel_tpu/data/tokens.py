@@ -20,11 +20,11 @@ next-token training rows on the fly.
   ``with_mask=True`` masked-eval contract applies unchanged (windows
   are rows).
 - **Vocab**: an optional ``FILE.json`` sidecar (``{"vocab_size": V}``)
-  pins the vocab (the CLI sizes the model from it and rejects a
-  too-small ``--vocab-size``); with a sidecar present every gathered
-  batch is range-checked — without one, note that XLA embedding
-  lookups CLAMP out-of-range ids silently, so bring the sidecar for
-  untrusted corpora.
+  pins the vocab — the CLI sizes the model from it (the sidecar
+  OVERRIDES ``--vocab-size``), and every gathered batch is
+  range-checked against it (negative ids included).  Without a
+  sidecar, note that XLA embedding lookups CLAMP out-of-range ids
+  silently, so bring the sidecar for untrusted corpora.
 
 ``encode_bytes`` gives a dependency-free real-text tokenizer (byte-level,
 vocab 256 — every byte id is a valid GPT-2-range token id) used by the
@@ -122,13 +122,15 @@ class TokenFileDataset:
             for j, i in enumerate(idx):  # S+1 contiguous tokens per window
                 out[j] = self._arr[i * S : i * S + S + 1]
         if self.vocab_size is not None and out.size:
-            hi = int(out.max())
-            if hi >= self.vocab_size:
+            hi, lo = int(out.max()), int(out.min())
+            if hi >= self.vocab_size or lo < 0:
                 # Without this, the embedding lookup would CLAMP the id
-                # silently and train on corrupted inputs.
+                # silently (over-range AND negative) and train on
+                # corrupted inputs.
                 raise ValueError(
-                    f"token id {hi} >= sidecar vocab_size "
-                    f"{self.vocab_size} — corpus/sidecar mismatch"
+                    f"token ids [{lo}, {hi}] out of range for sidecar "
+                    f"vocab_size {self.vocab_size} — corpus/sidecar "
+                    "mismatch"
                 )
         return {"tokens": out}
 

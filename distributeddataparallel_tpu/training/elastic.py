@@ -21,9 +21,14 @@ Replicated layouts (plain DP, and the TP/EP/PP param layouts whose
 GLOBAL shapes are N-independent) reshard for free — orbax re-slices to
 whatever sharding the restore template carries.
 
-v1 scope: ``zero1`` and ``fsdp`` reshard at pure data parallelism
-(no tp/ep/pp axes — their local-shard flats segment the content
-model-major and need a segment-aware reshard).
+Scope: ``zero1`` reshards at pure data parallelism (its model-axis
+flats segment per position and keep the loud rejection); ``fsdp``
+reshards across BOTH the data degree and the Megatron TP degree —
+the segmented flats round-trip host-side through the full param tree
+(``_Meta.unflatten_full`` at the old geometry, ``flatten_full`` at the
+new), which re-slices every Megatron dim and re-tiles the replicated
+rest block.  The same linear positional mapping is applied to the Adam
+moment flats, so optimizer state survives a TP reshape exactly.
 """
 
 from __future__ import annotations
@@ -37,9 +42,18 @@ from jax.sharding import Mesh, NamedSharding
 Pytree = Any
 
 
-def topology_meta(mesh: Mesh, layout: str, data_axis: str = "data") -> dict:
+def topology_meta(
+    mesh: Mesh,
+    layout: str,
+    data_axis: str = "data",
+    tp_axis: str | None = None,
+) -> dict:
     """The sidecar dict ``Checkpointer.save(meta=...)`` records."""
-    return {"layout": layout, "n_data": int(mesh.shape[data_axis])}
+    meta = {"layout": layout, "n_data": int(mesh.shape[data_axis])}
+    if tp_axis is not None:
+        meta["n_tp"] = int(mesh.shape[tp_axis])
+        meta["tp_axis"] = tp_axis
+    return meta
 
 
 def _repad(arr: np.ndarray, true: int, padded_new: int) -> np.ndarray:
@@ -57,6 +71,7 @@ def elastic_restore(
     layout: str = "replicated",
     cfg=None,
     data_axis: str = "data",
+    tp_axis: str | None = None,
     allow_reshard: bool = True,
 ) -> tuple[Pytree, int]:
     """Restore the latest checkpoint into ``state`` (built for THIS
@@ -83,7 +98,9 @@ def elastic_restore(
         )
     n_new = int(mesh.shape[data_axis])
     n_old = (meta or {}).get("n_data", n_new)
-    if n_old == n_new or layout == "replicated":
+    n_tp_new = int(mesh.shape[tp_axis]) if tp_axis is not None else 1
+    n_tp_old = int((meta or {}).get("n_tp", 1))
+    if (n_old == n_new and n_tp_old == n_tp_new) or layout == "replicated":
         # Same chunking (or N-independent global shapes): exact-topology
         # restore regardless of layout — orbax re-slices to the
         # template's shardings on its own.
@@ -115,37 +132,105 @@ def elastic_restore(
     elif layout == "fsdp":
         if cfg is None:
             raise ValueError("layout='fsdp' needs cfg for the flat templates")
+        import dataclasses
+
         from distributeddataparallel_tpu.parallel.fsdp import _Meta
 
-        m_new = _Meta(cfg, n_new)
-        m_old = _Meta(cfg, n_old)
+        old_axis = (meta or {}).get("tp_axis") if n_tp_old > 1 else None
+        cfg_old = dataclasses.replace(cfg, tp_axis=old_axis)
+        cfg_new = dataclasses.replace(
+            cfg, tp_axis=tp_axis if n_tp_new > 1 else None
+        )
+        m_new = _Meta(
+            cfg_new, n_new, cfg_new.tp_axis, n_tp_new
+        )
+        m_old = _Meta(
+            cfg_old, n_old, cfg_old.tp_axis, n_tp_old
+        )
+        w_new = m_new.layer_chunk * n_new * m_new.n_tp
+        w_old = m_old.layer_chunk * n_old * m_old.n_tp
+        r_new = m_new.rest_chunk * n_new * m_new.n_tp
+        r_old = m_old.rest_chunk * n_old * m_old.n_tp
         true_layer = sum(
             l.size for l in jax.tree.leaves(m_new.layer_template)
         )
         true_rest = sum(l.size for l in jax.tree.leaves(m_new.rest_template))
 
         def old_shape(leaf):
-            if leaf.ndim == 2 and leaf.shape[-1] == m_new.layer_chunk * n_new:
-                return (leaf.shape[0], m_old.layer_chunk * n_old)
-            if leaf.ndim == 1 and leaf.size == m_new.rest_chunk * n_new:
-                return (m_old.rest_chunk * n_old,)
+            if leaf.ndim == 2 and leaf.shape[-1] == w_new:
+                return (leaf.shape[0], w_old)
+            if leaf.ndim == 1 and leaf.size == r_new:
+                return (r_old,)
             return leaf.shape
 
-        def rebuild(old_arr, leaf):
-            if old_arr.shape == leaf.shape:
-                return old_arr
-            true = true_layer if old_arr.ndim == 2 else true_rest
-            return _repad(old_arr, true, leaf.shape[-1])
+        if m_old.n_tp == 1 and m_new.n_tp == 1:
+            # Pure data-degree change: the flats are content||pad, so a
+            # truncate/re-pad suffices (no host round-trip through the
+            # full tree).
+            def rebuild(old_arr, leaf):
+                if old_arr.shape == leaf.shape:
+                    return old_arr
+                true = true_layer if old_arr.ndim == 2 else true_rest
+                return _repad(old_arr, true, leaf.shape[-1])
+
+        else:
+            # TP geometry change (and/or data change under TP): the
+            # flats segment model-major per position, so positions are
+            # NOT content||pad.  Handled tree-level below (rebuild=None
+            # sentinel): round-trip host-side through the full param
+            # tree — unflatten at the old geometry (re-concatenates
+            # Megatron shards, takes one replicated copy), re-flatten at
+            # the new (re-slices and re-tiles).  The mapping is linear
+            # and positional, so applying it to the Adam moment flats
+            # transports optimizer state exactly.
+            rebuild = None
 
     else:
         raise ValueError(f"unknown elastic layout {layout!r}")
 
-    # Restore at the OLD shapes into host numpy, then truncate/re-pad and
+    # Restore at the OLD shapes into host numpy, then reshard and
     # re-place every leaf under the new mesh's shardings.
     template = jax.tree.map(
         lambda l: np.zeros(old_shape(l), l.dtype), state
     )
     restored, next_epoch = ckpt.restore_latest(state, template=template)
+
+    if rebuild is None:
+        # FSDP x TP pair path: transform every {"layers", "rest"} flat
+        # pair (params, and each Adam moment tree) through the full-tree
+        # round trip; scalars and equal-shape leaves pass through.
+        def is_pair(x):
+            return isinstance(x, dict) and set(x.keys()) == {
+                "layers", "rest",
+            }
+
+        def fix(x):
+            if not is_pair(x):
+                return x
+            pair = {k: np.asarray(v, np.float32) for k, v in x.items()}
+            if pair["layers"].shape[-1] == w_new:
+                return pair  # already new geometry (shouldn't happen)
+            try:
+                full = m_old.unflatten_full(pair)
+            except ValueError as exc:
+                # Most likely cause: the checkpoint's MODEL differs from
+                # cfg (e.g. dpp.py derives llama GQA kv-head counts from
+                # --tp, so changing --tp changes the architecture).
+                raise ValueError(
+                    "FSDP TP-reshard could not unflatten the checkpoint "
+                    "at its recorded geometry — the model architecture "
+                    "probably differs between the save and this run "
+                    "(same cfg required; note dpp.py derives llama "
+                    "kv-head counts from --tp at small --d-model)"
+                ) from exc
+            return m_new.flatten_full(full)
+
+        restored = jax.tree_util.tree_map(
+            fix, restored, is_leaf=is_pair
+        )
+
+        def rebuild(old_arr, leaf):  # noqa: F811 - pair path passthrough
+            return old_arr
 
     def _place(old, leaf):
         val = rebuild(np.asarray(old), leaf)

@@ -838,18 +838,21 @@ def train(args) -> float:
         )
 
         ckpt = Checkpointer(args.checkpoint_dir)
+        fsdp_tp = "model" if (args.fsdp and args.tp > 1) else None
         ckpt_meta = topology_meta(
             mesh,
             "fsdp" if args.fsdp
             else "zero1" if args.zero
             else "replicated",
+            tp_axis=fsdp_tp,
         )
         if args.resume:
             # Elastic resume: the flat ZeRO/FSDP layouts reshard when the
-            # checkpoint was written at a different device count.  The
-            # layout string is the SAME value the save sidecar records;
-            # model-axis runs (segmented flats) restore exact-topology
-            # and reject a changed device count loudly.
+            # checkpoint was written at a different topology.  FSDP
+            # reshards across BOTH the data degree and the Megatron TP
+            # degree (full-tree host round-trip); ZeRO-1 reshards at
+            # pure DP; other model-axis flats restore exact-topology and
+            # reject a change loudly.
             pure_dp = (
                 args.tp == 1 and args.ep == 1 and args.pp == 1
                 and args.cp == 1
@@ -858,7 +861,8 @@ def train(args) -> float:
                 ckpt, state, mesh,
                 layout=ckpt_meta["layout"],
                 cfg=model.cfg if args.fsdp else None,
-                allow_reshard=pure_dp,
+                tp_axis=fsdp_tp,
+                allow_reshard=pure_dp or args.fsdp,
             )
         # Preemption handling (TPU-VM maintenance events deliver SIGTERM):
         # finish the in-flight step, checkpoint, exit cleanly.  Epoch
