@@ -413,9 +413,10 @@ def _pp_1f1b_loss_and_grads(
     aux output's cotangent is the constant ``moe_aux_weight/(n·M)``,
     matching GPipe's ``psum(aux_acc)/(n·M)`` term exactly.
 
-    v1 restriction (the GPipe path remains for it): no ``cp_axis``.
-    TP composes: the stage body's Megatron collectives sit inside
-    ``jax.vjp``, which transposes them exactly as AD does.
+    TP and CP compose: the stage body's Megatron psums and the ring's
+    ppermutes sit inside ``jax.vjp``, which transposes them exactly as
+    AD does; the outer step completes the sequence-sharded gradient
+    with its cp pmean, schedule-agnostic.
 
     Head/embed vjps are gated on the owning stage with ``lax.cond``
     (ADVICE r3): at Llama-scale vocab the d×V head matmuls rival a
@@ -434,7 +435,22 @@ def _pp_1f1b_loss_and_grads(
     s = lax.axis_index(pp_axis)
     mb_rows = inputs.shape[0] // M
     S = inputs.shape[1]
-    _check_seq_bound(cfg, S)
+    positions = None
+    n_cp = 1
+    if cfg.cp_axis is not None:
+        # CP composition: inputs arrive sequence-sharded (host-side
+        # shift, see shard_lm_batch); the stage blocks run ring
+        # attention with global positions.  The ring's ppermutes sit
+        # inside jax.vjp, which transposes them exactly as AD does (the
+        # same argument as TP) — and the outer _step completes the
+        # seq-sharded gradient with its cp pmean, schedule-agnostic.
+        from distributeddataparallel_tpu.parallel.context_parallel import (
+            cp_positions,
+        )
+
+        n_cp = int(lax.psum(1, cfg.cp_axis))
+        positions = cp_positions(S, cfg.cp_axis)
+    _check_seq_bound(cfg, S, n_cp)
     mbs_in = inputs.reshape(M, mb_rows, S)
     mbs_tgt = targets.reshape(M, mb_rows, S)
     rope = (
@@ -455,7 +471,9 @@ def _pp_1f1b_loss_and_grads(
     use_aux = cfg.moe_experts > 0 and moe_aux_weight > 0.0
 
     def stage_fn(layer_params, x):
-        y, _ = stack.apply({"params": layer_params}, x, None, rope, True)
+        y, _ = stack.apply(
+            {"params": layer_params}, x, positions, rope, True
+        )
         return y
 
     def stage_fn_aux(layer_params, x):
@@ -464,7 +482,7 @@ def _pp_1f1b_loss_and_grads(
         )
 
         (y, _), col = stack.apply(
-            {"params": layer_params}, x, None, rope, True,
+            {"params": layer_params}, x, positions, rope, True,
             mutable=["intermediates"],
         )
         return y, moe_aux_from_intermediates(col)
@@ -473,7 +491,7 @@ def _pp_1f1b_loss_and_grads(
         return lm_cross_entropy(_head(cfg, hparams, y), tgt)
 
     def embed_fn(eparams, toks):
-        return _embed(cfg, eparams, toks)
+        return _embed(cfg, eparams, toks, positions)
 
     n_slots = 2 * n + 1          # in-flight <= 2(n-1); last slot = scratch
     saved = jnp.zeros((n_slots, mb_rows, S, cfg.d_model), cfg.dtype)
@@ -678,10 +696,6 @@ def make_pp_train_step(
         raise ValueError("grad_clip requires grad_sync=True")
     if schedule not in ("gpipe", "1f1b"):
         raise ValueError(f"unknown pipeline schedule {schedule!r}")
-    if schedule == "1f1b" and cfg.cp_axis is not None:
-        raise ValueError(
-            "1f1b v1 does not compose with cp_axis (use gpipe)"
-        )
     n_stages = mesh.shape[pp_axis]
     M = microbatches
     stack = _stage_stack(cfg, n_stages)

@@ -306,10 +306,6 @@ def validate_args(args) -> None:
             raise SystemExit(
                 f"--layers {args.layers} must be divisible by --pp {args.pp}"
             )
-        if args.pp_schedule == "1f1b" and args.cp > 1:
-            raise SystemExit(
-                "--pp-schedule 1f1b does not support --cp (use gpipe)"
-            )
     if args.fsdp:
         if not is_lm(args):
             raise SystemExit("--fsdp requires an LM model (--model gpt2|llama)")
