@@ -21,14 +21,14 @@ Replicated layouts (plain DP, and the TP/EP/PP param layouts whose
 GLOBAL shapes are N-independent) reshard for free — orbax re-slices to
 whatever sharding the restore template carries.
 
-Scope: ``zero1`` reshards at pure data parallelism (its model-axis
-flats segment per position and keep the loud rejection); ``fsdp``
-reshards across BOTH the data degree and the Megatron TP degree —
-the segmented flats round-trip host-side through the full param tree
-(``_Meta.unflatten_full`` at the old geometry, ``flatten_full`` at the
-new), which re-slices every Megatron dim and re-tiles the replicated
-rest block.  The same linear positional mapping is applied to the Adam
-moment flats, so optimizer state survives a TP reshape exactly.
+Scope: ``zero1`` and ``fsdp`` both reshard across the data degree AND
+the Megatron TP degree.  The segmented flats round-trip host-side
+through full leaves — FSDP via ``_Meta.unflatten_full`` at the old
+geometry / ``flatten_full`` at the new; ZeRO-1 by reassembling each tp
+position's (data, tp)-interleaved local flat, concatenating Megatron
+dims back to full leaves, and re-slicing/re-interleaving.  The mapping
+is linear and positional, so the same transform transports the Adam
+moment flats exactly.  ZeRO-1 x EP/PP flats keep the loud rejection.
 """
 
 from __future__ import annotations
@@ -49,9 +49,16 @@ def topology_meta(
     tp_axis: str | None = None,
 ) -> dict:
     """The sidecar dict ``Checkpointer.save(meta=...)`` records."""
-    meta = {"layout": layout, "n_data": int(mesh.shape[data_axis])}
+    meta = {
+        "layout": layout,
+        "n_data": int(mesh.shape[data_axis]),
+        # Always recorded (1 when no tp axis): a sidecar MISSING n_tp is
+        # a legacy (pre-tp-awareness) save, which elastic_restore treats
+        # as same-tp-as-current — preserving the exact-topology restore
+        # those checkpoints were limited to.
+        "n_tp": int(mesh.shape[tp_axis]) if tp_axis is not None else 1,
+    }
     if tp_axis is not None:
-        meta["n_tp"] = int(mesh.shape[tp_axis])
         meta["tp_axis"] = tp_axis
     return meta
 
@@ -61,6 +68,103 @@ def _repad(arr: np.ndarray, true: int, padded_new: int) -> np.ndarray:
     kept = arr[..., :true]
     pad = [(0, 0)] * (arr.ndim - 1) + [(0, padded_new - true)]
     return np.pad(kept, pad)
+
+
+def _zero_tp_geometry(params: Pytree, tp_axis: str) -> list:
+    """Per-leaf (global_shape, megatron_dim | None) in canonical leaf
+    order — the static facts the ZeRO x TP flat reshard needs.  The
+    Megatron dim comes from the SAME spec rule the layout was built with
+    (zero._param_specs), so the reshard cannot drift from the state."""
+    from jax.sharding import PartitionSpec
+
+    from distributeddataparallel_tpu.parallel.zero import _param_specs
+
+    specs = _param_specs(params, tp_axis)
+    geom = []
+    for leaf, sp in zip(
+        jax.tree.leaves(params),
+        jax.tree.leaves(specs, is_leaf=lambda x: isinstance(x, PartitionSpec)),
+    ):
+        mdim = None
+        for dim, entry in enumerate(tuple(sp)):
+            names = entry if isinstance(entry, tuple) else (entry,)
+            if tp_axis in [n for n in names if n is not None]:
+                mdim = dim
+                break
+        geom.append((tuple(leaf.shape), mdim))
+    return geom
+
+
+def _zero_tp_sizes(geom: list, n: int, n_tp: int) -> tuple[int, int]:
+    """(local_total, chunk) for one tp position's flat at (n, n_tp)."""
+    total = 0
+    for shape, mdim in geom:
+        size = int(np.prod(shape)) if shape else 1
+        if mdim is not None:
+            size //= n_tp
+        total += size
+    return total, -(-total // n)
+
+
+def _reshard_zero_tp_flat(
+    flat_old: np.ndarray,
+    geom: list,
+    n_old: int, n_tp_old: int, chunk_old: int, local_total_old: int,
+    n_new: int, n_tp_new: int, chunk_new: int,
+) -> np.ndarray:
+    """One ZeRO x TP opt flat: (data, tp)-interleaved local chunks at the
+    old topology -> the same at the new."""
+    # 1. Reassemble each old tp position's local flat (drop tail pad).
+    locals_old = []
+    for j in range(n_tp_old):
+        parts = [
+            flat_old[(d * n_tp_old + j) * chunk_old
+                     : (d * n_tp_old + j + 1) * chunk_old]
+            for d in range(n_old)
+        ]
+        locals_old.append(np.concatenate(parts)[:local_total_old])
+    # 2. Unflatten each local flat and reassemble FULL leaves.
+    full = []
+    offs = [0] * n_tp_old
+    for shape, mdim in geom:
+        if mdim is None:
+            size = int(np.prod(shape)) if shape else 1
+            full.append(
+                locals_old[0][offs[0]: offs[0] + size].reshape(shape)
+            )
+            for j in range(n_tp_old):
+                offs[j] += size
+        else:
+            lshape = list(shape)
+            lshape[mdim] //= n_tp_old
+            size = int(np.prod(lshape))
+            shards = []
+            for j in range(n_tp_old):
+                shards.append(
+                    locals_old[j][offs[j]: offs[j] + size].reshape(lshape)
+                )
+                offs[j] += size
+            full.append(np.concatenate(shards, axis=mdim))
+    # 3. Re-slice for the new tp positions, flatten, pad, interleave.
+    out = np.zeros((chunk_new * n_new * n_tp_new,), flat_old.dtype)
+    for j in range(n_tp_new):
+        pieces = []
+        for (shape, mdim), leaf in zip(geom, full):
+            if mdim is None:
+                pieces.append(leaf.reshape(-1))
+            else:
+                size = shape[mdim] // n_tp_new
+                sl = [slice(None)] * len(shape)
+                sl[mdim] = slice(j * size, (j + 1) * size)
+                pieces.append(leaf[tuple(sl)].reshape(-1))
+        loc = np.concatenate(pieces)
+        loc = np.pad(loc, (0, chunk_new * n_new - loc.size))
+        for d in range(n_new):
+            out[(d * n_tp_new + j) * chunk_new
+                : (d * n_tp_new + j + 1) * chunk_new] = (
+                loc[d * chunk_new: (d + 1) * chunk_new]
+            )
+    return out
 
 
 def elastic_restore(
@@ -99,7 +203,10 @@ def elastic_restore(
     n_new = int(mesh.shape[data_axis])
     n_old = (meta or {}).get("n_data", n_new)
     n_tp_new = int(mesh.shape[tp_axis]) if tp_axis is not None else 1
-    n_tp_old = int((meta or {}).get("n_tp", 1))
+    # Legacy sidecars (no n_tp key) predate tp-aware resharding and could
+    # only ever be resumed at the identical topology — assume the current
+    # run's degree so they keep taking the exact-restore path.
+    n_tp_old = int((meta or {}).get("n_tp", n_tp_new))
     if (n_old == n_new and n_tp_old == n_tp_new) or layout == "replicated":
         # Same chunking (or N-independent global shapes): exact-topology
         # restore regardless of layout — orbax re-slices to the
@@ -115,19 +222,51 @@ def elastic_restore(
     if layout == "zero1":
         from distributeddataparallel_tpu.parallel.zero import flat_size
 
-        true = sum(l.size for l in jax.tree.leaves(state.params))
-        padded_new, _ = flat_size(state.params, n_new)
-        padded_old, _ = flat_size(state.params, n_old)
+        if n_tp_old == 1 and n_tp_new == 1:
+            true = sum(l.size for l in jax.tree.leaves(state.params))
+            padded_new, _ = flat_size(state.params, n_new)
+            padded_old, _ = flat_size(state.params, n_old)
 
-        def old_shape(leaf):
-            if leaf.ndim == 1 and leaf.size == padded_new:
-                return (padded_old,)
-            return leaf.shape
+            def old_shape(leaf):
+                if leaf.ndim == 1 and leaf.size == padded_new:
+                    return (padded_old,)
+                return leaf.shape
 
-        def rebuild(old_arr, leaf):
-            if old_arr.shape == leaf.shape:
-                return old_arr
-            return _repad(old_arr, true, padded_new)
+            def rebuild(old_arr, leaf):
+                if old_arr.shape == leaf.shape:
+                    return old_arr
+                return _repad(old_arr, true, padded_new)
+
+        else:
+            # ZeRO-1 x Megatron TP: params carry N-independent GLOBAL
+            # shapes (orbax re-slices them), but each opt-state flat
+            # interleaves (data, tp) blocks of each tp position's LOCAL
+            # param shard.  Reshard = reassemble per-position local
+            # flats, unflatten into the local leaf shards, concatenate
+            # Megatron dims back to full leaves (replicated leaves: any
+            # position's copy), then re-slice/re-flatten/re-interleave
+            # at the new topology.  Linear and positional, so it
+            # transports Adam moments exactly.
+            old_axis = (meta or {}).get("tp_axis") or tp_axis
+            geom = _zero_tp_geometry(state.params, old_axis)
+            lt_old, chunk_old = _zero_tp_sizes(geom, n_old, n_tp_old)
+            lt_new, chunk_new = _zero_tp_sizes(geom, n_new, n_tp_new)
+            w_old = chunk_old * n_old * n_tp_old
+            w_new = chunk_new * n_new * n_tp_new
+
+            def old_shape(leaf):
+                if leaf.ndim == 1 and leaf.size == w_new:
+                    return (w_old,)
+                return leaf.shape
+
+            def rebuild(old_arr, leaf):
+                if old_arr.shape == leaf.shape:
+                    return old_arr
+                return _reshard_zero_tp_flat(
+                    old_arr, geom,
+                    n_old, n_tp_old, chunk_old, lt_old,
+                    n_new, n_tp_new, chunk_new,
+                )
 
     elif layout == "fsdp":
         if cfg is None:

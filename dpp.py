@@ -834,21 +834,25 @@ def train(args) -> float:
         )
 
         ckpt = Checkpointer(args.checkpoint_dir)
-        fsdp_tp = "model" if (args.fsdp and args.tp > 1) else None
+        flat_tp = (
+            "model"
+            if ((args.fsdp or args.zero) and args.tp > 1)
+            else None
+        )
         ckpt_meta = topology_meta(
             mesh,
             "fsdp" if args.fsdp
             else "zero1" if args.zero
             else "replicated",
-            tp_axis=fsdp_tp,
+            tp_axis=flat_tp,
         )
         if args.resume:
             # Elastic resume: the flat ZeRO/FSDP layouts reshard when the
-            # checkpoint was written at a different topology.  FSDP
-            # reshards across BOTH the data degree and the Megatron TP
-            # degree (full-tree host round-trip); ZeRO-1 reshards at
-            # pure DP; other model-axis flats restore exact-topology and
-            # reject a change loudly.
+            # checkpoint was written at a different topology.  FSDP and
+            # ZeRO-1 reshard across BOTH the data degree and the
+            # Megatron TP degree (host round-trips through the full
+            # tree / full leaves); ZeRO-1 x EP/PP flats restore
+            # exact-topology and reject a change loudly.
             pure_dp = (
                 args.tp == 1 and args.ep == 1 and args.pp == 1
                 and args.cp == 1
@@ -857,8 +861,11 @@ def train(args) -> float:
                 ckpt, state, mesh,
                 layout=ckpt_meta["layout"],
                 cfg=model.cfg if args.fsdp else None,
-                tp_axis=fsdp_tp,
-                allow_reshard=pure_dp or args.fsdp,
+                tp_axis=flat_tp,
+                allow_reshard=(
+                    pure_dp or args.fsdp
+                    or (args.zero and args.ep == 1 and args.pp == 1)
+                ),
             )
         # Preemption handling (TPU-VM maintenance events deliver SIGTERM):
         # finish the in-flight step, checkpoint, exit cleanly.  Epoch
