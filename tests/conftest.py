@@ -22,3 +22,17 @@ def devices():
     devs = jax.devices()
     assert len(devs) == 8, f"expected 8 fake CPU devices, got {len(devs)}"
     return devs
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _bounded_jit_cache():
+    """Drop prior modules' compiled executables at each module start.
+
+    A ~280-test run accumulates hundreds of executables in one process;
+    a full-suite run once hit an XLA:CPU runtime abort deep in the
+    pipeline module that never reproduces standalone or in the module's
+    own run.  Bounding the live cache to ~one module's worth keeps the
+    suite's memory/runtime state shaped like the per-module runs that
+    are known good, while preserving within-module cache reuse."""
+    jax.clear_caches()
+    yield
