@@ -357,7 +357,7 @@ def bench_gpt2() -> dict:
                     step.lower(state, batch, jax.random.PRNGKey(1))
                     .compile().as_text()
                 )
-                decomp = cycles_by_scope(txt, {
+                decomp = cycles_by_scope(txt, strict=True, buckets={
                     "attention": (
                         "q_proj|k_proj|v_proj|out_proj|attn|flash|attention"
                     ),
@@ -894,6 +894,7 @@ def bench_overlap() -> dict:
                 "n_async_windows", "n_sync_collectives",
                 "overlapped_compute_cycles", "total_compute_cycles",
                 "overlapped_frac_of_compute", "topology", "n_chips",
+                "compiler",
             )
         }
     except Exception as e:  # noqa: BLE001 - evidence lives in dryrun too

@@ -10,10 +10,14 @@ next-token training rows on the fly.
 - **Storage**: one ``.npy`` integer array, either a flat stream ``(N,)``
   or pre-chunked rows ``(n, seq_len+1)``.  ``np.load(mmap_mode="r")``:
   reads are OS page-cache-backed file IO, the corpus is never resident.
-- **Windowing**: flat streams yield ``(N-1)//seq_len`` non-overlapping
-  windows; window ``i`` is ``stream[i*S : i*S + S + 1]`` — the +1
-  carries the next-token target for the last position (the same
-  host-side shift contract as ``SyntheticLM``/``shard_lm_batch``).
+- **Windowing**: flat streams yield windows starting every ``stride``
+  tokens (default ``stride=seq_len`` → the classic ``(N-1)//seq_len``
+  non-overlapping layout); window ``i`` is
+  ``stream[i*stride : i*stride + S + 1]`` — the +1 carries the
+  next-token target for the last position (the same host-side shift
+  contract as ``SyntheticLM``/``shard_lm_batch``).  ``stride < seq_len``
+  overlaps windows for small corpora.  The batch gather is one
+  vectorized sliding-window-view fancy index (no per-row Python loop).
 - **Sampler semantics**: ``DistributedSampler`` operates on window
   indices exactly as on any dataset — padding to ``ceil(n/W)×W``,
   ``rank::W`` striding, epoch reshuffle — and the loader's
@@ -72,9 +76,16 @@ def write_token_file(
 
 
 class TokenFileDataset:
-    """Next-token LM windows over a memmapped token file."""
+    """Next-token LM windows over a memmapped token file.
 
-    def __init__(self, path: str, *, seq_len: int):
+    ``stride`` (flat streams only) spaces window starts ``stride`` tokens
+    apart; ``stride < seq_len`` yields overlapping windows — more training
+    rows from a small corpus, the nanoGPT random-offset sampling made
+    deterministic so the sampler's pad/stride/epoch semantics still apply.
+    Default ``stride=seq_len`` keeps the non-overlapping layout.
+    """
+
+    def __init__(self, path: str, *, seq_len: int, stride: int | None = None):
         if not os.path.exists(path):
             raise FileNotFoundError(f"no token file at {path}")
         arr = np.load(path, mmap_mode="r")
@@ -83,6 +94,9 @@ class TokenFileDataset:
                 f"{path}: token files hold integers, got {arr.dtype}"
             )
         self.seq_len = seq_len
+        self.stride = seq_len if stride is None else stride
+        if self.stride < 1:
+            raise ValueError(f"stride must be >= 1, got {self.stride}")
         self._arr = arr
         if arr.ndim == 1:
             if len(arr) < seq_len + 1:
@@ -90,9 +104,16 @@ class TokenFileDataset:
                     f"{path}: stream of {len(arr)} tokens is shorter than "
                     f"one window (seq_len+1 = {seq_len + 1})"
                 )
-            self._n = (len(arr) - 1) // seq_len
+            # window i covers [i*stride, i*stride + seq_len + 1); with the
+            # default stride=seq_len this is the classic (N-1)//S count.
+            self._n = (len(arr) - seq_len - 1) // self.stride + 1
             self._rows = False
         elif arr.ndim == 2:
+            if stride is not None and stride != seq_len:
+                raise ValueError(
+                    f"{path}: stride applies to flat streams; pre-chunked "
+                    "row files fix their own window layout"
+                )
             if arr.shape[1] != seq_len + 1:
                 raise ValueError(
                     f"{path}: rows are {arr.shape[1]} wide, need "
@@ -117,10 +138,14 @@ class TokenFileDataset:
         if self._rows:
             out = np.asarray(self._arr[idx], np.int32)
         else:
-            S = self.seq_len
-            out = np.empty((len(idx), S + 1), np.int32)
-            for j, i in enumerate(idx):  # S+1 contiguous tokens per window
-                out[j] = self._arr[i * S : i * S + S + 1]
+            # One vectorized gather: a zero-copy sliding-window view over
+            # the memmap, fancy-indexed at the window starts — numpy does
+            # the whole batch copy in C (the old per-row Python loop was
+            # the one data path with no fast path).
+            view = np.lib.stride_tricks.sliding_window_view(
+                self._arr, self.seq_len + 1
+            )
+            out = view[idx * self.stride].astype(np.int32, copy=False)
         if self.vocab_size is not None and out.size:
             hi, lo = int(out.max()), int(out.min())
             if hi >= self.vocab_size or lo < 0:

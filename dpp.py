@@ -59,6 +59,11 @@ def parse_args(argv=None):
                         "synthetic otherwise")
     p.add_argument("--seq-len", type=int, default=128,
                    help="LM sequence length")
+    p.add_argument("--token-stride", type=int, default=None,
+                   help="window-start spacing for tokens:FILE flat streams "
+                        "(< seq-len overlaps windows; default seq-len). "
+                        "Train split only — eval keeps non-overlapping "
+                        "windows so its mean is over distinct text")
     p.add_argument("--vocab-size", type=int, default=256,
                    help="LM vocab size (synthetic data; real data overrides)")
     p.add_argument("--layers", type=int, default=None,
@@ -463,7 +468,10 @@ def build_dataset(args, train=True):
                     f"--eval with --dataset tokens: needs a val split at "
                     f"{path}"
                 )
-        return data.TokenFileDataset(path, seq_len=args.seq_len)
+        return data.TokenFileDataset(
+            path, seq_len=args.seq_len,
+            stride=(args.token_stride if train else None),
+        )
     if is_lm(args) or args.dataset == "synthetic-lm":
         return data.SyntheticLM(
             num_examples=args.num_examples, seq_len=args.seq_len,

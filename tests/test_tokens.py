@@ -41,6 +41,37 @@ def test_flat_stream_windowing(tmp_path):
     assert batch["tokens"].dtype == np.int32
 
 
+def test_overlapping_stride_windows(tmp_path):
+    """stride < seq_len overlaps windows; counts and contents are exact."""
+    toks = np.arange(101, dtype=np.int32)
+    path = write_token_file(str(tmp_path / "t.npy"), toks)
+    ds = TokenFileDataset(path, seq_len=10, stride=5)
+    # starts 0,5,...,90: last window covers [90, 101) -> 19 windows
+    assert len(ds) == 19
+    np.testing.assert_array_equal(ds[1]["tokens"], np.arange(5, 16))
+    batch = ds.gather(np.array([0, 18]))
+    np.testing.assert_array_equal(batch["tokens"][1], np.arange(90, 101))
+    # default stride reproduces the non-overlapping layout exactly
+    base = TokenFileDataset(path, seq_len=10)
+    strided = TokenFileDataset(path, seq_len=10, stride=10)
+    assert len(base) == len(strided)
+    np.testing.assert_array_equal(
+        base.gather(range(len(base)))["tokens"],
+        strided.gather(range(len(strided)))["tokens"],
+    )
+    with pytest.raises(ValueError, match="stride must be >= 1"):
+        TokenFileDataset(path, seq_len=10, stride=0)
+
+
+def test_stride_rejected_on_row_files(tmp_path):
+    rows = np.arange(60, dtype=np.int64).reshape(6, 10)
+    path = write_token_file(str(tmp_path / "rows.npy"), rows)
+    with pytest.raises(ValueError, match="flat streams"):
+        TokenFileDataset(path, seq_len=9, stride=4)
+    # explicit stride == seq_len is the default layout: allowed
+    assert len(TokenFileDataset(path, seq_len=9, stride=9)) == 6
+
+
 def test_prechunked_rows_and_sidecar(tmp_path):
     rows = np.arange(60, dtype=np.int64).reshape(6, 10)
     path = write_token_file(
