@@ -853,6 +853,36 @@ def bench_input_pipeline() -> dict:
         out["host_to_device_img_s"] = round(
             rows / (time.perf_counter() - t0), 1
         )
+
+    # Token host-gather rate (data.tokens vectorized sliding-window
+    # gather, VERDICT r4 item 8): same >=-device-rate done-bar as images,
+    # computed in main() against bench_gpt2's tokens/s/chip.
+    import tempfile as _tf
+
+    from distributeddataparallel_tpu.data import TokenFileDataset
+
+    from distributeddataparallel_tpu.data import write_token_file
+
+    tok_path = os.path.join(_tf.gettempdir(), "ddp_bench_tokens.npy")
+    n_tok, S = 8_000_000, 1024
+    if not (
+        os.path.exists(tok_path)
+        and np.load(tok_path, mmap_mode="r").shape == (n_tok,)
+    ):
+        npr = np.random.default_rng(0)
+        write_token_file(
+            tok_path, npr.integers(0, 50257, size=(n_tok,))
+        )
+    tds = TokenFileDataset(tok_path, seq_len=S)
+    bsz = 64
+    order = np.random.default_rng(1).permutation(len(tds))
+    tds.gather(order[:bsz])  # touch pages once
+    t0 = time.perf_counter()
+    toks = 0
+    for lo in range(0, len(order) - bsz, bsz):
+        b = tds.gather(order[lo : lo + bsz])
+        toks += b["tokens"].size
+    out["token_gather_tok_s"] = round(toks / (time.perf_counter() - t0), 1)
     return out
 
 
@@ -873,32 +903,31 @@ def bench_overlap() -> dict:
         loss_fn, state, batch, jax.random.PRNGKey(1), mesh=mesh, iters=4
     )
 
-    # The scheduled-HLO demonstration (OVERLAP.md): AOT-compile the
-    # chained-bucket DP step for an 8-chip v5e topology and report how
-    # much backward compute the TPU compiler scheduled inside the
-    # async-collective windows.  This is the BASELINE "overlap
-    # demonstrated in profile" artifact — the wall-clock probe above
-    # cannot show it with one visible chip (overlap_frac None).
-    try:
-        # chain=True evidence only: the stock-XLA zero-overlap contrast
-        # costs a second topology AOT compile (~35 s through the tunnel)
-        # and is recorded every dryrun in MULTICHIP_PROBES.json.
-        from distributeddataparallel_tpu.parallel.overlap import (
-            grad_sync_schedule_evidence,
-        )
+    # The scheduled-HLO demonstration (OVERLAP.md): AOT-compile the REAL
+    # train steps — GPT-2 124M (unrolled, adamw) and the Llama-0.6B
+    # scan+remat config with the in-scan-body reduction — for an 8-chip
+    # v5e topology and report how much backward compute the TPU compiler
+    # scheduled inside the async-collective windows (VERDICT r4 item 1:
+    # rounds 1-4 recorded an 8-layer-MLP proxy here).  The MLP pair
+    # (chain-vs-stock contrast) still lands in MULTICHIP_PROBES.json
+    # every dryrun.
+    keys = (
+        "n_async_windows", "n_sync_collectives", "n_comm_fused",
+        "overlapped_compute_cycles", "total_compute_cycles",
+        "overlapped_frac_of_compute", "async_collective_bytes",
+        "sync_collective_bytes", "async_bytes_frac", "topology",
+        "n_chips", "compiler", "compile_s", "config", "while_bodies",
+    )
+    from distributeddataparallel_tpu.parallel.overlap import (
+        train_step_schedule_evidence,
+    )
 
-        sched = grad_sync_schedule_evidence(chain=True)
-        out["tpu_schedule"] = {
-            k: sched[k]
-            for k in (
-                "n_async_windows", "n_sync_collectives",
-                "overlapped_compute_cycles", "total_compute_cycles",
-                "overlapped_frac_of_compute", "topology", "n_chips",
-                "compiler",
-            )
-        }
-    except Exception as e:  # noqa: BLE001 - evidence lives in dryrun too
-        out["scheduled_error"] = repr(e)
+    for m in ("gpt2", "llama"):
+        try:
+            rep = train_step_schedule_evidence(model=m)
+            out[f"real_step_schedule_{m}"] = {k: rep[k] for k in keys}
+        except Exception as e:  # noqa: BLE001 - keep the other sections
+            out[f"real_step_schedule_{m}"] = {"error": repr(e)}
     return out
 
 
@@ -953,32 +982,101 @@ def main() -> None:
             input_pipe["host_gather_img_s"] / max(dev_rate, 1e-9), 3
         )
 
+    # Token-pipeline done-bar (mirrors the image one above).
+    if "token_gather_tok_s" in input_pipe and "tokens_s_chip" in gpt2:
+        tok_dev = gpt2["tokens_s_chip"] * len(jax.devices())
+        input_pipe["device_tok_s"] = round(tok_dev, 1)
+        input_pipe["token_host_over_device"] = round(
+            input_pipe["token_gather_tok_s"] / max(tok_dev, 1e-9), 3
+        )
+
     img_s_chip = resnet.get("img_s_chip", 0.0)
     target = TARGET_FRACTION * A100_DDP_RESNET50_IMG_S
-    print(
-        json.dumps(
-            {
-                "metric": "img/s/chip (resnet50_imagenet_dp)",
-                "value": img_s_chip,
-                "unit": "img/s/chip",
-                "vs_baseline": round(img_s_chip / target, 4),
-                "extras": {
-                    "peaks": _device_peaks(),
-                    "device_kind": dev.device_kind,
-                    "platform": dev.platform,
-                    "n_devices": len(jax.devices()),
-                    "resnet50": resnet,
-                    "gpt2_124m": gpt2,
-                    "llama_0p6b": llama,
-                    "decode_gpt2": decode,
-                    "moe_token_choice": moe,
-                    "cp_ring_block": cp_ring,
-                    "overlap_gpt2_dp": overlap,
-                    "input_pipeline": input_pipe,
-                },
-            }
-        )
+    full = {
+        "metric": "img/s/chip (resnet50_imagenet_dp)",
+        "value": img_s_chip,
+        "unit": "img/s/chip",
+        "vs_baseline": round(img_s_chip / target, 4),
+        "extras": {
+            "peaks": _device_peaks(),
+            "device_kind": dev.device_kind,
+            "platform": dev.platform,
+            "n_devices": len(jax.devices()),
+            "resnet50": resnet,
+            "gpt2_124m": gpt2,
+            "llama_0p6b": llama,
+            "decode_gpt2": decode,
+            "moe_token_choice": moe,
+            "cp_ring_block": cp_ring,
+            "overlap_gpt2_dp": overlap,
+            "input_pipeline": input_pipe,
+        },
+    }
+    # Full detail: stdout (live readers) + a file next to this script —
+    # the driver persists only a 2 KB stdout TAIL, which round 4 proved
+    # loses the headline sections (VERDICT r4 missing 3).
+    print(json.dumps(full))
+    detail_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "BENCH_DETAIL.json"
     )
+    with open(detail_path, "w") as fh:
+        json.dump(full, fh, indent=1)
+
+    # LAST line: a compact headline summary sized to always fit the
+    # driver's tail, so every README perf claim is auditable from
+    # BENCH_r{N}.json alone.
+    def _sched(rep):
+        if not isinstance(rep, dict):
+            return {"error": "missing"}
+        if "error" in rep:
+            return {"error": str(rep["error"])[:60]}
+        return {
+            "windows": rep["n_async_windows"],
+            "sync": rep["n_sync_collectives"],
+            "frac_compute": rep["overlapped_frac_of_compute"],
+            "async_bytes_frac": rep["async_bytes_frac"],
+        }
+
+    headline = {
+        "metric": full["metric"],
+        "value": img_s_chip,
+        "unit": "img/s/chip",
+        "vs_baseline": full["vs_baseline"],
+        "headline": {
+            "device": dev.device_kind,
+            "resnet50_img_s_chip": img_s_chip,
+            "resnet50_mfu": resnet.get("mfu_est"),
+            "gpt2_tok_s_chip": gpt2.get("tokens_s_chip"),
+            "gpt2_mfu": gpt2.get("mfu_est"),
+            "gpt2_attn_winner": gpt2.get("attn_winner"),
+            "llama_tok_s_chip": llama.get("tokens_s_chip"),
+            "llama_mfu": llama.get("mfu_est"),
+            "decode_tok_s_chip_b256": (
+                decode.get("per_batch", {}).get("256", {})
+                .get("decode_tokens_s_chip")
+            ),
+            "decode_hbm_util_b8": decode.get("hbm_util_b8"),
+            "moe_e16_over_e4": moe.get("e16_over_e4"),
+            "moe_roofline": moe.get("e16_over_e4_weight_traffic_roofline"),
+            "flash_vs_xla_block_speedup": cp_ring.get("flash_speedup"),
+            "overlap_real_gpt2": _sched(
+                overlap.get("real_step_schedule_gpt2")
+            ),
+            "overlap_real_llama": _sched(
+                overlap.get("real_step_schedule_llama")
+            ),
+            "input_host_gather_img_s": input_pipe.get("host_gather_img_s"),
+            "input_host_over_device": input_pipe.get("host_over_device"),
+            "token_gather_tok_s": input_pipe.get("token_gather_tok_s"),
+            "token_host_over_device": input_pipe.get(
+                "token_host_over_device"
+            ),
+            "detail": "BENCH_DETAIL.json (full sections)",
+        },
+    }
+    line = json.dumps(headline)
+    assert len(line) < 1900, f"headline line {len(line)}B > 1.9KB tail budget"
+    print(line)
 
 
 if __name__ == "__main__":

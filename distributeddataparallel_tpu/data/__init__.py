@@ -10,6 +10,10 @@ from distributeddataparallel_tpu.data.sharded import (  # noqa: F401
     write_image_shards,
     write_synthetic_image_shards,
 )
+from distributeddataparallel_tpu.data.ingest import (  # noqa: F401
+    ingest_image_tree,
+    scan_image_tree,
+)
 from distributeddataparallel_tpu.data.tokens import (  # noqa: F401
     TokenFileDataset,
     encode_bytes,

@@ -138,6 +138,15 @@ class TokenFileDataset:
         if self._rows:
             out = np.asarray(self._arr[idx], np.int32)
         else:
+            if idx.size and (idx.min() < 0 or idx.max() >= self._n):
+                # The sliding-window view would wrap negative indices to
+                # window starts that aren't on the dataset's stride grid
+                # — silently wrong text (the old per-row loop failed
+                # loudly here; keep that contract).
+                raise IndexError(
+                    f"window indices must be in [0, {self._n}); got "
+                    f"[{idx.min()}, {idx.max()}]"
+                )
             # One vectorized gather: a zero-copy sliding-window view over
             # the memmap, fancy-indexed at the window starts — numpy does
             # the whole batch copy in C (the old per-row Python loop was

@@ -113,6 +113,16 @@ class TransformerConfig:
     moe_top_k: int = 1
     ep_axis: str | None = None
     moe_capacity_factor: float = 0.0
+    # Data-parallel grad sync INSIDE the backward scan: name of the mesh
+    # axis the scanned blocks' param gradients are pmean'd over, per scan
+    # iteration, via an identity-with-all-reduce-VJP on the param reads
+    # (``parallel.data_parallel.sync_grad_in_backward``).  Scanned models
+    # otherwise hold every layer grad inside the backward while-loop
+    # where no post-loop all-reduce can overlap them (OVERLAP.md).
+    # Requires ``scan_layers``; the train step must skip these leaves in
+    # its own sync (``make_train_step(presynced=scanned_param_paths)``).
+    # Backward passes must then run inside shard_map with the axis bound.
+    grad_sync_axis: str | None = None
 
     @property
     def kv_heads(self) -> int:
@@ -614,13 +624,37 @@ class DecoderBlock(nn.Module):
 
 
 class _ScanBlock(nn.Module):
-    """DecoderBlock adapted to linen.scan's (carry, *broadcast) shape."""
+    """DecoderBlock adapted to linen.scan's (carry, *broadcast) shape.
+
+    Under ``cfg.grad_sync_axis`` the block's params are read through
+    ``sync_grad_in_backward``: forward identity, backward pmean over the
+    data axis — so each scan iteration's param-slice gradient is reduced
+    inside the backward while-loop body where the async scheduler can
+    hide it under the trip's remaining backward compute (the only
+    overlap available to a scanned model; see parallel/overlap.py).
+    """
 
     cfg: TransformerConfig
 
     @nn.compact
     def __call__(self, x, positions, rope, deterministic):
-        x = DecoderBlock(self.cfg, name="block")(
+        cls = DecoderBlock
+        if self.cfg.grad_sync_axis is not None:
+            from distributeddataparallel_tpu.parallel.data_parallel import (
+                sync_grad_in_backward,
+            )
+
+            axis = self.cfg.grad_sync_axis
+            cls = nn.map_variables(
+                DecoderBlock,
+                "params",
+                trans_in_fn=(
+                    (lambda vs: vs) if self.is_initializing()
+                    else (lambda vs: sync_grad_in_backward(vs, axis))
+                ),
+                init=self.is_initializing(),
+            )
+        x = cls(self.cfg, name="block")(
             x, positions, rope, deterministic
         )
         return x, None
@@ -737,6 +771,11 @@ class TransformerLM(nn.Module):
             rope = rope_frequencies(
                 cfg.dims_per_head, cfg.max_seq_len, theta=cfg.rope_theta
             )
+        if cfg.grad_sync_axis is not None and not cfg.scan_layers:
+            # Unrolled layers emit per-leaf grads at top level, where the
+            # train step's own bucketed reduction already overlaps; the
+            # in-body sync exists for the scan case only.
+            raise ValueError("grad_sync_axis requires scan_layers=True")
         if cfg.scan_layers:
             # One traced layer instead of L (compile time); under scan,
             # remat wraps the scan body (prevent_cse must be False there).

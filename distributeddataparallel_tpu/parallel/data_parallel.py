@@ -163,6 +163,42 @@ def bucket_gradients(
     return jax.tree.unflatten(treedef, reduced)
 
 
+def sync_grad_in_backward(x: Pytree, axis_name: str, *, op: str = "mean"):
+    """Identity on the forward; all-reduces the COTANGENT over
+    ``axis_name`` on the backward.
+
+    Applied to a parameter *use site* inside a ``lax.scan`` body (the
+    scanned transformer block reads its per-layer param slice through
+    this, ``models.transformer grad_sync_axis``), the gradient of that
+    slice is reduced INSIDE the backward scan iteration — which is the
+    only place a scanned model's layer grads exist before the loop
+    stacks them.  Measured on the scanned-Llama v5e:2x4 schedule: the
+    post-loop reduction of the stacked grads cannot overlap anything
+    (2.3% of compute in windows); the in-body reduction runs one async
+    window per scan trip while that trip's remaining backward computes
+    (OVERLAP.md).  The train step must then SKIP these leaves in its own
+    sync (``make_train_step(presynced=...)``) — re-reducing an averaged
+    gradient is numerically a no-op but pays the full wire bytes twice.
+
+    Forward-only applies (eval, decode) never touch the axis, so the
+    model stays usable outside ``shard_map``.
+    """
+
+    @jax.custom_vjp
+    def ident(t):
+        return t
+
+    def fwd(t):
+        return t, None
+
+    def bwd(_, g):
+        red = lax.pmean if op == "mean" else lax.psum
+        return (red(g, axis_name),)
+
+    ident.defvjp(fwd, bwd)
+    return jax.tree.map(ident, x)
+
+
 def sumsq_f32(tree: Pytree):
     """Sum of squares of every leaf, accumulated in float32 (bf16 grads
     would lose the norm to ~8 mantissa bits) — the building block for

@@ -63,6 +63,7 @@ def make_train_step(
     tp_axis: str | None = None,
     ep_axis: str | None = None,
     grad_clip: float | None = None,
+    presynced: Callable[[tuple], bool] | None = None,
 ):
     """Build the jit'd DP train step.
 
@@ -89,20 +90,31 @@ def make_train_step(
 
     ``overlap=True`` is the demonstrated analog of DDP's bucketed
     all-reduce hidden under backward (ref dpp.py:52, SURVEY §3.4):
-    gradients reduce as chained reverse-order buckets
-    (``bucket_gradients(chain=True)``) and the step compiles with the
-    TPU async-collective/latency-hiding options, which schedules real
-    backward compute inside each collective's start/done window — see
+    gradients reduce as unchained reverse-order buckets (sub-MiB leaves
+    coalesced, weight-sized leaves solo in native dtype) and the step
+    compiles with the TPU async-collective/latency-hiding options plus a
+    disabled all-reduce combiner, which schedules real backward compute
+    inside each collective's start/done window — see
     ``parallel/overlap.py`` and OVERLAP.md for the scheduled-HLO
-    evidence.  Composes with ``accum_steps`` (reduction still fires once
-    per boundary) and ``grad_clip``; on non-TPU backends the chained
-    buckets still run (semantics identical) without the TPU options.
+    evidence measured on the real GPT-2 step.  Composes with
+    ``accum_steps`` (reduction still fires once per boundary) and
+    ``grad_clip``; on non-TPU backends the buckets still run (semantics
+    identical) without the TPU options.
 
     With ``zero=True``, optimizer state is ZeRO-1-sharded across the data
     axis (see ``parallel.zero``): grads reduce_scatter instead of
     all-reduce, the update runs on each replica's 1/N shard, updated
     params all_gather back.  ``state`` must come from ``zero_state``.
     Mutually exclusive with ``bucket_bytes``.
+
+    ``presynced`` (a predicate on gradient-leaf key paths, e.g.
+    ``lambda path: path[0] == "layers"``) marks leaves whose gradients
+    the MODEL already reduced over the data axis —
+    ``TransformerConfig.grad_sync_axis`` reduces the scanned blocks'
+    grads inside the backward scan body, the only place they can overlap
+    with backward compute.  The step then syncs only the remaining
+    leaves; re-reducing an averaged gradient would be a numeric no-op
+    but pays the full wire bytes twice.
 
     ``grad_sync=False`` is the ``DDP.no_sync()`` analog: gradients are NOT
     averaged across the data axis — each replica applies its local grads
@@ -155,6 +167,12 @@ def make_train_step(
     if zero and (bucket_bytes is not None or overlap):
         raise ValueError("zero=True does its own reduction; drop "
                          "bucket_bytes/overlap")
+    if presynced is not None and (zero or not grad_sync):
+        # ZeRO's reduce_scatter SUMS shards: feeding it leaves the model
+        # already averaged would divide those grads by the axis size
+        # twice.  grad_sync=False skips the step's sync entirely, so a
+        # skip-list is meaningless there.
+        raise ValueError("presynced requires grad_sync=True and zero=False")
     if not grad_sync and (zero or bucket_bytes is not None or overlap):
         raise ValueError("grad_sync=False skips the reduction entirely; "
                          "it does not compose with zero/bucket_bytes/overlap")
@@ -276,20 +294,54 @@ def make_train_step(
         else:
             if grad_sync:
                 # THE DDP moment: average grads across the data axis.
-                # overlap=True: chained reverse-order buckets so the TPU
-                # backend's async-collective fusion can hide each bucket's
-                # all-reduce under the remaining backward (parallel.overlap;
-                # the scheduled-HLO evidence lives in OVERLAP.md).  1 MiB
-                # default bucket: leaves above it ride solo in native
-                # dtype, which is what the async scheduler fuses best.
-                grads = all_reduce_gradients(
-                    grads, axis_name, op="mean",
-                    bucket_bytes=(
-                        bucket_bytes if bucket_bytes is not None
-                        else (OVERLAP_BUCKET_BYTES if overlap else None)
-                    ),
-                    chain=overlap,
+                # overlap=True: UNCHAINED reverse-order buckets (1 MiB —
+                # leaves above it ride solo in native dtype, sub-MiB
+                # leaves coalesce) + the compiler options' disabled
+                # all-reduce combiner, so every weight-sized bucket stays
+                # a separate collective the TPU async scheduler can hide
+                # under the remaining backward.  Barrier-chaining the
+                # buckets (rounds 1-4) measured WORSE on the real model
+                # step — 12.3% vs 19.1% scheduled overlap at 2.7x the
+                # compile time — because the chain serializes the
+                # collectives themselves (parallel/overlap.py, OVERLAP.md).
+                bb = (
+                    bucket_bytes if bucket_bytes is not None
+                    else (OVERLAP_BUCKET_BYTES if overlap else None)
                 )
+                if presynced is None:
+                    grads = all_reduce_gradients(
+                        grads, axis_name, op="mean", bucket_bytes=bb,
+                        chain=False,
+                    )
+                else:
+                    # Model-synced leaves (grad_sync_axis: reduced inside
+                    # the backward scan body) pass through; the step
+                    # reduces only the rest (embeddings/head/final norm).
+                    flat, treedef = jax.tree_util.tree_flatten_with_path(
+                        grads
+                    )
+                    keys = [
+                        tuple(
+                            getattr(k, "key", getattr(k, "idx", str(k)))
+                            for k in path
+                        )
+                        for path, _ in flat
+                    ]
+                    rest = [
+                        leaf for (path, leaf), k in zip(flat, keys)
+                        if not presynced(k)
+                    ]
+                    rest = iter(all_reduce_gradients(
+                        rest, axis_name, op="mean", bucket_bytes=bb,
+                        chain=False,
+                    ))
+                    grads = jax.tree.unflatten(
+                        treedef,
+                        [
+                            leaf if presynced(k) else next(rest)
+                            for (path, leaf), k in zip(flat, keys)
+                        ],
+                    )
             if grad_clip is not None:
                 from distributeddataparallel_tpu.parallel.data_parallel import (
                     clip_scale,

@@ -444,7 +444,16 @@ def build_model(args, num_classes: int = 10, vocab_size: int | None = None):
                         f"--d-model"
                     )
                 overrides["num_kv_heads"] = kv
-        return tfm.TransformerLM(family(**overrides))
+        cfg = family(**overrides)
+        if args.overlap and cfg.scan_layers:
+            # Scanned stacks hold every layer grad inside the backward
+            # while-loop; overlap needs the reduction to fire in there
+            # (sync_grad_in_backward) — the step then skips the "layers"
+            # subtree (presynced, wired at make_train_step below).
+            import dataclasses as _dc
+
+            cfg = _dc.replace(cfg, grad_sync_axis="data")
+        return tfm.TransformerLM(cfg)
     raise NotImplementedError(f"--model {args.model}")
 
 
@@ -805,6 +814,12 @@ def train(args) -> float:
             tp_axis="model" if args.tp > 1 else None,
             ep_axis="expert" if args.ep > 1 else None,
             grad_clip=args.grad_clip,
+            presynced=(
+                (lambda p: p[0] == "layers")
+                if getattr(getattr(model, "cfg", None), "grad_sync_axis",
+                           None)
+                else None
+            ),
         )
 
     def full_params():

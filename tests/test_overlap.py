@@ -145,6 +145,113 @@ def test_overlap_train_step_matches_stock(devices):
         assert np.isfinite(outs[name][1])
 
 
+def test_scan_body_grad_sync_matches_stock(devices):
+    """grad_sync_axis (in-scan-body pmean via sync_grad_in_backward) +
+    presynced skip-list in the step == the stock DP step, bit-for-bit in
+    params and loss — the reduction moves INTO the backward while loop,
+    the math doesn't change."""
+    import jax.numpy as jnp
+
+    from distributeddataparallel_tpu.data.loader import shard_batch
+    from distributeddataparallel_tpu.models import TransformerLM, tiny_lm
+    from distributeddataparallel_tpu.ops import lm_cross_entropy
+
+    mesh = make_mesh(("data",))
+    n = mesh.shape["data"]
+    seq = 16
+
+    def build(grad_sync_axis, remat):
+        cfg = tiny_lm(
+            max_seq_len=seq, scan_layers=True, remat=remat,
+            grad_sync_axis=grad_sync_axis,
+        )
+        model = TransformerLM(cfg)
+
+        def loss_fn(params, batch, rng):
+            toks = batch["tokens"]
+            logits = model.apply({"params": params}, toks[:, :-1])
+            return lm_cross_entropy(logits, toks[:, 1:]), {}
+
+        return model, loss_fn
+
+    toks = np.asarray(
+        jax.random.randint(
+            jax.random.PRNGKey(7), (4 * n, seq + 1), 0, 256
+        ),
+        np.int32,
+    )
+    for remat in (False, True):
+        model0, loss0 = build(None, remat)
+        model1, loss1 = build("data", remat)
+        params = model0.init(
+            jax.random.PRNGKey(0), jnp.zeros((1, seq), jnp.int32)
+        )["params"]
+        # the map_variables wrap is identity at init: same param tree
+        params1 = model1.init(
+            jax.random.PRNGKey(0), jnp.zeros((1, seq), jnp.int32)
+        )["params"]
+        jax.tree.map(
+            lambda a, b: np.testing.assert_array_equal(a, b),
+            params, params1,
+        )
+
+        outs = []
+        for loss_fn, kwargs in (
+            (loss0, {}),
+            (loss1, {"presynced": lambda p: p[0] == "layers",
+                     "overlap": True}),
+        ):
+            state = ddp.TrainState.create(
+                apply_fn=None, params=jax.tree.map(jnp.copy, params),
+                tx=optax.sgd(0.1),
+            )
+            state = ddp.broadcast_params(state, mesh)
+            step = ddp.make_train_step(
+                loss_fn, mesh=mesh, donate=False, **kwargs
+            )
+            new_state, metrics = step(
+                state, shard_batch({"tokens": toks}, mesh),
+                jax.random.PRNGKey(3),
+            )
+            outs.append((new_state.params, float(metrics["loss"])))
+
+        np.testing.assert_allclose(outs[0][1], outs[1][1], rtol=1e-6)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-7
+            ),
+            outs[0][0], outs[1][0],
+        )
+
+
+def test_grad_sync_axis_requires_scan(devices):
+    import jax.numpy as jnp
+
+    from distributeddataparallel_tpu.models import TransformerLM, tiny_lm
+
+    cfg = tiny_lm(scan_layers=False, grad_sync_axis="data")
+    with pytest.raises(ValueError, match="scan_layers"):
+        TransformerLM(cfg).init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+        )
+
+
+def test_presynced_rejects_zero_and_nosync(devices):
+    mesh = make_mesh(("data",))
+
+    def loss_fn(params, batch, rng):
+        return jnp.sum(params["w"] * 0.0), {}
+
+    with pytest.raises(ValueError, match="presynced"):
+        ddp.make_train_step(
+            loss_fn, mesh=mesh, zero=True, presynced=lambda p: False
+        )
+    with pytest.raises(ValueError, match="presynced"):
+        ddp.make_train_step(
+            loss_fn, mesh=mesh, grad_sync=False, presynced=lambda p: False
+        )
+
+
 def test_overlap_rejects_zero_and_nosync(devices):
     mesh = make_mesh(("data",))
 
@@ -273,3 +380,37 @@ def test_tpu_schedule_evidence():
         pytest.skip(f"TPU topology compile unavailable: {exc!r}")
     assert rep["n_async_windows"] >= 1
     assert rep["overlapped_compute_cycles"] > 0
+    assert rep["compiler"]["jax"]
+
+
+def test_tpu_real_step_schedule_evidence_scanned():
+    """The REAL scanned-Llama train step (remat + scan + in-body grad
+    sync) schedules async all-reduce windows INSIDE the backward scan
+    body on an 8-chip TPU topology — the model-scale evidence VERDICT r4
+    item 1 demanded (size reduced from the bench config to keep the AOT
+    compile test-budget-sized; same structure: scan, remat, GQA,
+    grad_sync_axis, presynced step)."""
+    pytest.importorskip("jax.experimental.topologies")
+    from distributeddataparallel_tpu.parallel.overlap import (
+        train_step_schedule_evidence,
+    )
+
+    try:
+        rep = train_step_schedule_evidence(
+            model="llama", per_chip_batch=2, seq_len=512
+        )
+    except Exception as exc:  # no TPU compiler in this process
+        pytest.skip(f"TPU topology compile unavailable: {exc!r}")
+    assert rep["config"]["scan_layers"] and rep["config"]["remat"]
+    # the win: windows inside the backward while body, every scan trip
+    body_windows = sum(
+        b["n_async_windows_per_trip"] * b["trip_count"]
+        for b in rep["while_bodies"]
+    )
+    assert body_windows >= rep["config"]["num_layers"]
+    assert rep["overlapped_compute_cycles"] > 0
+    # the bulk of the collective payload rides async (weight-sized
+    # grads); at this reduced test size the sync residue (norm-scale
+    # leaves) is a bigger share than at bench scale, hence > 0.5 here
+    # and the real fraction recorded from the full config in BENCH_r{N}
+    assert rep["async_bytes_frac"] > 0.5

@@ -63,6 +63,18 @@ def test_overlapping_stride_windows(tmp_path):
         TokenFileDataset(path, seq_len=10, stride=0)
 
 
+def test_flat_gather_rejects_out_of_range(tmp_path):
+    """Negative/overflow window indices fail loudly — the sliding-window
+    view would otherwise wrap them to off-grid starts (wrong text)."""
+    toks = np.arange(101, dtype=np.int32)
+    path = write_token_file(str(tmp_path / "t.npy"), toks)
+    ds = TokenFileDataset(path, seq_len=10)
+    with pytest.raises(IndexError):
+        ds.gather([-1])
+    with pytest.raises(IndexError):
+        ds.gather([len(ds)])
+
+
 def test_stride_rejected_on_row_files(tmp_path):
     rows = np.arange(60, dtype=np.int64).reshape(6, 10)
     path = write_token_file(str(tmp_path / "rows.npy"), rows)
