@@ -886,6 +886,34 @@ def bench_input_pipeline() -> dict:
     return out
 
 
+def bench_pipeline_bubble() -> dict:
+    """Interleaved-1F1B bubble accounting (VERDICT r4 item 5): exact
+    per-device idle from the schedule's own tick arithmetic
+    (``pp_bubble_fraction`` — the compiled scan length IS this T), for
+    the plain vs interleaved schedules at bench-relevant geometry.
+    Schedule math, not wall clock, so it is fabric-independent; the
+    numerics equivalence is pinned by tests/test_pipeline_parallel.py."""
+    from distributeddataparallel_tpu.parallel.pipeline_parallel import (
+        pp_bubble_fraction,
+    )
+
+    out = {}
+    for n, m in ((4, 16), (8, 32)):
+        row = {}
+        for v in (1, 2, 4):
+            b = pp_bubble_fraction(n, m, v)
+            row[f"v{v}"] = {
+                "bubble_fraction": b["bubble_fraction"],
+                "bubble_stage_units": b["bubble_stage_units"],
+            }
+        row["v4_over_v1_bubble"] = round(
+            row["v4"]["bubble_stage_units"] / row["v1"]["bubble_stage_units"],
+            3,
+        )
+        out[f"stages{n}_mb{m}"] = row
+    return out
+
+
 def bench_overlap() -> dict:
     """Comm/compute overlap on the GPT-2 124M DP step (BASELINE config 5's
     "overlap demonstrated"): full step vs compute-only (grad_sync=False,
@@ -973,6 +1001,7 @@ def main() -> None:
     moe = _run(bench_moe_scaling, "moe_scaling")
     cp_ring = _run(bench_cp_ring, "cp_ring")
     overlap = _run(bench_overlap, "overlap")
+    pp_bubble = _run(bench_pipeline_bubble, "pipeline_bubble")
     input_pipe = _run(bench_input_pipeline, "input_pipeline")
     # Config 3's done bar: can the host pipeline feed the device?
     if "host_gather_img_s" in input_pipe and "img_s_chip" in resnet:
@@ -1009,6 +1038,7 @@ def main() -> None:
             "moe_token_choice": moe,
             "cp_ring_block": cp_ring,
             "overlap_gpt2_dp": overlap,
+            "pipeline_1f1b_bubble": pp_bubble,
             "input_pipeline": input_pipe,
         },
     }
@@ -1064,6 +1094,9 @@ def main() -> None:
             ),
             "overlap_real_llama": _sched(
                 overlap.get("real_step_schedule_llama")
+            ),
+            "pp_interleaved_bubble_v4_over_v1": (
+                pp_bubble.get("stages8_mb32", {}).get("v4_over_v1_bubble")
             ),
             "input_host_gather_img_s": input_pipe.get("host_gather_img_s"),
             "input_host_over_device": input_pipe.get("host_over_device"),

@@ -194,8 +194,20 @@ def _shape_bytes(line: str) -> int:
     return n * _DTYPE_BYTES.get(dt, 4)
 
 
-def _parse_events(lines: list[str], ar_comps: set[str]):
-    """One computation's scheduled lines → [(kind, cycles, bytes)]."""
+def _parse_events(
+    lines: list[str],
+    ar_comps: set[str],
+    ar_payload: dict[str, int] | None = None,
+):
+    """One computation's scheduled lines → [(kind, cycles, bytes)].
+
+    ``ar_payload`` maps collective-carrying computation name → the sum
+    of its collectives' RESULT bytes — the payload attribution for
+    collective-carrying fusions, whose own result tuple leads with the
+    fused COMPUTE outputs (using the call-site shape would credit those
+    compute bytes to the collective).
+    """
+    ar_payload = ar_payload or {}
     events: list[tuple[str, int, int]] = []
     for line in lines:
         m = re.search(r"%([\w.\-]+) = ", line)
@@ -217,7 +229,7 @@ def _parse_events(lines: list[str], ar_comps: set[str]):
             events.append(("done", cycles, _shape_bytes(line)))
         elif callee in ar_comps or "async_collective_fusion" in (callee or ""):
             # Compute fused with a collective: overlapped by construction.
-            events.append(("comm_fused", cycles, _shape_bytes(line)))
+            events.append(("comm_fused", cycles, ar_payload.get(callee, 0)))
         elif re.search(r"\ball-reduce\(|\breduce-scatter\(|\ball-gather\(", line):
             events.append(("sync_collective", cycles, _shape_bytes(line)))
         elif re.search(r" (fusion|custom-call|convolution)\(", line):
@@ -303,19 +315,29 @@ def schedule_report(
     """
     comps = _split_computations(hlo_text)
 
-    # Computations that contain a collective op (async wrapper targets).
-    ar_comps: set[str] = {
-        name
-        for name, lines in comps.items()
-        if name != "ENTRY"
-        and any(
+    # Computations that contain a collective op (async wrapper targets),
+    # with the payload bytes of the collectives they carry.
+    ar_comps: set[str] = set()
+    ar_payload: dict[str, int] = {}
+    for name, lines in comps.items():
+        if name == "ENTRY":
+            continue
+        payload = sum(
+            _shape_bytes(l)
+            for l in lines
+            if re.search(
+                r"\ball-reduce\(|\breduce-scatter\(|\ball-gather\(", l
+            )
+        )
+        if payload or any(
             re.search(r"\ball-reduce\(|\breduce-scatter\(|\ball-gather\(", l)
             for l in lines
-        )
-    }
+        ):
+            ar_comps.add(name)
+            ar_payload[name] = payload
 
     entry_lines = comps.get("ENTRY", [])
-    tally = _tally(_parse_events(entry_lines, ar_comps))
+    tally = _tally(_parse_events(entry_lines, ar_comps, ar_payload))
 
     # While bodies reachable from ENTRY (scan-lowered layer loops).
     body_names: list[str] = []
@@ -338,7 +360,7 @@ def schedule_report(
         blines = comps.get(bname)
         if not blines:
             continue
-        btally = _tally(_parse_events(blines, ar_comps))
+        btally = _tally(_parse_events(blines, ar_comps, ar_payload))
         trips = 1
         if while_trip_counts:
             for pat, n in while_trip_counts.items():
@@ -658,6 +680,18 @@ def train_step_schedule_evidence(
         schedule_report(txt, while_trip_counts=trips),
         txt,
         where=f"train_step_schedule_evidence({model})",
+    )
+    # Exact payload accounting: sync collectives execute once each in
+    # the ENTRY schedule, so sync_collective_bytes / gradient-bytes is
+    # exact; async_bytes_frac is approximate (fusion-wrapper clones can
+    # repeat a payload on the async side).
+    grad_bytes = sum(
+        l.size * l.dtype.itemsize
+        for l in jax.tree.leaves(state_sds.params)
+    )
+    rep["grad_bytes"] = grad_bytes
+    rep["async_frac_of_grad_bytes"] = round(
+        max(0.0, 1.0 - rep["sync_collective_bytes"] / grad_bytes), 4
     )
     rep.update(
         {

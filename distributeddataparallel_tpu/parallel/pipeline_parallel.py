@@ -113,23 +113,76 @@ def pp_state_specs(
     )
 
 
+def interleave_layer_perm(L: int, n: int, v: int) -> "np.ndarray":
+    """Storage order of the stacked layer dim for interleaved 1F1B.
+
+    With ``v`` virtual chunks per stage (Megatron-LM interleaved
+    schedule, arXiv 2104.04473 §2.3), stage ``s`` owns the round-robin
+    layer chunks ``{s, s+n, ..., s+(v-1)n}`` (chunk length
+    ``Lc = L/(n·v)``) — non-contiguous in logical layer order.  The
+    stacked dim shards CONTIGUOUSLY over the pipe axis, so placement
+    permutes rows so that position ``s``'s contiguous block is its v
+    chunks in chunk-major order.  Returns ``perm`` with
+    ``stored = logical[perm]``; invert with ``np.argsort(perm)``.
+    """
+    import numpy as np
+
+    Lc = L // (n * v)
+    perm = np.empty((L,), np.int64)
+    i = 0
+    for s in range(n):
+        for c in range(v):
+            base = (c * n + s) * Lc
+            perm[i : i + Lc] = np.arange(base, base + Lc)
+            i += Lc
+    return perm
+
+
 def shard_state_pp(
     state,
     mesh: Mesh,
     axis_name: str = "pipe",
     tp_axis: str | None = None,
     ep_axis: str | None = None,
+    virtual: int = 1,
 ):
     """Place a full TrainState with the stacked layer dim sharded over the
-    pipe axis (the PP analog of ``broadcast_params``)."""
+    pipe axis (the PP analog of ``broadcast_params``).
+
+    ``virtual > 1`` (interleaved 1F1B): the stacked layer dim of every
+    ``layers`` leaf — params AND optimizer state (optax trees embed the
+    param paths) — is stored in ``interleave_layer_perm`` order before
+    placement, so each pipe position's contiguous shard is its v
+    round-robin chunks.  Invert with the perm's argsort when gathering
+    params back to the logical model layout.
+    """
+    import numpy as np
+
     n = mesh.shape[axis_name]
     for path, leaf in jax.tree_util.tree_flatten_with_path(state.params)[0]:
         names = tuple(str(getattr(k, "key", k)) for k in path)
-        if "layers" in names and leaf.shape[0] % n:
+        if "layers" in names and leaf.shape[0] % (n * virtual):
             raise ValueError(
                 f"pipeline: stacked layer dim {leaf.shape[0]} of param "
                 f"{'/'.join(names)} is not divisible by {n} stages"
+                + (f" x {virtual} virtual chunks" if virtual > 1 else "")
             )
+    if virtual > 1:
+        def permute_layers(tree):
+            flat = jax.tree_util.tree_flatten_with_path(tree)
+            out = []
+            for path, leaf in flat[0]:
+                names = tuple(str(getattr(k, "key", k)) for k in path)
+                if "layers" in names and getattr(leaf, "ndim", 0) >= 1:
+                    perm = interleave_layer_perm(leaf.shape[0], n, virtual)
+                    leaf = jnp.asarray(leaf)[np.asarray(perm)]
+                out.append(leaf)
+            return jax.tree.unflatten(flat[1], out)
+
+        state = state.replace(
+            params=permute_layers(state.params),
+            opt_state=permute_layers(state.opt_state),
+        )
     if ep_axis is not None:
         from distributeddataparallel_tpu.parallel.expert_parallel import (
             check_ep_divisibility,
@@ -378,6 +431,7 @@ def _pp_1f1b_loss_and_grads(
     n: int,
     microbatches: int,
     moe_aux_weight: float = 0.0,
+    virtual: int = 1,
 ):
     """1F1B schedule with a MANUAL backward: returns ``(loss, grads)``
     shaped exactly like ``value_and_grad(pp_loss)`` so the surrounding
@@ -425,6 +479,24 @@ def _pp_1f1b_loss_and_grads(
     predicate depends only on the pipe index, so model-axis peers
     always agree — any Megatron collective inside the branch stays
     matched.
+
+    ``virtual > 1`` — INTERLEAVED 1F1B (Megatron arXiv 2104.04473
+    §2.3): each stage holds ``v`` round-robin layer chunks (state
+    placed with ``shard_state_pp(virtual=v)``; ``stack`` is built for
+    chunk length ``L/(n·v)``) and the schedule's unit becomes a
+    (chunk, microbatch) pair.  Microbatches proceed in groups of n;
+    within a group, stage s's F-unit sequence is chunk-major
+    ``(c, m mod n)`` and its B-unit sequence is reverse-chunk-major —
+    the generalization keeps every transfer a +1 (F) / -1 (B) ring hop
+    with one tick of latency, including the wrap that carries chunk c's
+    output from stage n-1 into chunk c+1 on stage 0, so the alternating
+    F/B clock and masked-validity machinery are unchanged.  Fill/drain
+    spans become ``v·n`` chunk-ticks of 1/v stage-work each, shrinking
+    the warm-up/drain bubble per device from ``(n-1)`` stage-units
+    toward ``n/2 + n/(2v)`` — the measured tick accounting is reported
+    by ``pp_bubble_fraction`` and recorded in the bench.  Requires
+    ``num_layers % (n·v) == 0``; the unit ordering needs no divisibility
+    of M (off-group units are masked like any bubble tick).
     """
     from distributeddataparallel_tpu.models.transformer import (
         rope_frequencies,
@@ -493,7 +565,10 @@ def _pp_1f1b_loss_and_grads(
     def embed_fn(eparams, toks):
         return _embed(cfg, eparams, toks, positions)
 
-    n_slots = 2 * n + 1          # in-flight <= 2(n-1); last slot = scratch
+    v = virtual
+    # Chunk length of the LOCAL stacked shard (leaves carry L/n rows;
+    # each of the v chunks is L/(n*v) of them).
+    n_slots = v * 2 * n + 1      # per-chunk 2n ring; last slot = scratch
     saved = jnp.zeros((n_slots, mb_rows, S, cfg.d_model), cfg.dtype)
     fbuf = jnp.zeros((mb_rows, S, cfg.d_model), cfg.dtype)
     bbuf = jnp.zeros((mb_rows, S, cfg.d_model), cfg.dtype)
@@ -511,9 +586,26 @@ def _pp_1f1b_loss_and_grads(
             )
         return out
 
-    n_f_ticks = M + n - 1
-    n_b_ticks = M + 2 * (n - 1)
-    T = max(n_f_ticks, n_b_ticks)
+    def _decode_unit(j):
+        """Unit index -> (chunk, microbatch, valid): groups of n
+        microbatches cycle chunk-major (g asc, c asc, m-offset asc)."""
+        g = j // (n * v)
+        r = j % (n * v)
+        c = r // n
+        m = g * n + (r % n)
+        valid = (j >= 0) & (m < M)
+        return c, m, valid
+
+    def _chunk_params(c):
+        if v == 1:
+            return params["layers"]
+        Lc = jax.tree.leaves(params["layers"])[0].shape[0] // v
+        return jax.tree.map(
+            lambda p: lax.dynamic_slice_in_dim(p, c * Lc, Lc, 0),
+            params["layers"],
+        )
+
+    _, T = _1f1b_ticks(n, M, v)
 
     # One scan iteration = one F-tick + one B-tick (the even/odd clock
     # flattened).  lax.scan, NOT an unrolled python loop, for two
@@ -523,32 +615,32 @@ def _pp_1f1b_loss_and_grads(
     # resurrect the O(M) liveness this schedule exists to kill).
     def tick(carry, i):
         saved, fbuf, bbuf, gacc, loss_acc, aux_acc = carry
-        # --- F-tick i: stage s runs forward of microbatch i - s -------
+        # --- F-tick i: stage s runs forward of unit i - s --------------
         # (0 <= m < M subsumes the tick-range bound: i < T implies the
-        # per-stage microbatch index is already past M when off-schedule)
-        m = i - s
-        valid = (m >= 0) & (m < M)
-        mc = jnp.clip(m, 0, M - 1)
+        # per-stage unit index is already past the last unit when
+        # off-schedule)
+        cf, mf, valid = _decode_unit(i - s)
+        mc = jnp.clip(mf, 0, M - 1)
         toks = lax.dynamic_index_in_dim(mbs_in, mc, 0, keepdims=False)
-        x = jnp.where(s == 0, embed_fn(params, toks), fbuf)
-        slot = jnp.where(valid, mc % (2 * n), 2 * n)
+        x = jnp.where((s == 0) & (cf == 0), embed_fn(params, toks), fbuf)
+        slot = jnp.where(valid, cf * (2 * n) + mc % (2 * n), v * 2 * n)
         saved = lax.dynamic_update_slice_in_dim(saved, x[None], slot, 0)
-        fbuf = lax.ppermute(stage_fn(params["layers"], x), pp_axis, perm_f)
-        # --- B-tick i: stage s runs backward of mb i - 2(n-1) + s -----
-        m = i - 2 * (n - 1) + s
-        valid = (m >= 0) & (m < M)
-        mc = jnp.clip(m, 0, M - 1)
-        slot = jnp.where(valid, mc % (2 * n), 2 * n)
+        fbuf = lax.ppermute(stage_fn(_chunk_params(cf), x), pp_axis, perm_f)
+        # --- B-tick i: stage s runs backward of unit
+        #     i - (vn - 1) - (n - 1 - s), chunks in REVERSE order -------
+        cb, mb_, valid = _decode_unit(i - (v * n - 1) - (n - 1 - s))
+        cb = v - 1 - cb
+        mc = jnp.clip(mb_, 0, M - 1)
+        slot = jnp.where(valid, cb * (2 * n) + mc % (2 * n), v * 2 * n)
         xb = lax.dynamic_index_in_dim(saved, slot, 0, keepdims=False)
+        chunk_p = _chunk_params(cb)
         if use_aux:
-            (y, aux), stage_vjp = jax.vjp(
-                stage_fn_aux, params["layers"], xb
-            )
+            (y, aux), stage_vjp = jax.vjp(stage_fn_aux, chunk_p, xb)
         else:
-            y, stage_vjp = jax.vjp(stage_fn, params["layers"], xb)
+            y, stage_vjp = jax.vjp(stage_fn, chunk_p, xb)
             aux = jnp.zeros((), jnp.float32)
         tgt = lax.dynamic_index_in_dim(mbs_tgt, mc, 0, keepdims=False)
-        on_last = (s == n - 1)
+        on_last = (s == n - 1) & (cb == v - 1)
         head_params = {kk: params[kk] for kk in head_keys}
 
         # Gated head vjp (ADVICE r3): only the last stage pays the d×V
@@ -574,10 +666,11 @@ def _pp_1f1b_loss_and_grads(
         if use_aux:
             # The aux output's cotangent: GPipe adds
             # moe_aux_weight * psum(aux_acc) / (n*M) to the loss, so
-            # every valid (stage, microbatch) aux value carries this
-            # constant derivative.  Invalid ticks are masked by w below.
+            # every valid (stage-chunk, microbatch) aux value carries
+            # this constant derivative (v·n·M units in total).  Invalid
+            # ticks are masked by w below.
             dlayers, dx = stage_vjp(
-                (gy, jnp.asarray(moe_aux_weight / (n * M), aux.dtype))
+                (gy, jnp.asarray(moe_aux_weight / (n * v * M), aux.dtype))
             )
         else:
             dlayers, dx = stage_vjp(gy)
@@ -598,9 +691,23 @@ def _pp_1f1b_loss_and_grads(
                 jax.eval_shape(do_embed, dx_),
             )
 
-        dep = lax.cond(s == 0, do_embed, skip_embed, dx)
+        dep = lax.cond((s == 0) & (cb == 0), do_embed, skip_embed, dx)
         w = valid.astype(jnp.float32)
-        gacc = _acc(gacc, ("layers",), {"layers": dlayers}, w)
+        if v == 1:
+            gacc = _acc(gacc, ("layers",), {"layers": dlayers}, w)
+        else:
+            Lc = jax.tree.leaves(params["layers"])[0].shape[0] // v
+
+            def _upd_chunk(a, g):
+                cur = lax.dynamic_slice_in_dim(a, cb * Lc, Lc, 0)
+                return lax.dynamic_update_slice_in_dim(
+                    a, cur + g.astype(a.dtype) * w, cb * Lc, 0
+                )
+
+            gacc = {
+                **gacc,
+                "layers": jax.tree.map(_upd_chunk, gacc["layers"], dlayers),
+            }
         gacc = _acc(gacc, head_keys, dhp, w * on_last.astype(jnp.float32))
         gacc = _acc(gacc, embed_keys, dep, w)
         loss_acc = loss_acc + jnp.where(valid & on_last, lval, 0.0)
@@ -619,12 +726,54 @@ def _pp_1f1b_loss_and_grads(
     # irrelevant here (no AD through this), plain psum replicates it.
     loss = lax.psum(loss_acc, pp_axis) / M
     if use_aux:
-        # Mirror pp_loss: per-stage aux summed over the pipe, averaged
-        # over stages × microbatches.
+        # Mirror pp_loss: per-stage-chunk aux summed over the pipe,
+        # averaged over stage-chunks × microbatches.
         loss = loss + moe_aux_weight * (
-            lax.psum(aux_acc, pp_axis) / (n * M)
+            lax.psum(aux_acc, pp_axis) / (n * v * M)
         )
     return loss, gacc
+
+
+def _1f1b_ticks(n: int, M: int, v: int) -> tuple[int, int]:
+    """(last valid unit index, scan length T) of the 1F1B schedule —
+    THE tick arithmetic, shared by the compiled schedule
+    (``_pp_1f1b_loss_and_grads``) and the bubble accounting
+    (``pp_bubble_fraction``) so the reported number cannot drift from
+    the schedule that runs."""
+    # Last VALID unit (m = M-1, c = v-1); off-group units past it are
+    # bubbles anyway.
+    j_last = ((M - 1) // n) * n * v + (v - 1) * n + (M - 1) % n
+    # F span ends at j_last + (n-1); B span at (vn-1) + (n-1) + j_last.
+    return j_last, j_last + v * n + n - 1
+
+
+def pp_bubble_fraction(
+    n: int, microbatches: int, virtual: int = 1
+) -> dict:
+    """Exact tick accounting of the 1F1B schedule's pipeline bubble.
+
+    The scan runs ``T`` iterations; each executes one F-unit and one
+    B-unit slot of ``1/virtual`` stage-work each, masked off-schedule.
+    Useful work per device = ``2·M·virtual`` unit-slots out of ``2·T``
+    — the rest is bubble (warm-up/drain idle).  ``T`` comes from
+    ``_1f1b_ticks``, the same arithmetic the compiled schedule uses, so
+    the number IS the schedule, not an estimate; the bench records it
+    next to the wall-clock step times.
+    """
+    M, v = microbatches, virtual
+    _, T = _1f1b_ticks(n, M, v)
+    useful = 2 * M * v
+    total = 2 * T
+    return {
+        "n_stages": n,
+        "microbatches": M,
+        "virtual": v,
+        "ticks": T,
+        "bubble_fraction": round((total - useful) / total, 4),
+        # per-device idle in full-stage-compute units (ticks are 1/v of
+        # a stage): the cross-virtual-degree comparable number.
+        "bubble_stage_units": round((total - useful) / (2 * v), 4),
+    }
 
 
 def make_pp_train_step(
@@ -640,8 +789,16 @@ def make_pp_train_step(
     zero: bool = False,
     schedule: str = "gpipe",
     grad_clip: float | None = None,
+    virtual: int = 1,
 ):
     """Compiled DP x PP train step for a scanned TransformerLM config.
+
+    ``virtual > 1`` selects INTERLEAVED 1F1B (v layer chunks per stage;
+    state must be placed with ``shard_state_pp(virtual=v)`` so each pipe
+    position's contiguous rows are its round-robin chunks).  Requires
+    ``schedule="1f1b"`` and ``num_layers % (n_stages · v) == 0``; see
+    ``_pp_1f1b_loss_and_grads`` for the schedule and
+    ``pp_bubble_fraction`` for the measured bubble accounting.
 
     ``zero=True``: ZeRO-1 over the data axis on the PIPE-LOCAL param
     shards — after the pipe psum completes every gradient, each
@@ -696,9 +853,16 @@ def make_pp_train_step(
         raise ValueError("grad_clip requires grad_sync=True")
     if schedule not in ("gpipe", "1f1b"):
         raise ValueError(f"unknown pipeline schedule {schedule!r}")
+    if virtual < 1:
+        raise ValueError(f"virtual must be >= 1, got {virtual}")
+    if virtual > 1 and schedule != "1f1b":
+        raise ValueError(
+            "virtual (interleaved) stages require schedule='1f1b' — the "
+            "GPipe path runs whole contiguous stages"
+        )
     n_stages = mesh.shape[pp_axis]
     M = microbatches
-    stack = _stage_stack(cfg, n_stages)
+    stack = _stage_stack(cfg, n_stages * virtual)
 
     def pp_loss(params, inputs, targets):
         """inputs/targets: (B_local, S_local) — the next-token shift
@@ -795,7 +959,7 @@ def make_pp_train_step(
             loss, grads = _pp_1f1b_loss_and_grads(
                 cfg, stack, state.params, inputs, targets,
                 pp_axis=pp_axis, n=n_stages, microbatches=M,
-                moe_aux_weight=moe_aux_weight,
+                moe_aux_weight=moe_aux_weight, virtual=virtual,
             )
         else:
             loss, grads = jax.value_and_grad(pp_loss)(

@@ -21,14 +21,17 @@ Replicated layouts (plain DP, and the TP/EP/PP param layouts whose
 GLOBAL shapes are N-independent) reshard for free — orbax re-slices to
 whatever sharding the restore template carries.
 
-Scope: ``zero1`` and ``fsdp`` both reshard across the data degree AND
-the Megatron TP degree.  The segmented flats round-trip host-side
+Scope: ``fsdp`` reshards across the data degree AND the Megatron TP
+degree; ``zero1`` reshards across the data degree AND any of its model
+axes — Megatron TP, expert EP, pipeline PP (stage-count changes
+included), alone or combined.  The segmented flats round-trip host-side
 through full leaves — FSDP via ``_Meta.unflatten_full`` at the old
-geometry / ``flatten_full`` at the new; ZeRO-1 by reassembling each tp
-position's (data, tp)-interleaved local flat, concatenating Megatron
-dims back to full leaves, and re-slicing/re-interleaving.  The mapping
-is linear and positional, so the same transform transports the Adam
-moment flats exactly.  ZeRO-1 x EP/PP flats keep the loud rejection.
+geometry / ``flatten_full`` at the new; ZeRO-1 by reassembling each
+model position's (data, position)-interleaved local flat, reassembling
+full leaves along their sharded dims, and re-slicing/re-interleaving at
+the new topology (``_reshard_zero_model_flat``).  The mapping is linear
+and positional, so the same transform transports the Adam moment flats
+exactly.
 """
 
 from __future__ import annotations
@@ -47,19 +50,34 @@ def topology_meta(
     layout: str,
     data_axis: str = "data",
     tp_axis: str | None = None,
+    ep_axis: str | None = None,
+    pp_axis: str | None = None,
+    pp_virtual: int = 1,
 ) -> dict:
-    """The sidecar dict ``Checkpointer.save(meta=...)`` records."""
+    """The sidecar dict ``Checkpointer.save(meta=...)`` records.
+
+    ``pp_virtual``: interleaved-1F1B virtual chunk degree — the layer
+    STORAGE ORDER bakes it in (``shard_state_pp(virtual=)``), so a
+    restore at a different (pp, virtual) geometry must be rejected even
+    for the otherwise N-independent replicated layout.
+    """
     meta = {
         "layout": layout,
         "n_data": int(mesh.shape[data_axis]),
-        # Always recorded (1 when no tp axis): a sidecar MISSING n_tp is
-        # a legacy (pre-tp-awareness) save, which elastic_restore treats
-        # as same-tp-as-current — preserving the exact-topology restore
-        # those checkpoints were limited to.
+        # Always recorded (1 when no such axis): a sidecar MISSING a
+        # degree key is a legacy (pre-awareness) save, which
+        # elastic_restore treats as same-degree-as-current — preserving
+        # the exact-topology restore those checkpoints were limited to.
         "n_tp": int(mesh.shape[tp_axis]) if tp_axis is not None else 1,
+        "n_ep": int(mesh.shape[ep_axis]) if ep_axis is not None else 1,
+        "n_pp": int(mesh.shape[pp_axis]) if pp_axis is not None else 1,
+        "n_virtual": int(pp_virtual),
     }
-    if tp_axis is not None:
-        meta["tp_axis"] = tp_axis
+    for key, ax in (
+        ("tp_axis", tp_axis), ("ep_axis", ep_axis), ("pp_axis", pp_axis),
+    ):
+        if ax is not None:
+            meta[key] = ax
     return meta
 
 
@@ -70,98 +88,134 @@ def _repad(arr: np.ndarray, true: int, padded_new: int) -> np.ndarray:
     return np.pad(kept, pad)
 
 
-def _zero_tp_geometry(params: Pytree, tp_axis: str) -> list:
-    """Per-leaf (global_shape, megatron_dim | None) in canonical leaf
-    order — the static facts the ZeRO x TP flat reshard needs.  The
-    Megatron dim comes from the SAME spec rule the layout was built with
-    (zero._param_specs), so the reshard cannot drift from the state."""
+def _zero_model_geometry(
+    params: Pytree,
+    tp_axis: str | None,
+    ep_axis: str | None = None,
+    pp_axis: str | None = None,
+) -> list:
+    """Per-leaf ``(global_shape, {dim: axis_name})`` in canonical leaf
+    order — the static facts the ZeRO x model-axes flat reshard needs.
+    The sharded dims come from the SAME spec rule the layout was built
+    with (zero._param_specs, which routes through the Megatron / expert /
+    pipeline spec sources), so the reshard cannot drift from the state."""
     from jax.sharding import PartitionSpec
 
     from distributeddataparallel_tpu.parallel.zero import _param_specs
 
-    specs = _param_specs(params, tp_axis)
+    specs = _param_specs(params, tp_axis, ep_axis, pp_axis)
     geom = []
     for leaf, sp in zip(
         jax.tree.leaves(params),
         jax.tree.leaves(specs, is_leaf=lambda x: isinstance(x, PartitionSpec)),
     ):
-        mdim = None
+        dims: dict[int, str] = {}
         for dim, entry in enumerate(tuple(sp)):
             names = entry if isinstance(entry, tuple) else (entry,)
-            if tp_axis in [n for n in names if n is not None]:
-                mdim = dim
-                break
-        geom.append((tuple(leaf.shape), mdim))
+            for nm in names:
+                if nm is not None:
+                    dims[dim] = nm
+        geom.append((tuple(leaf.shape), dims))
     return geom
 
 
-def _zero_tp_sizes(geom: list, n: int, n_tp: int) -> tuple[int, int]:
-    """(local_total, chunk) for one tp position's flat at (n, n_tp)."""
+def _zero_sizes(geom: list, n: int, axn: dict) -> tuple[int, int]:
+    """(local_total, chunk) for one model position's flat at data degree
+    ``n`` and model-axis degrees ``axn`` ({axis_name: size})."""
     total = 0
-    for shape, mdim in geom:
+    for shape, dims in geom:
         size = int(np.prod(shape)) if shape else 1
-        if mdim is not None:
-            size //= n_tp
+        for dim, ax in dims.items():
+            size //= axn.get(ax, 1)
         total += size
     return total, -(-total // n)
 
 
-def _reshard_zero_tp_flat(
+def _reshard_zero_model_flat(
     flat_old: np.ndarray,
     geom: list,
-    n_old: int, n_tp_old: int, chunk_old: int, local_total_old: int,
-    n_new: int, n_tp_new: int, chunk_new: int,
+    order: list,
+    n_old: int, axn_old: dict, chunk_old: int, local_total_old: int,
+    n_new: int, axn_new: dict, chunk_new: int,
 ) -> np.ndarray:
-    """One ZeRO x TP opt flat: (data, tp)-interleaved local chunks at the
-    old topology -> the same at the new."""
-    # 1. Reassemble each old tp position's local flat (drop tail pad).
+    """One ZeRO x model-axes opt flat: (data, model-position)-interleaved
+    local chunks at the old topology -> the same at the new.
+
+    ``order`` is the model-axis name sequence of the flat's
+    PartitionSpec (zero._leaf_spec: data, then tp, ep, pp as present) —
+    blocks interleave row-major over (data, *order), so position ``j``
+    enumerates the product of ``order``'s degrees.  Axes at degree 1
+    participate with size 1, which makes the pure-TP, pure-EP, pure-PP
+    and combined cases one code path.
+    """
+    def sizes(axn):
+        return [max(int(axn.get(ax, 1)), 1) for ax in order]
+
+    def midx(j, szs):
+        out = []
+        for s in reversed(szs):
+            out.append(j % s)
+            j //= s
+        return list(reversed(out))
+
+    sz_old = sizes(axn_old)
+    m_old = int(np.prod(sz_old)) if sz_old else 1
+    axidx = {ax: i for i, ax in enumerate(order)}
+
+    # 1. Reassemble each old model position's local flat (drop tail pad).
     locals_old = []
-    for j in range(n_tp_old):
+    for j in range(m_old):
         parts = [
-            flat_old[(d * n_tp_old + j) * chunk_old
-                     : (d * n_tp_old + j + 1) * chunk_old]
+            flat_old[(d * m_old + j) * chunk_old
+                     : (d * m_old + j + 1) * chunk_old]
             for d in range(n_old)
         ]
         locals_old.append(np.concatenate(parts)[:local_total_old])
+
+    def leaf_slice(shape, dims, mi, axn):
+        """Per-dim slices of one position's local shard in the full leaf.
+        Positions differing only on axes that do NOT shard this leaf hold
+        identical copies (write idempotent / read any)."""
+        sl = [slice(None)] * len(shape)
+        for dim, ax in dims.items():
+            nax = max(int(axn.get(ax, 1)), 1)
+            k = mi[axidx[ax]] if ax in axidx else 0
+            step = shape[dim] // nax
+            sl[dim] = slice(k * step, (k + 1) * step)
+        return tuple(sl)
+
     # 2. Unflatten each local flat and reassemble FULL leaves.
     full = []
-    offs = [0] * n_tp_old
-    for shape, mdim in geom:
-        if mdim is None:
-            size = int(np.prod(shape)) if shape else 1
-            full.append(
-                locals_old[0][offs[0]: offs[0] + size].reshape(shape)
+    offs = [0] * m_old
+    for shape, dims in geom:
+        lshape = list(shape)
+        for dim, ax in dims.items():
+            lshape[dim] //= max(int(axn_old.get(ax, 1)), 1)
+        size = int(np.prod(lshape)) if lshape else 1
+        arr = np.zeros(shape, flat_old.dtype)
+        for j in range(m_old):
+            mi = midx(j, sz_old)
+            arr[leaf_slice(shape, dims, mi, axn_old)] = (
+                locals_old[j][offs[j]: offs[j] + size].reshape(lshape)
             )
-            for j in range(n_tp_old):
-                offs[j] += size
-        else:
-            lshape = list(shape)
-            lshape[mdim] //= n_tp_old
-            size = int(np.prod(lshape))
-            shards = []
-            for j in range(n_tp_old):
-                shards.append(
-                    locals_old[j][offs[j]: offs[j] + size].reshape(lshape)
-                )
-                offs[j] += size
-            full.append(np.concatenate(shards, axis=mdim))
-    # 3. Re-slice for the new tp positions, flatten, pad, interleave.
-    out = np.zeros((chunk_new * n_new * n_tp_new,), flat_old.dtype)
-    for j in range(n_tp_new):
-        pieces = []
-        for (shape, mdim), leaf in zip(geom, full):
-            if mdim is None:
-                pieces.append(leaf.reshape(-1))
-            else:
-                size = shape[mdim] // n_tp_new
-                sl = [slice(None)] * len(shape)
-                sl[mdim] = slice(j * size, (j + 1) * size)
-                pieces.append(leaf[tuple(sl)].reshape(-1))
+            offs[j] += size
+        full.append(arr)
+
+    # 3. Re-slice for the new positions, flatten, pad, interleave.
+    sz_new = sizes(axn_new)
+    m_new = int(np.prod(sz_new)) if sz_new else 1
+    out = np.zeros((chunk_new * n_new * m_new,), flat_old.dtype)
+    for j in range(m_new):
+        mi = midx(j, sz_new)
+        pieces = [
+            leaf[leaf_slice(shape, dims, mi, axn_new)].reshape(-1)
+            for (shape, dims), leaf in zip(geom, full)
+        ]
         loc = np.concatenate(pieces)
         loc = np.pad(loc, (0, chunk_new * n_new - loc.size))
         for d in range(n_new):
-            out[(d * n_tp_new + j) * chunk_new
-                : (d * n_tp_new + j + 1) * chunk_new] = (
+            out[(d * m_new + j) * chunk_new
+                : (d * m_new + j + 1) * chunk_new] = (
                 loc[d * chunk_new: (d + 1) * chunk_new]
             )
     return out
@@ -176,6 +230,9 @@ def elastic_restore(
     cfg=None,
     data_axis: str = "data",
     tp_axis: str | None = None,
+    ep_axis: str | None = None,
+    pp_axis: str | None = None,
+    pp_virtual: int = 1,
     allow_reshard: bool = True,
 ) -> tuple[Pytree, int]:
     """Restore the latest checkpoint into ``state`` (built for THIS
@@ -203,11 +260,35 @@ def elastic_restore(
     n_new = int(mesh.shape[data_axis])
     n_old = (meta or {}).get("n_data", n_new)
     n_tp_new = int(mesh.shape[tp_axis]) if tp_axis is not None else 1
-    # Legacy sidecars (no n_tp key) predate tp-aware resharding and could
-    # only ever be resumed at the identical topology — assume the current
-    # run's degree so they keep taking the exact-restore path.
+    n_ep_new = int(mesh.shape[ep_axis]) if ep_axis is not None else 1
+    n_pp_new = int(mesh.shape[pp_axis]) if pp_axis is not None else 1
+    # Legacy sidecars (no n_tp/n_ep/n_pp key) predate axis-aware
+    # resharding and could only ever be resumed at the identical
+    # topology — assume the current run's degree so they keep taking the
+    # exact-restore path.
     n_tp_old = int((meta or {}).get("n_tp", n_tp_new))
-    if (n_old == n_new and n_tp_old == n_tp_new) or layout == "replicated":
+    n_ep_old = int((meta or {}).get("n_ep", n_ep_new))
+    n_pp_old = int((meta or {}).get("n_pp", n_pp_new))
+    same_model_axes = (
+        n_tp_old == n_tp_new and n_ep_old == n_ep_new
+        and n_pp_old == n_pp_new
+    )
+    # Interleaved-1F1B layer-storage order depends on (pp, virtual): a
+    # geometry change re-permutes ROW MEANING, which no re-slice can fix
+    # — reject before any restore path, replicated included (VERDICT-r5
+    # review finding; legacy sidecars without the key restore only at
+    # the degree they were saved with, i.e. the current one).
+    n_virtual_old = int((meta or {}).get("n_virtual", pp_virtual))
+    if n_virtual_old != pp_virtual or (
+        pp_virtual > 1 and n_pp_old != n_pp_new
+    ):
+        raise ValueError(
+            f"checkpoint layer storage is interleaved for (pp={n_pp_old}, "
+            f"virtual={n_virtual_old}) but this run is (pp={n_pp_new}, "
+            f"virtual={pp_virtual}) — interleaved layouts resume only at "
+            "their exact pipeline geometry"
+        )
+    if (n_old == n_new and same_model_axes) or layout == "replicated":
         # Same chunking (or N-independent global shapes): exact-topology
         # restore regardless of layout — orbax re-slices to the
         # template's shardings on its own.
@@ -222,7 +303,12 @@ def elastic_restore(
     if layout == "zero1":
         from distributeddataparallel_tpu.parallel.zero import flat_size
 
-        if n_tp_old == 1 and n_tp_new == 1:
+        no_model_axes = (
+            n_tp_old == n_tp_new == 1
+            and n_ep_old == n_ep_new == 1
+            and n_pp_old == n_pp_new == 1
+        )
+        if no_model_axes:
             true = sum(l.size for l in jax.tree.leaves(state.params))
             padded_new, _ = flat_size(state.params, n_new)
             padded_old, _ = flat_size(state.params, n_old)
@@ -238,21 +324,42 @@ def elastic_restore(
                 return _repad(old_arr, true, padded_new)
 
         else:
-            # ZeRO-1 x Megatron TP: params carry N-independent GLOBAL
-            # shapes (orbax re-slices them), but each opt-state flat
-            # interleaves (data, tp) blocks of each tp position's LOCAL
-            # param shard.  Reshard = reassemble per-position local
-            # flats, unflatten into the local leaf shards, concatenate
-            # Megatron dims back to full leaves (replicated leaves: any
-            # position's copy), then re-slice/re-flatten/re-interleave
-            # at the new topology.  Linear and positional, so it
-            # transports Adam moments exactly.
-            old_axis = (meta or {}).get("tp_axis") or tp_axis
-            geom = _zero_tp_geometry(state.params, old_axis)
-            lt_old, chunk_old = _zero_tp_sizes(geom, n_old, n_tp_old)
-            lt_new, chunk_new = _zero_tp_sizes(geom, n_new, n_tp_new)
-            w_old = chunk_old * n_old * n_tp_old
-            w_new = chunk_new * n_new * n_tp_new
+            # ZeRO-1 x Megatron TP / expert EP / pipeline PP: params
+            # carry N-independent GLOBAL shapes (orbax re-slices them),
+            # but each opt-state flat interleaves (data, model-position)
+            # blocks of each position's LOCAL param shard.  Reshard =
+            # reassemble per-position local flats, unflatten into the
+            # local leaf shards, reassemble FULL leaves (sharded dims
+            # concatenate; replicated leaves: any position's copy), then
+            # re-slice/re-flatten/re-interleave at the new topology.
+            # Linear and positional, so it transports Adam moments
+            # exactly.  Covers degree changes of ANY of the model axes
+            # (and the data axis) in one mechanism — tp 2<->4, ep 2<->1,
+            # pp 4->2 stage-count changes all take this path.
+            tp_name = (meta or {}).get("tp_axis") or tp_axis
+            ep_name = (meta or {}).get("ep_axis") or ep_axis
+            pp_name = (meta or {}).get("pp_axis") or pp_axis
+            order = [a for a in (tp_name, ep_name, pp_name)
+                     if a is not None]
+            geom = _zero_model_geometry(
+                state.params, tp_name, ep_name, pp_name
+            )
+            axn_old = {}
+            axn_new = {}
+            for name, o, nw in (
+                (tp_name, n_tp_old, n_tp_new),
+                (ep_name, n_ep_old, n_ep_new),
+                (pp_name, n_pp_old, n_pp_new),
+            ):
+                if name is not None:
+                    axn_old[name] = o
+                    axn_new[name] = nw
+            lt_old, chunk_old = _zero_sizes(geom, n_old, axn_old)
+            lt_new, chunk_new = _zero_sizes(geom, n_new, axn_new)
+            m_old = int(np.prod([axn_old[a] for a in order])) if order else 1
+            m_new = int(np.prod([axn_new[a] for a in order])) if order else 1
+            w_old = chunk_old * n_old * m_old
+            w_new = chunk_new * n_new * m_new
 
             def old_shape(leaf):
                 if leaf.ndim == 1 and leaf.size == w_new:
@@ -262,10 +369,10 @@ def elastic_restore(
             def rebuild(old_arr, leaf):
                 if old_arr.shape == leaf.shape:
                     return old_arr
-                return _reshard_zero_tp_flat(
-                    old_arr, geom,
-                    n_old, n_tp_old, chunk_old, lt_old,
-                    n_new, n_tp_new, chunk_new,
+                return _reshard_zero_model_flat(
+                    old_arr, geom, order,
+                    n_old, axn_old, chunk_old, lt_old,
+                    n_new, axn_new, chunk_new,
                 )
 
     elif layout == "fsdp":

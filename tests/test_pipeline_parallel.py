@@ -771,3 +771,116 @@ def test_entrypoint_pp_1f1b_cli(devices):
     )
     loss = dpp.train(args)
     assert loss == loss
+
+
+def test_interleaved_1f1b_matches_single_device(devices):
+    """Interleaved 1F1B (virtual=2): same loss and params as the
+    single-device reference step — the round-robin chunk schedule and
+    the layer-permutation placement are pure schedule/layout changes
+    (VERDICT r4 item 5)."""
+    import numpy as _np
+
+    from distributeddataparallel_tpu.parallel.pipeline_parallel import (
+        interleave_layer_perm,
+    )
+
+    cfg = _scan_cfg(num_layers=8)
+    n, v = 2, 2
+    mesh = ddp.make_mesh(("data", "pipe"), shape=(4, n))
+    params = TransformerLM(cfg).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 32), jnp.int32)
+    )["params"]
+    tx = optax.sgd(0.1)
+    rng = _np.random.default_rng(0)
+    tokens = rng.integers(0, 256, size=(16, 33)).astype(_np.int32)
+
+    ref_loss, ref_params = _reference_step(cfg, params, tokens, tx)
+
+    state = ddp.TrainState.create(apply_fn=None, params=params, tx=tx)
+    state = shard_state_pp(state, mesh, virtual=v)
+    step = make_pp_train_step(
+        cfg, mesh=mesh, microbatches=4, donate=False, schedule="1f1b",
+        virtual=v,
+    )
+    batch = shard_batch({"tokens": tokens}, mesh)
+    state, metrics = step(state, batch, jax.random.PRNGKey(0))
+
+    assert float(metrics["loss"]) == pytest.approx(ref_loss, rel=1e-5)
+    inv = _np.argsort(interleave_layer_perm(cfg.num_layers, n, v))
+    for (path, a), b in zip(
+        jax.tree_util.tree_flatten_with_path(state.params)[0],
+        jax.tree.leaves(ref_params),
+    ):
+        names = tuple(str(getattr(k, "key", k)) for k in path)
+        a = _np.asarray(a)
+        if "layers" in names:
+            a = a[inv]  # storage (interleaved) -> logical layer order
+        _np.testing.assert_allclose(
+            a, _np.asarray(b), atol=2e-5,
+            err_msg="/".join(names),
+        )
+
+
+def test_interleaved_1f1b_multi_step_matches_gpipe(devices):
+    """3 training steps of interleaved 1F1B track GPipe's loss curve
+    (same logical model, different schedule + storage layout)."""
+    import numpy as _np
+
+    cfg = _scan_cfg(num_layers=4)
+    n, v = 2, 2
+    mesh = ddp.make_mesh(("data", "pipe"), shape=(4, n))
+    params = TransformerLM(cfg).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 32), jnp.int32)
+    )["params"]
+    tx = optax.adam(1e-2)
+    rng = _np.random.default_rng(1)
+    batches = [
+        rng.integers(0, 256, size=(16, 33)).astype(_np.int32)
+        for _ in range(3)
+    ]
+
+    g_state = ddp.TrainState.create(apply_fn=None, params=params, tx=tx)
+    g_state = shard_state_pp(g_state, mesh)
+    g_step = make_pp_train_step(cfg, mesh=mesh, microbatches=4,
+                                donate=False)
+
+    i_state = ddp.TrainState.create(apply_fn=None, params=params, tx=tx)
+    i_state = shard_state_pp(i_state, mesh, virtual=v)
+    i_step = make_pp_train_step(
+        cfg, mesh=mesh, microbatches=4, donate=False, schedule="1f1b",
+        virtual=v,
+    )
+
+    for t in batches:
+        b = shard_batch({"tokens": t}, mesh)
+        g_state, gm = g_step(g_state, b, jax.random.PRNGKey(0))
+        i_state, im = i_step(i_state, b, jax.random.PRNGKey(0))
+        assert float(im["loss"]) == pytest.approx(
+            float(gm["loss"]), rel=2e-5
+        )
+
+
+def test_interleaved_requires_1f1b_and_divisibility(devices):
+    from distributeddataparallel_tpu.parallel.pipeline_parallel import (
+        pp_bubble_fraction,
+    )
+
+    cfg = _scan_cfg(num_layers=4)
+    mesh = ddp.make_mesh(("data", "pipe"), shape=(4, 2))
+    with pytest.raises(ValueError, match="1f1b"):
+        make_pp_train_step(cfg, mesh=mesh, microbatches=2, virtual=2)
+    # 4 layers cannot split into 2 stages x 4 chunks
+    with pytest.raises(ValueError, match="divisible"):
+        make_pp_train_step(
+            cfg, mesh=mesh, microbatches=2, schedule="1f1b", virtual=4
+        )
+    # bubble accounting: v=1 reproduces the classic 2(n-1) idle units,
+    # higher v strictly shrinks it
+    b1 = pp_bubble_fraction(4, 8, 1)
+    b2 = pp_bubble_fraction(4, 8, 2)
+    b4 = pp_bubble_fraction(4, 8, 4)
+    assert b1["bubble_stage_units"] == 2 * (4 - 1)
+    assert (
+        b4["bubble_stage_units"] < b2["bubble_stage_units"]
+        < b1["bubble_stage_units"]
+    )
