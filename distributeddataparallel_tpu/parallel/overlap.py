@@ -322,19 +322,16 @@ def schedule_report(
     for name, lines in comps.items():
         if name == "ENTRY":
             continue
-        payload = sum(
-            _shape_bytes(l)
+        hits = [
+            l
             for l in lines
             if re.search(
                 r"\ball-reduce\(|\breduce-scatter\(|\ball-gather\(", l
             )
-        )
-        if payload or any(
-            re.search(r"\ball-reduce\(|\breduce-scatter\(|\ball-gather\(", l)
-            for l in lines
-        ):
+        ]
+        if hits:  # collective-carrying even when no shape parses (0 B)
             ar_comps.add(name)
-            ar_payload[name] = payload
+            ar_payload[name] = sum(_shape_bytes(l) for l in hits)
 
     entry_lines = comps.get("ENTRY", [])
     tally = _tally(_parse_events(entry_lines, ar_comps, ar_payload))

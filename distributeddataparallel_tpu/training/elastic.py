@@ -194,6 +194,12 @@ def _reshard_zero_model_flat(
         size = int(np.prod(lshape)) if lshape else 1
         arr = np.zeros(shape, flat_old.dtype)
         for j in range(m_old):
+            # Replicated leaves (no sharded dims) are identical in every
+            # position's flat — one write suffices; offsets still advance
+            # past each position's copy.
+            if not dims and j > 0:
+                offs[j] += size
+                continue
             mi = midx(j, sz_old)
             arr[leaf_slice(shape, dims, mi, axn_old)] = (
                 locals_old[j][offs[j]: offs[j] + size].reshape(lshape)
@@ -275,10 +281,12 @@ def elastic_restore(
     )
     # Interleaved-1F1B layer-storage order depends on (pp, virtual): a
     # geometry change re-permutes ROW MEANING, which no re-slice can fix
-    # — reject before any restore path, replicated included (VERDICT-r5
-    # review finding; legacy sidecars without the key restore only at
-    # the degree they were saved with, i.e. the current one).
-    n_virtual_old = int((meta or {}).get("n_virtual", pp_virtual))
+    # — reject before any restore path, replicated included.  Sidecars
+    # without the key predate interleaving entirely, so they are
+    # contiguous = virtual 1 (defaulting to the CURRENT run's degree
+    # would let a legacy save slip into an interleaved run with its rows
+    # silently re-interpreted).
+    n_virtual_old = int((meta or {}).get("n_virtual", 1))
     if n_virtual_old != pp_virtual or (
         pp_virtual > 1 and n_pp_old != n_pp_new
     ):

@@ -447,7 +447,7 @@ def make_train_step(
     # structure — build on first call (jit caches thereafter).
     compiled = None
 
-    def step(state: TrainState, batch: Pytree, rng: jax.Array):
+    def _build(state: TrainState):
         nonlocal compiled
         if compiled is None:
             if zero:
@@ -470,7 +470,17 @@ def make_train_step(
                 check_vma=False,
             )
             compiled = jax.jit(sharded, **jit_kwargs)
-        return compiled(state, batch, rng)
+        return compiled
+
+    def step(state: TrainState, batch: Pytree, rng: jax.Array):
+        return _build(state)(state, batch, rng)
+
+    # AOT access to the SAME jit (specs included): evidence harnesses
+    # lower the real step for a multi-chip TPU topology with abstract
+    # state (parallel.expert_parallel.ep_memory_evidence).
+    step.lower = lambda state, batch, rng: _build(state).lower(
+        state, batch, rng
+    )
 
     return step
 

@@ -392,9 +392,14 @@ def validate_args(args) -> None:
     if args.generate:
         if not is_lm(args):
             raise SystemExit("--generate requires an LM model")
-        if args.tp > 1 or args.pp > 1 or args.ep > 1:
+        if (args.tp > 1 and not args.fsdp) or args.pp > 1 or args.ep > 1:
+            # Decode runs on replicated params.  FSDP (incl. FSDP x TP)
+            # is exempt: its eval/generate path host-gathers the sharded
+            # flats back to the full model layout first (fsdp_gather_params
+            # -- the tested --fsdp --tp 2 --generate CLI path).
             raise SystemExit(
-                "--generate needs replicated params (no --tp/--pp/--ep)"
+                "--generate needs replicated params (no --tp/--pp/--ep; "
+                "--fsdp [--tp N] generates via the host gather)"
             )
     if args.moe_experts and not is_lm(args):
         raise SystemExit("--moe-experts requires an LM model")

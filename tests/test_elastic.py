@@ -756,3 +756,44 @@ def test_elastic_rejects_interleaved_geometry_change(tmp_path, devices):
             ckpt, st, mesh24, layout="replicated", pp_axis="pipe",
             pp_virtual=2,
         )
+
+
+def test_elastic_legacy_sidecar_rejected_into_interleaved_run(
+    tmp_path, devices
+):
+    """A sidecar WITHOUT the n_virtual key predates interleaving, so its
+    layer rows are contiguous (virtual=1): resuming it into a
+    --pp-virtual>1 run must be rejected, not silently row-permuted
+    (round-5 review finding: the legacy default was the CURRENT run's
+    degree, which let exactly this slip through)."""
+    import json
+
+    from distributeddataparallel_tpu.parallel.pipeline_parallel import (
+        shard_state_pp,
+    )
+
+    cfg = _cfg(num_layers=4, scan_layers=True)
+    model = TransformerLM(cfg)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 16), jnp.int32)
+    )["params"]
+    mesh = Mesh(np.array(jax.devices()).reshape(4, 2), ("data", "pipe"))
+    st = ddp.TrainState.create(
+        apply_fn=None, params=params, tx=optax.sgd(0.1)
+    )
+    st = shard_state_pp(st, mesh)  # contiguous (virtual=1) layout
+    ckpt = Checkpointer(str(tmp_path))
+    meta = topology_meta(mesh, "replicated", pp_axis="pipe")
+    del meta["n_virtual"]  # simulate the pre-interleaving sidecar
+    ckpt.save(st, 0, meta=meta)
+    ckpt.wait()
+    with pytest.raises(ValueError, match="interleaved"):
+        elastic_restore(
+            ckpt, st, mesh, layout="replicated", pp_axis="pipe",
+            pp_virtual=2,
+        )
+    # and at virtual=1 the legacy sidecar restores exactly as before
+    st2, _ = elastic_restore(
+        ckpt, st, mesh, layout="replicated", pp_axis="pipe", pp_virtual=1
+    )
+    assert json.dumps(meta)  # meta untouched by the restore
