@@ -123,6 +123,10 @@ class TransformerConfig:
     # its own sync (``make_train_step(presynced=scanned_param_paths)``).
     # Backward passes must then run inside shard_map with the axis bound.
     grad_sync_axis: str | None = None
+    # bf16 comm-hook for the in-scan reduction: the per-layer cotangents
+    # cross the wire in bfloat16 (see data_parallel.all_reduce_gradients
+    # ``compress``).  Only meaningful with grad_sync_axis.
+    grad_sync_compress: str | None = None
 
     @property
     def kv_heads(self) -> int:
@@ -645,12 +649,15 @@ class _ScanBlock(nn.Module):
             )
 
             axis = self.cfg.grad_sync_axis
+            comp = self.cfg.grad_sync_compress
             cls = nn.map_variables(
                 DecoderBlock,
                 "params",
                 trans_in_fn=(
                     (lambda vs: vs) if self.is_initializing()
-                    else (lambda vs: sync_grad_in_backward(vs, axis))
+                    else (lambda vs: sync_grad_in_backward(
+                        vs, axis, compress=comp
+                    ))
                 ),
                 init=self.is_initializing(),
             )

@@ -45,6 +45,11 @@ DEFAULT_BUCKET_BYTES = 25 * 1024 * 1024
 OVERLAP_BUCKET_BYTES = 1 * 1024 * 1024
 
 
+def _check_compress(compress: str | None) -> None:
+    if compress not in (None, "bf16"):
+        raise ValueError(f"compress must be None or 'bf16', got {compress!r}")
+
+
 def all_reduce_gradients(
     grads: Pytree,
     axis_name: str = "data",
@@ -52,6 +57,7 @@ def all_reduce_gradients(
     op: str = "mean",
     bucket_bytes: int | None = None,
     chain: bool = False,
+    compress: str | None = None,
 ) -> Pytree:
     """All-reduce a gradient pytree across the data axis (inside shard_map).
 
@@ -61,18 +67,33 @@ def all_reduce_gradients(
     ``bucket_bytes``) orders the buckets with barriers so the compiler
     keeps them separate and can overlap them with backward — see
     ``bucket_gradients`` and ``parallel.overlap``.
+
+    ``compress='bf16'`` is the comm-hook analog of torch DDP's
+    ``bf16_compress_hook`` (the stack behind ref dpp.py:52's
+    ``register_comm_hook`` surface): gradients cross the wire in
+    bfloat16 — half the bytes of f32 — and are cast back to each leaf's
+    dtype after the reduce.  bf16 keeps f32's exponent range, so unlike
+    the fp16 hook no loss-scaling is needed; replicas remain in lockstep
+    because every replica sees the SAME compressed-then-averaged value.
     """
     if op not in ("mean", "sum"):
         raise ValueError(f"op must be 'mean' or 'sum', got {op!r}")
+    _check_compress(compress)
     if chain and bucket_bytes is None:
         bucket_bytes = OVERLAP_BUCKET_BYTES
     if bucket_bytes is not None:
         return bucket_gradients(
-            grads, axis_name, op=op, bucket_bytes=bucket_bytes, chain=chain
+            grads, axis_name, op=op, bucket_bytes=bucket_bytes, chain=chain,
+            compress=compress,
         )
-    if op == "mean":
-        return jax.tree.map(lambda g: lax.pmean(g, axis_name), grads)
-    return jax.tree.map(lambda g: lax.psum(g, axis_name), grads)
+    red = lax.pmean if op == "mean" else lax.psum
+
+    def _leaf(g):
+        if compress == "bf16" and g.dtype == jnp.float32:
+            return red(g.astype(jnp.bfloat16), axis_name).astype(g.dtype)
+        return red(g, axis_name)
+
+    return jax.tree.map(_leaf, grads)
 
 
 def bucket_gradients(
@@ -82,6 +103,7 @@ def bucket_gradients(
     op: str = "mean",
     bucket_bytes: int = DEFAULT_BUCKET_BYTES,
     chain: bool = False,
+    compress: str | None = None,
 ) -> Pytree:
     """Coalesced all-reduce: flatten grad leaves into ~bucket_bytes groups,
     reduce each group as one flat vector, scatter back.
@@ -106,6 +128,7 @@ def bucket_gradients(
     """
     from distributeddataparallel_tpu import native
 
+    _check_compress(compress)
     leaves, treedef = jax.tree.flatten(grads)
     # Reverse-order ~bucket_bytes grouping, planned by the native layer
     # (the role DDP gives its C++ Reducer); runs at trace time.
@@ -130,6 +153,11 @@ def bucket_gradients(
             if chain and len(dtypes) == 1
             else jnp.float32
         )
+        if compress == "bf16":
+            # bf16 comm-hook: every bucket crosses the wire at 2 B/elem
+            # regardless of leaf dtype (torch bf16_compress_hook
+            # semantics: compress -> average -> decompress).
+            bdt = jnp.bfloat16
         if len(bucket) == 1:
             # Single-leaf bucket: skip the concat/flatten round-trip —
             # keeps the leaf's layout intact for the async scheduler.
@@ -163,7 +191,13 @@ def bucket_gradients(
     return jax.tree.unflatten(treedef, reduced)
 
 
-def sync_grad_in_backward(x: Pytree, axis_name: str, *, op: str = "mean"):
+def sync_grad_in_backward(
+    x: Pytree,
+    axis_name: str,
+    *,
+    op: str = "mean",
+    compress: str | None = None,
+):
     """Identity on the forward; all-reduces the COTANGENT over
     ``axis_name`` on the backward.
 
@@ -182,7 +216,12 @@ def sync_grad_in_backward(x: Pytree, axis_name: str, *, op: str = "mean"):
 
     Forward-only applies (eval, decode) never touch the axis, so the
     model stays usable outside ``shard_map``.
+
+    ``compress='bf16'``: the cotangent crosses the wire in bfloat16 (the
+    in-scan-body arm of the bf16 comm hook — see
+    ``all_reduce_gradients``).
     """
+    _check_compress(compress)
 
     @jax.custom_vjp
     def ident(t):
@@ -193,6 +232,8 @@ def sync_grad_in_backward(x: Pytree, axis_name: str, *, op: str = "mean"):
 
     def bwd(_, g):
         red = lax.pmean if op == "mean" else lax.psum
+        if compress == "bf16" and g.dtype == jnp.float32:
+            return (red(g.astype(jnp.bfloat16), axis_name).astype(g.dtype),)
         return (red(g, axis_name),)
 
     ident.defvjp(fwd, bwd)

@@ -208,7 +208,7 @@ def _parse_events(
     compute bytes to the collective).
     """
     ar_payload = ar_payload or {}
-    events: list[tuple[str, int, int]] = []
+    events: list[tuple[str, int, int, str]] = []
     for line in lines:
         m = re.search(r"%([\w.\-]+) = ", line)
         if not m:
@@ -221,21 +221,23 @@ def _parse_events(
         if name.startswith("async-collective-start") or re.search(
             r"\ball-reduce-start\(|\ball-gather-start\(", line
         ):
-            events.append(("start", cycles, 0))
+            events.append(("start", cycles, 0, name))
         elif name.startswith("async-collective-done") or re.search(
             r"\ball-reduce-done\(|\ball-gather-done\(", line
         ):
             # done's single result is the reduced payload: bytes land here
-            events.append(("done", cycles, _shape_bytes(line)))
+            events.append(("done", cycles, _shape_bytes(line), name))
         elif callee in ar_comps or "async_collective_fusion" in (callee or ""):
             # Compute fused with a collective: overlapped by construction.
-            events.append(("comm_fused", cycles, ar_payload.get(callee, 0)))
+            events.append(
+                ("comm_fused", cycles, ar_payload.get(callee, 0), name)
+            )
         elif re.search(r"\ball-reduce\(|\breduce-scatter\(|\ball-gather\(", line):
-            events.append(("sync_collective", cycles, _shape_bytes(line)))
+            events.append(("sync_collective", cycles, _shape_bytes(line), name))
         elif re.search(r" (fusion|custom-call|convolution)\(", line):
             # note: matches tuple-typed (multi-output) fusions too, which
             # the pre-round-5 `= \S+ fusion(` spelling silently missed
-            events.append(("compute", cycles, 0))
+            events.append(("compute", cycles, 0, name))
     return events
 
 
@@ -250,8 +252,9 @@ def _tally(events) -> dict:
     n_sync = 0
     async_bytes = 0
     sync_bytes = 0
-    n_comm_fused = sum(1 for kind, _, _ in events if kind == "comm_fused")
-    for kind, cycles, nbytes in events:
+    sync_detail: list[dict] = []
+    n_comm_fused = sum(1 for kind, _, _, _ in events if kind == "comm_fused")
+    for kind, cycles, nbytes, name in events:
         if kind == "start":
             depth += 1
             if depth == 1:
@@ -267,6 +270,7 @@ def _tally(events) -> dict:
         elif kind == "sync_collective":
             n_sync += 1
             sync_bytes += nbytes
+            sync_detail.append({"bytes": nbytes, "name": name})
         else:  # compute / comm_fused
             total_compute += cycles
             if kind == "comm_fused":
@@ -274,6 +278,7 @@ def _tally(events) -> dict:
             if depth > 0 and cycles:
                 win_cycles += cycles
                 win_ops += 1
+    sync_detail.sort(key=lambda d: -d["bytes"])
     return {
         "windows": windows,
         "total_compute": total_compute,
@@ -281,6 +286,7 @@ def _tally(events) -> dict:
         "n_comm_fused": n_comm_fused,
         "async_bytes": async_bytes,
         "sync_bytes": sync_bytes,
+        "sync_detail": sync_detail,
     }
 
 
@@ -403,6 +409,9 @@ def schedule_report(
         "async_bytes_frac": (
             round(async_bytes / coll_bytes, 4) if coll_bytes else 0.0
         ),
+        # the sync residue itself, largest first (ENTRY-level only):
+        # what stayed synchronous and how big — the tuning target.
+        "sync_collective_detail": tally["sync_detail"][:16],
     }
 
 
@@ -577,6 +586,7 @@ def train_step_schedule_evidence(
     per_chip_batch: int | None = None,
     seq_len: int | None = None,
     attn_impl: str = "xla",
+    grad_compress: str | None = None,
     return_hlo: bool = False,
 ) -> dict:
     """AOT-compile the REAL ``make_train_step(..., overlap=True)`` for a
@@ -633,6 +643,7 @@ def train_step_schedule_evidence(
             num_layers=8, d_model=2048, d_ff=7168, num_heads=16,
             num_kv_heads=4, vocab_size=32000, max_seq_len=seq_len,
             attn_impl=attn_impl, grad_sync_axis="data",
+            grad_sync_compress=grad_compress,
         )
         tx = optax.sgd(1e-3, momentum=0.9)
         presynced = lambda p: p[0] == "layers"  # noqa: E731
@@ -662,7 +673,8 @@ def train_step_schedule_evidence(
     rng_sds = jax.ShapeDtypeStruct((2,), jnp.uint32)
 
     step = make_train_step(
-        loss_fn, mesh=mesh, overlap=True, presynced=presynced
+        loss_fn, mesh=mesh, overlap=True, presynced=presynced,
+        grad_compress=grad_compress,
     )
     import time
 
@@ -679,16 +691,24 @@ def train_step_schedule_evidence(
         where=f"train_step_schedule_evidence({model})",
     )
     # Exact payload accounting: sync collectives execute once each in
-    # the ENTRY schedule, so sync_collective_bytes / gradient-bytes is
-    # exact; async_bytes_frac is approximate (fusion-wrapper clones can
-    # repeat a payload on the async side).
+    # the ENTRY schedule, so sync_collective_bytes / gradient-WIRE-bytes
+    # is exact; async_bytes_frac is approximate (fusion-wrapper clones
+    # can repeat a payload on the async side).  Under the bf16 comm hook
+    # the wire carries 2 B/elem regardless of param dtype — dividing by
+    # f32 bytes would flatter the async share 2x.
     grad_bytes = sum(
         l.size * l.dtype.itemsize
         for l in jax.tree.leaves(state_sds.params)
     )
+    wire_bytes = (
+        sum(2 * l.size for l in jax.tree.leaves(state_sds.params))
+        if grad_compress == "bf16"
+        else grad_bytes
+    )
     rep["grad_bytes"] = grad_bytes
+    rep["grad_wire_bytes"] = wire_bytes
     rep["async_frac_of_grad_bytes"] = round(
-        max(0.0, 1.0 - rep["sync_collective_bytes"] / grad_bytes), 4
+        max(0.0, 1.0 - rep["sync_collective_bytes"] / wire_bytes), 4
     )
     rep.update(
         {
@@ -705,6 +725,7 @@ def train_step_schedule_evidence(
                 "scan_layers": cfg.scan_layers,
                 "remat": cfg.remat,
                 "grad_sync_axis": cfg.grad_sync_axis,
+                "grad_compress": grad_compress,
             },
         }
     )

@@ -64,6 +64,7 @@ def make_train_step(
     ep_axis: str | None = None,
     grad_clip: float | None = None,
     presynced: Callable[[tuple], bool] | None = None,
+    grad_compress: str | None = None,
 ):
     """Build the jit'd DP train step.
 
@@ -100,6 +101,15 @@ def make_train_step(
     ``accum_steps`` (reduction still fires once per boundary) and
     ``grad_clip``; on non-TPU backends the buckets still run (semantics
     identical) without the TPU options.
+
+    ``grad_compress="bf16"`` is the bf16 comm hook (torch DDP's
+    ``bf16_compress_hook`` analog): gradient buckets cross the wire in
+    bfloat16 and decompress back after the average — half the f32 wire
+    bytes, same exponent range so no loss scaling.  Composes with
+    ``overlap``/``bucket_bytes``/``accum_steps``/``grad_clip`` (the clip
+    norm sees the decompressed averaged grads, matching torch's
+    hook-then-clip order).  For scanned models syncing in-body, set
+    ``TransformerConfig.grad_sync_compress`` for the presynced leaves.
 
     With ``zero=True``, optimizer state is ZeRO-1-sharded across the data
     axis (see ``parallel.zero``): grads reduce_scatter instead of
@@ -176,6 +186,11 @@ def make_train_step(
     if not grad_sync and (zero or bucket_bytes is not None or overlap):
         raise ValueError("grad_sync=False skips the reduction entirely; "
                          "it does not compose with zero/bucket_bytes/overlap")
+    if grad_compress is not None and (zero or not grad_sync):
+        # ZeRO owns its reduce_scatter; compressing there is a separate
+        # (unimplemented) path — reject rather than silently not compress.
+        raise ValueError("grad_compress requires grad_sync=True and "
+                         "zero=False")
     if grad_clip is not None and not grad_sync:
         # Unsynced per-replica grads have per-replica norms: clipping
         # would scale each replica differently (same divergence as the
@@ -311,7 +326,7 @@ def make_train_step(
                 if presynced is None:
                     grads = all_reduce_gradients(
                         grads, axis_name, op="mean", bucket_bytes=bb,
-                        chain=False,
+                        chain=False, compress=grad_compress,
                     )
                 else:
                     # Model-synced leaves (grad_sync_axis: reduced inside
@@ -333,7 +348,7 @@ def make_train_step(
                     ]
                     rest = iter(all_reduce_gradients(
                         rest, axis_name, op="mean", bucket_bytes=bb,
-                        chain=False,
+                        chain=False, compress=grad_compress,
                     ))
                     grads = jax.tree.unflatten(
                         treedef,

@@ -185,6 +185,12 @@ def parse_args(argv=None):
                         "async-collective/latency-hiding compiler options, "
                         "so each bucket's all-reduce hides under the "
                         "remaining backward (see OVERLAP.md)")
+    p.add_argument("--grad-compress", choices=["bf16"], default=None,
+                   help="comm-hook gradient compression (torch DDP "
+                        "bf16_compress_hook analog): gradients cross the "
+                        "wire in bfloat16, half the f32 bytes; composes "
+                        "with --overlap/--bucket-mb/--accum-steps/"
+                        "--grad-clip (clip sees decompressed grads)")
     p.add_argument("--buffer-sync", choices=["mean", "broadcast"],
                    default="mean",
                    help="BatchNorm-style buffer consistency across replicas: "
@@ -389,6 +395,14 @@ def validate_args(args) -> None:
             raise SystemExit(
                 f"--overlap applies to the DP all-reduce; drop {', '.join(bad)}"
             )
+    if args.grad_compress and (args.zero or args.fsdp or args.pp > 1):
+        # Those layouts own their reductions (reduce_scatter / per-layer
+        # gathers / stage collectives); the comm hook is the plain-DP
+        # all-reduce's.
+        raise SystemExit(
+            "--grad-compress applies to the DP all-reduce; drop "
+            "--zero/--fsdp/--pp"
+        )
     if args.generate:
         if not is_lm(args):
             raise SystemExit("--generate requires an LM model")
@@ -504,7 +518,8 @@ def build_model(args, num_classes: int = 10, vocab_size: int | None = None):
             # subtree (presynced, wired at make_train_step below).
             import dataclasses as _dc
 
-            cfg = _dc.replace(cfg, grad_sync_axis="data")
+            cfg = _dc.replace(cfg, grad_sync_axis="data",
+                              grad_sync_compress=args.grad_compress)
         return tfm.TransformerLM(cfg)
     raise NotImplementedError(f"--model {args.model}")
 
@@ -887,6 +902,7 @@ def train(args) -> float:
             tp_axis="model" if args.tp > 1 else None,
             ep_axis="expert" if args.ep > 1 else None,
             grad_clip=args.grad_clip,
+            grad_compress=args.grad_compress,
             presynced=(
                 (lambda p: p[0] == "layers")
                 if getattr(getattr(model, "cfg", None), "grad_sync_axis",
