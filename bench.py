@@ -971,6 +971,24 @@ def bench_overlap() -> dict:
             out[f"real_step_schedule_{m}"] = {k: rep[k] for k in keys}
         except Exception as e:  # noqa: BLE001 - keep the other sections
             out[f"real_step_schedule_{m}"] = {"error": repr(e)}
+
+    # Comm-hook wire-byte ledgers for the GPT-2 124M gradient tree
+    # (shape math, no compile): the bf16 hook halves the wire; the
+    # PowerSGD hook's rank-4 factors cut it by orders of magnitude.
+    # Schedule-level measurements for bf16 are in OVERLAP.md §6.
+    from distributeddataparallel_tpu.parallel.powersgd import (
+        powersgd_wire_bytes,
+    )
+
+    try:
+        out["comm_hooks_wire_bytes"] = {
+            "powersgd_rank4": powersgd_wire_bytes(state.params, rank=4),
+            "bf16_wire_bytes": sum(
+                2 * l.size for l in jax.tree.leaves(state.params)
+            ),
+        }
+    except Exception as e:  # noqa: BLE001
+        out["comm_hooks_wire_bytes"] = {"error": repr(e)}
     return out
 
 

@@ -43,6 +43,10 @@ from distributeddataparallel_tpu.parallel.data_parallel import (  # noqa: F401
     all_reduce_gradients,
     broadcast_params,
 )
+from distributeddataparallel_tpu.parallel.powersgd import (  # noqa: F401
+    powersgd_state,
+    powersgd_wire_bytes,
+)
 from distributeddataparallel_tpu.parallel.zero import zero_state  # noqa: F401
 from distributeddataparallel_tpu.parallel.tensor_parallel import shard_state_tp  # noqa: F401
 from distributeddataparallel_tpu.parallel.expert_parallel import shard_state_ep  # noqa: F401

@@ -20,6 +20,12 @@ from distributeddataparallel_tpu.parallel.overlap import (  # noqa: F401
     overlap_compiler_options,
     schedule_report,
 )
+from distributeddataparallel_tpu.parallel.powersgd import (  # noqa: F401
+    powersgd_state,
+    powersgd_state_specs,
+    powersgd_sync,
+    powersgd_wire_bytes,
+)
 from distributeddataparallel_tpu.parallel.zero import zero_state  # noqa: F401
 from distributeddataparallel_tpu.parallel.tensor_parallel import (  # noqa: F401
     copy_to_tp,

@@ -100,15 +100,49 @@ class Checkpointer:
         if step is None:
             return state, 0
         if template is not None:
-            restored = self._mgr.restore(
-                step, args=ocp.args.StandardRestore(template)
-            )
+            restored = self._restore(step, template)
             return restored, step + 1
-        restored = self._mgr.restore(
-            step, args=ocp.args.StandardRestore(_arrays_only(state))
-        )
+        restored = self._restore(step, _arrays_only(state))
         state = _merge_arrays(state, restored)
         return state, step + 1
+
+    def _restore(self, step: int, template: Pytree) -> Pytree:
+        """Standard restore, with a legacy fallback: checkpoints written
+        before TrainState grew ``comm_state`` have no such node on disk,
+        so a template whose comm_state is EMPTY drops it via a partial
+        restore (template shardings preserved through explicit
+        restore_args).  A non-empty comm_state against a legacy
+        checkpoint stays a loud error — there is no saved hook state to
+        resume from."""
+        try:
+            return self._mgr.restore(
+                step, args=ocp.args.StandardRestore(template)
+            )
+        except ValueError as e:
+            empty_comm = not jax.tree.leaves(
+                getattr(template, "comm_state", {"x": 1})
+            )
+            if "comm_state" not in str(e) or not empty_comm:
+                raise
+            # One-off read-only manager: self._mgr bound its handler
+            # registry to StandardRestore on first use and would reject
+            # PyTreeRestore args.
+            mgr = ocp.CheckpointManager(self._dir)
+            try:
+                return mgr.restore(
+                    step,
+                    args=ocp.args.PyTreeRestore(
+                        template,
+                        restore_args=(
+                            ocp.checkpoint_utils.construct_restore_args(
+                                template
+                            )
+                        ),
+                        partial_restore=True,
+                    ),
+                )
+            finally:
+                mgr.close()
 
     def wait(self) -> None:
         self._mgr.wait_until_finished()

@@ -39,6 +39,7 @@ from __future__ import annotations
 from typing import Any
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding
 
@@ -300,6 +301,62 @@ def elastic_restore(
         # Same chunking (or N-independent global shapes): exact-topology
         # restore regardless of layout — orbax re-slices to the
         # template's shardings on its own.
+        #
+        # Exception: comm-hook state (PowerSGD) carries a LEADING
+        # data-axis dim on its error residuals, so it is NOT
+        # N-independent.  Across a data-degree change, restore
+        # everything else against the template, then rebuild the hook
+        # state fresh at the new degree keeping the warm Q (replicated,
+        # transportable) and zeroing the residuals — the residual rows
+        # have no meaningful mapping between replica sets, and dropping
+        # them loses at most one step's deferred low-rank error.
+        if n_old != n_new and jax.tree.leaves(state.comm_state):
+            from distributeddataparallel_tpu.parallel.powersgd import (
+                PowerSGDLeaf,
+                _is_entry,
+            )
+
+            # The old-degree residuals are restored only to satisfy the
+            # saved tree structure and then dropped.  Spread the
+            # throwaway rows over the new data axis when the counts
+            # divide (the common downsize path — keeps per-device peak
+            # at n_old/n_new x one residual tree); otherwise fall back
+            # to one device.
+            from jax.sharding import PartitionSpec as P_
+
+            if n_old % n_new == 0:
+                err_shard = NamedSharding(mesh, P_(data_axis))
+            else:
+                err_shard = jax.sharding.SingleDeviceSharding(
+                    jax.devices()[0]
+                )
+            old_template = state.replace(
+                comm_state=jax.tree.map(
+                    lambda e: (
+                        None if e is None else PowerSGDLeaf(
+                            q=e.q,
+                            err=jax.ShapeDtypeStruct(
+                                (n_old, *e.err.shape[1:]), e.err.dtype,
+                                sharding=err_shard,
+                            ),
+                        )
+                    ),
+                    state.comm_state,
+                    is_leaf=_is_entry,
+                )
+            )
+            restored, nxt = ckpt.restore_latest(old_template)
+            fresh = jax.tree.map(
+                lambda new_e, got_e: (
+                    None if new_e is None else PowerSGDLeaf(
+                        q=got_e.q, err=jnp.zeros_like(new_e.err)
+                    )
+                ),
+                state.comm_state,
+                restored.comm_state,
+                is_leaf=_is_entry,
+            )
+            return restored.replace(comm_state=fresh), nxt
         return ckpt.restore_latest(state)
     if not allow_reshard:
         raise ValueError(

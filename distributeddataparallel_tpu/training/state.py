@@ -35,6 +35,12 @@ class TrainState:
     model_state: Pytree
     apply_fn: Callable = flax.struct.field(pytree_node=False)
     tx: optax.GradientTransformation = flax.struct.field(pytree_node=False)
+    # Comm-hook state (e.g. PowerSGD's per-leaf Q factors + error
+    # feedback, ``parallel.powersgd``): device data like optimizer
+    # moments, replicated-then-diverging by design (the error residual
+    # is per-replica), checkpointed with the rest of the state.  Empty
+    # for hookless training.
+    comm_state: Pytree = flax.struct.field(default_factory=dict)
 
     @classmethod
     def create(
@@ -44,6 +50,7 @@ class TrainState:
         params: Pytree,
         tx: optax.GradientTransformation,
         model_state: Pytree | None = None,
+        comm_state: Pytree | None = None,
     ) -> "TrainState":
         import jax.numpy as jnp
 
@@ -54,6 +61,7 @@ class TrainState:
             model_state=model_state if model_state is not None else {},
             apply_fn=apply_fn,
             tx=tx,
+            comm_state=comm_state if comm_state is not None else {},
         )
 
     def apply_gradients(self, grads: Pytree) -> "TrainState":
