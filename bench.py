@@ -537,6 +537,118 @@ def bench_decode() -> dict:
             },
             "gen_wall_ms": round(dt * 1e3, 1),
         }
+    # int8 weight-only serving (ops.quant): matrices stream as int8 +
+    # per-channel scales, ~half the bf16 weight bytes.  On GPT-2 124M
+    # at b8 the step is SMALL-OP-FLOOR-bound (b8_bound_analysis), so
+    # the byte saving cannot show — recorded here as the honest ~1.0x;
+    # the byte-bound measurement lives in int8_llama_0p6b below, where
+    # the weight stream is ~10x and the dequant-fusion speedup is real
+    # (measured 1.7x per step).  A HOISTED dequant would re-materialize
+    # bf16 weights and erase that llama speedup — the llama number is
+    # the fusion proof.
+    int8 = {}
+    try:
+        from distributeddataparallel_tpu.ops.quant import (
+            quantize_int8,
+            quantized_bytes,
+        )
+
+        B = 8
+        prompt = jax.random.randint(rng, (B, P), 0, cfg.vocab_size)
+        # Quantize ONCE outside the timed loop (generate() detects the
+        # QuantLeaf tree and reuses it) — timing the per-call quantize
+        # pass would deflate the steady-state serving number.
+        qparams = jax.jit(quantize_int8)(params)
+        out = generate(model, qparams, prompt, N)
+        assert int(jnp.sum(out)) >= 0
+        out1 = generate(model, qparams, prompt, 1)
+        assert int(jnp.sum(out1)) >= 0
+        iters = 3
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = generate(model, qparams, prompt, N)
+        assert int(jnp.sum(out)) >= 0
+        dt = (time.perf_counter() - t0) / iters
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out1 = generate(model, qparams, prompt, 1)
+        assert int(jnp.sum(out1)) >= 0
+        dt_prefill = (time.perf_counter() - t0) / iters
+        qb = quantized_bytes(qparams)["bytes"]
+        cache_bytes = B * cfg.max_seq_len * kv_per_tok
+        roof_ms = (qb + cache_bytes) / peak * 1e3
+        meas_ms = max(dt - dt_prefill, 1e-9) / (N - 1) * 1e3
+        int8 = {
+            "decode_tokens_s_chip": round(B * N / dt, 1),
+            # like-for-like per-step ratio (the llama section's metric):
+            # end-to-end tokens/s would fold prefill into the compare
+            "step_speedup_int8": round(
+                per_batch[8]["roofline"]["measured_step_ms"] / meas_ms,
+                3,
+            ),
+            "weight_mb_per_step": round(qb / 1e6, 1),
+            "hbm_util_est": round(roof_ms / meas_ms, 4),
+            "measured_step_ms": round(meas_ms, 4),
+        }
+    except Exception as e:  # noqa: BLE001 - keep the bf16 numbers
+        int8 = {"error": repr(e)}
+
+    # Byte-bound int8 proof point: Llama-0.6B-class (567M params,
+    # 1.13 GB bf16 weight stream — step roofline ~1.4 ms, well above
+    # the op floor).  Two variants, two timed programs each.
+    int8_llama = {}
+    try:
+        from distributeddataparallel_tpu.models import llama3_8b
+
+        lcfg = llama3_8b(
+            num_layers=8, d_model=2048, d_ff=7168, num_heads=16,
+            num_kv_heads=4, vocab_size=32000, max_seq_len=P + N,
+            scan_layers=False, remat=False,
+        )
+        lmodel = TransformerLM(lcfg)
+        lparams = jax.jit(lmodel.init)(
+            rng, jax.random.randint(rng, (1, P), 0, lcfg.vocab_size)
+        )["params"]
+        B = 8
+        lprompt = jax.random.randint(rng, (B, P), 0, lcfg.vocab_size)
+        from distributeddataparallel_tpu.ops.quant import quantize_int8
+
+        lq = jax.jit(quantize_int8)(lparams)
+        res = {}
+        for q, ps in ((None, lparams), ("int8", lq)):
+            out = generate(lmodel, ps, lprompt, N)
+            assert int(jnp.sum(out)) >= 0
+            out1 = generate(lmodel, ps, lprompt, 1)
+            assert int(jnp.sum(out1)) >= 0
+            iters = 2
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                out = generate(lmodel, ps, lprompt, N)
+            assert int(jnp.sum(out)) >= 0
+            dt = (time.perf_counter() - t0) / iters
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                out1 = generate(lmodel, ps, lprompt, 1)
+            assert int(jnp.sum(out1)) >= 0
+            dtp = (time.perf_counter() - t0) / iters
+            res[q or "bf16"] = {
+                "decode_tokens_s_chip": round(B * N / dt, 1),
+                "step_ms": round(
+                    max(dt - dtp, 1e-9) / (N - 1) * 1e3, 4
+                ),
+            }
+        int8_llama = {
+            **res,
+            "step_speedup_int8": round(
+                res["bf16"]["step_ms"] / res["int8"]["step_ms"], 3
+            ),
+            "params_m": round(
+                sum(x.size for x in jax.tree.leaves(lparams)) / 1e6, 1
+            ),
+        }
+    except Exception as e:  # noqa: BLE001
+        int8_llama = {"error": repr(e)}
+
     best = max(per_batch, key=lambda b: per_batch[b]["decode_tokens_s_chip"])
     b8 = per_batch[8]["roofline"]
     return {
@@ -545,6 +657,8 @@ def bench_decode() -> dict:
         "hbm_util_est": per_batch[best]["hbm_util_est"],
         "hbm_util_b8": per_batch[8]["hbm_util_est"],
         "per_batch": {str(k): v for k, v in per_batch.items()},
+        "int8_b8": int8,
+        "int8_llama_0p6b": int8_llama,
         "prompt_len": P,
         "new_tokens": N,
         "weights_dtype": "bf16 (cast once inside the decode jit)",
@@ -1119,6 +1233,12 @@ def main() -> None:
                 .get("decode_tokens_s_chip")
             ),
             "decode_hbm_util_b8": decode.get("hbm_util_b8"),
+            "decode_int8_llama_step_speedup": decode.get(
+                "int8_llama_0p6b", {}
+            ).get("step_speedup_int8"),
+            "decode_int8_gpt2_b8_step_speedup": decode.get(
+                "int8_b8", {}
+            ).get("step_speedup_int8"),
             "moe_e16_over_e4": moe.get("e16_over_e4"),
             "moe_roofline": moe.get("e16_over_e4_weight_traffic_roofline"),
             "moe_ep_shard_frac_measured": moe.get("ep_memory", {}).get(
