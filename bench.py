@@ -549,7 +549,7 @@ def bench_decode() -> dict:
     int8 = {}
     try:
         from distributeddataparallel_tpu.ops.quant import (
-            quantize_int8,
+            quantize_for_decode,
             quantized_bytes,
         )
 
@@ -558,7 +558,7 @@ def bench_decode() -> dict:
         # Quantize ONCE outside the timed loop (generate() detects the
         # QuantLeaf tree and reuses it) — timing the per-call quantize
         # pass would deflate the steady-state serving number.
-        qparams = jax.jit(quantize_int8)(params)
+        qparams = quantize_for_decode(params)
         out = generate(model, qparams, prompt, N)
         assert int(jnp.sum(out)) >= 0
         out1 = generate(model, qparams, prompt, 1)
@@ -600,10 +600,13 @@ def bench_decode() -> dict:
     try:
         from distributeddataparallel_tpu.models import llama3_8b
 
+        # scan_layers: ONE compiled layer body (the production llama
+        # config) — the 8-layer unrolled decode compile blew the bench
+        # budget (~4 min/variant); byte totals are identical.
         lcfg = llama3_8b(
             num_layers=8, d_model=2048, d_ff=7168, num_heads=16,
             num_kv_heads=4, vocab_size=32000, max_seq_len=P + N,
-            scan_layers=False, remat=False,
+            scan_layers=True, remat=False,
         )
         lmodel = TransformerLM(lcfg)
         lparams = jax.jit(lmodel.init)(
@@ -611,9 +614,11 @@ def bench_decode() -> dict:
         )["params"]
         B = 8
         lprompt = jax.random.randint(rng, (B, P), 0, lcfg.vocab_size)
-        from distributeddataparallel_tpu.ops.quant import quantize_int8
+        from distributeddataparallel_tpu.ops.quant import (
+            quantize_for_decode,
+        )
 
-        lq = jax.jit(quantize_int8)(lparams)
+        lq = quantize_for_decode(lparams, scan_layers=True)
         res = {}
         for q, ps in ((None, lparams), ("int8", lq)):
             out = generate(lmodel, ps, lprompt, N)

@@ -65,6 +65,51 @@ def decode_model(model):
     return TransformerLM(cfg)
 
 
+def _quant_decode_model(model):
+    """Decode twin for int8 serving: scanned configs additionally set
+    ``quant_serving`` so each scan trip dequantizes only ITS layer slice
+    (transformer._ScanBlock) — the int8 stack stays HBM-resident."""
+    dm = decode_model(model)
+    if dm.cfg.scan_layers:
+        from distributeddataparallel_tpu.models.transformer import (
+            TransformerLM,
+        )
+
+        return TransformerLM(
+            dataclasses.replace(dm.cfg, quant_serving=True)
+        )
+    return dm
+
+
+def _fix_unstacked_quant(params, dtype):
+    """Defensive repair for hand-quantized trees fed to a SCANNED
+    model: any 'layers' QuantLeaf whose scale lost the leading layer
+    dim (quantized without ``stacked_first_dim``) cannot be sliced by
+    nn.scan — serve those leaves dequantized instead (eagerly, outside
+    the jit: they are the exception path, and typically the tiny norm
+    stacks)."""
+    from distributeddataparallel_tpu.ops.quant import (
+        QuantLeaf,
+        _is_entry,
+    )
+
+    if not isinstance(params, dict) or "layers" not in params:
+        return params
+
+    def _fix(e):
+        if (
+            isinstance(e, QuantLeaf)
+            and e.scale.shape[0] != e.q.shape[0]
+        ):
+            return (
+                e.q.astype(jnp.float32) * e.scale
+            ).astype(dtype)
+        return e
+
+    fixed = jax.tree.map(_fix, params["layers"], is_leaf=_is_entry)
+    return {**params, "layers": fixed}
+
+
 @functools.partial(
     jax.jit,
     static_argnums=(0, 3),
@@ -83,10 +128,20 @@ def _generate_jit(
         # matrices are produced on-chip inside each matmul's operand
         # fusion and the scan streams int8 from HBM — hoisting one
         # dequant up here would re-materialize the bf16 tree and
-        # forfeit the bandwidth win.
+        # forfeit the bandwidth win.  Scanned configs go further: the
+        # stacked 'layers' subtree passes through AS QuantLeaf nodes and
+        # dequantizes per layer slice inside the layer scan
+        # (cfg.quant_serving / _ScanBlock) — dequantizing the whole
+        # stack here would materialize it in full per decode step.
         from distributeddataparallel_tpu.ops.quant import dequantize
 
-        live = lambda: dequantize(params, cfg.dtype)  # noqa: E731
+        if cfg.scan_layers:
+            live = lambda: {  # noqa: E731
+                k: (v if k == "layers" else dequantize(v, cfg.dtype))
+                for k, v in params.items()
+            }
+        else:
+            live = lambda: dequantize(params, cfg.dtype)  # noqa: E731
     else:
         if cfg.dtype != jnp.float32:
             # Decode is weight-streaming-bound: every step reads the
@@ -182,15 +237,23 @@ def generate(
 
     quantized = is_quantized(params)
     if quantize == "int8" and not quantized:
-        from distributeddataparallel_tpu.ops.quant import quantize_int8
+        from distributeddataparallel_tpu.ops.quant import (
+            quantize_for_decode,
+        )
 
-        # One fused device pass; the int8 tree is what the decode scan
-        # keeps resident (ops.quant module docstring).  Serving loops
-        # should quantize ONCE and pass the quantized tree in — it is
-        # detected and reused as-is, skipping this per-call pass.
-        params = jax.jit(quantize_int8)(params)
+        # One fused device pass (module-level jit: cached across
+        # calls); the int8 tree is what the decode scan keeps resident
+        # (ops.quant module docstring).  Serving loops should still
+        # quantize ONCE and pass the quantized tree in — it is detected
+        # and reused as-is, skipping even the cached dispatch.  Scanned
+        # models quantize the stacked 'layers' subtree in stacked mode
+        # (every scale keeps the layer dim — nn.scan slices scales
+        # alongside q per trip).
+        params = quantize_for_decode(params, model.cfg.scan_layers)
         quantized = True
-    dm = decode_model(model)
+    if quantized and model.cfg.scan_layers:
+        params = _fix_unstacked_quant(params, model.cfg.dtype)
+    dm = _quant_decode_model(model) if quantized else decode_model(model)
     return _generate_jit(
         dm, params, prompt.astype(jnp.int32), int(max_new_tokens),
         rng if rng is not None else jax.random.PRNGKey(0),

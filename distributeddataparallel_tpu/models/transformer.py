@@ -127,6 +127,11 @@ class TransformerConfig:
     # cross the wire in bfloat16 (see data_parallel.all_reduce_gradients
     # ``compress``).  Only meaningful with grad_sync_axis.
     grad_sync_compress: str | None = None
+    # int8 weight-only serving (ops.quant): the scanned blocks
+    # dequantize their per-layer param slice INSIDE the scan body so the
+    # int8 stack stays HBM-resident (set by models.generate for
+    # quantized decode; see _ScanBlock).
+    quant_serving: bool = False
 
     @property
     def kv_heads(self) -> int:
@@ -636,6 +641,15 @@ class _ScanBlock(nn.Module):
     inside the backward while-loop body where the async scheduler can
     hide it under the trip's remaining backward compute (the only
     overlap available to a scanned model; see parallel/overlap.py).
+
+    Under ``cfg.quant_serving`` (int8 weight-only decode, ops.quant) the
+    per-layer param SLICE is dequantized here, inside the scan body —
+    nn.scan splits the stacked ``QuantLeaf`` nodes along the layer dim
+    like any pytree, so each trip dequantizes only its own layer and the
+    int8 stack stays HBM-resident.  Dequantizing the whole stack before
+    the scan instead measures SLOWER than bf16 (full-stack bf16
+    materialization per decode step: +2x the byte traffic it was meant
+    to save).
     """
 
     cfg: TransformerConfig
@@ -643,6 +657,7 @@ class _ScanBlock(nn.Module):
     @nn.compact
     def __call__(self, x, positions, rope, deterministic):
         cls = DecoderBlock
+        trans = []
         if self.cfg.grad_sync_axis is not None:
             from distributeddataparallel_tpu.parallel.data_parallel import (
                 sync_grad_in_backward,
@@ -650,14 +665,25 @@ class _ScanBlock(nn.Module):
 
             axis = self.cfg.grad_sync_axis
             comp = self.cfg.grad_sync_compress
+            trans.append(
+                lambda vs: sync_grad_in_backward(vs, axis, compress=comp)
+            )
+        if self.cfg.quant_serving:
+            from distributeddataparallel_tpu.ops.quant import dequantize
+
+            dt = self.cfg.dtype
+            trans.append(lambda vs: dequantize(vs, dt))
+        if trans:
+            def chain(vs, _fns=tuple(trans)):
+                for f in _fns:
+                    vs = f(vs)
+                return vs
+
             cls = nn.map_variables(
                 DecoderBlock,
                 "params",
                 trans_in_fn=(
-                    (lambda vs: vs) if self.is_initializing()
-                    else (lambda vs: sync_grad_in_backward(
-                        vs, axis, compress=comp
-                    ))
+                    (lambda vs: vs) if self.is_initializing() else chain
                 ),
                 init=self.is_initializing(),
             )

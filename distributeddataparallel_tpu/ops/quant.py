@@ -26,6 +26,7 @@ torch stack's ``int8`` serving paths do for DDP-trained checkpoints).
 
 from __future__ import annotations
 
+import functools
 from typing import Any
 
 import flax.struct
@@ -64,7 +65,9 @@ def _quantizable(leaf) -> bool:
     )
 
 
-def _scale_reduce_axes(shape: tuple[int, ...]) -> tuple[int, ...]:
+def _scale_reduce_axes(
+    shape: tuple[int, ...], stacked: bool = False
+) -> tuple[int, ...]:
     """Axes the absmax reduces over — i.e., which elements SHARE a
     scale.  Scale groups are (leading stack slice) x (trailing
     channel): ndim>=3 leaves keep axis 0 separate because scanned
@@ -79,17 +82,24 @@ def _scale_reduce_axes(shape: tuple[int, ...]) -> tuple[int, ...]:
     import math
 
     nd = len(shape)
-    keep = {nd - 1} | ({0} if nd >= 3 else set())
+    keep = {nd - 1} | ({0} if (nd >= 3 or stacked) else set())
     size = math.prod(shape)
-    while keep:
+    while keep - ({0} if stacked else set()):
         ksize = math.prod(shape[a] for a in keep)
         if 4 * ksize <= size / 16:
             break
-        keep.remove(max(keep, key=lambda a: shape[a]))
+        # stacked trees NEVER drop axis 0: nn.scan slices every leaf
+        # (q AND scale) along the layer dim, so a scale without it is
+        # unsliceable (a stacked (L, d) norm leaf coarsens to a (L, 1)
+        # per-layer scalar instead)
+        keep.remove(
+            max(keep - ({0} if stacked else set()),
+                key=lambda a: shape[a])
+        )
     return tuple(a for a in range(nd) if a not in keep)
 
 
-def quantize_int8(params: Pytree) -> Pytree:
+def quantize_int8(params: Pytree, *, stacked_first_dim: bool = False) -> Pytree:
     """Symmetric absmax int8 quantization of every matrix leaf (scale
     groups per ``_scale_reduce_axes``: trailing channels, independent
     per leading stack slice); other leaves pass through unchanged.
@@ -98,6 +108,13 @@ def quantize_int8(params: Pytree) -> Pytree:
     reuse the result — ``generate()`` accepts the quantized tree
     directly (it detects ``QuantLeaf`` nodes), so a serving loop pays
     this pass once, not per request.
+
+    ``stacked_first_dim``: the tree is a scanned layer stack (leading
+    dim = layer) — every scale keeps the layer dim so ``nn.scan`` can
+    slice it per trip.  ``generate()`` sets this for the ``layers``
+    subtree of scanned models; hand-quantized stacks must do the same
+    (a non-stacked quantization of a stacked tree is detected and those
+    leaves are served dequantized instead — see ``models.generate``).
     """
 
     def _q(leaf):
@@ -106,7 +123,7 @@ def quantize_int8(params: Pytree) -> Pytree:
         f = leaf.astype(jnp.float32)
         absmax = jnp.max(
             jnp.abs(f),
-            axis=_scale_reduce_axes(leaf.shape),
+            axis=_scale_reduce_axes(leaf.shape, stacked_first_dim),
             keepdims=True,
         )
         scale = jnp.where(absmax > 0, absmax, 1.0) / 127.0
@@ -114,6 +131,29 @@ def quantize_int8(params: Pytree) -> Pytree:
         return QuantLeaf(q=q, scale=scale)
 
     return jax.tree.map(_q, params)
+
+
+@functools.partial(jax.jit, static_argnames=("stacked_first_dim",))
+def quantize_int8_jit(params: Pytree, *, stacked_first_dim: bool = False):
+    """Module-level jitted ``quantize_int8`` — callers must NOT wrap
+    ``jax.jit(quantize_int8)`` per call (a fresh jit wrapper has a fresh
+    cache: every call would retrace AND recompile the full-tree pass;
+    measured as a ~1.3 s per-generate() stall)."""
+    return quantize_int8(params, stacked_first_dim=stacked_first_dim)
+
+
+def quantize_for_decode(params: Pytree, scan_layers: bool = False):
+    """THE decode-side quantization convention, in one place: scanned
+    models quantize the stacked ``layers`` subtree in stacked mode
+    (sliceable per-layer scales), everything else channel-wise.  Used
+    by ``models.generate`` and the bench so the convention cannot
+    drift."""
+    if not scan_layers:
+        return quantize_int8_jit(params)
+    return {
+        k: quantize_int8_jit(v, stacked_first_dim=(k == "layers"))
+        for k, v in params.items()
+    }
 
 
 def is_quantized(params: Pytree) -> bool:

@@ -517,7 +517,19 @@ def make_train_step(
                     powersgd_state_specs,
                 )
 
-                if not jax.tree.leaves(state.comm_state):
+                # Distinguish "never initialized" ({} / None / empty
+                # containers) from "initialized, nothing above the
+                # compression floor" (a params-shaped tree of None
+                # ENTRIES — valid: every leaf syncs dense).  Leaf count
+                # is 0 for both, so count entries instead.
+                from distributeddataparallel_tpu.parallel.powersgd import (
+                    _is_entry,
+                )
+
+                entries = jax.tree.flatten(
+                    state.comm_state, is_leaf=_is_entry
+                )[0]
+                if state.comm_state is None or not entries:
                     raise ValueError(
                         "grad_compress='powersgd' needs hook state: build "
                         "the TrainState with comm_state=powersgd_state("

@@ -164,3 +164,42 @@ def test_generate_accepts_prequantized_tree(devices):
     out_pre = generate(model, qp, prompt, 8)
     out_conv = generate(model, params, prompt, 8, quantize="int8")
     np.testing.assert_array_equal(np.asarray(out_pre), np.asarray(out_conv))
+
+
+def test_stacked_mode_and_scanned_generate(devices):
+    """stacked_first_dim keeps the layer dim on EVERY scale (norm-stack
+    leaves included — nn.scan must slice scales alongside q); scanned
+    generate() runs end-to-end and matches the unquantized-fixup path."""
+    from distributeddataparallel_tpu.ops.quant import quantize_int8_jit
+
+    # a stacked norm-like leaf exactly at the floor: (8, 2048)
+    w = jnp.ones((8, 2048))
+    q = quantize_int8_jit({"w": w}, stacked_first_dim=True)["w"]
+    assert q.scale.shape[0] == 8  # per-layer, sliceable
+    # non-stacked quantization of the same leaf loses the layer dim
+    q_bad = quantize_int8_jit({"w": w})["w"]
+    assert q_bad.scale.shape[0] == 1
+
+    import dataclasses
+
+    cfg = dataclasses.replace(
+        tiny_lm(
+            vocab_size=256, d_model=128, d_ff=512, num_layers=2,
+            num_heads=4, max_seq_len=64, dtype=jnp.bfloat16,
+        ),
+        scan_layers=True,
+    )
+    model = TransformerLM(cfg)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    prompt = jnp.asarray([[1, 2, 3, 4]], jnp.int32)
+    out = generate(model, params, prompt, 8, quantize="int8")
+    assert out.shape == (1, 12)
+    # hand-quantized WITHOUT stacked mode: the fixup serves the
+    # unsliceable leaves dequantized; still runs and agrees on shape
+    from distributeddataparallel_tpu.ops.quant import quantize_int8
+
+    qp = jax.jit(quantize_int8)(params)  # non-stacked on purpose
+    out2 = generate(model, qp, prompt, 8)
+    assert out2.shape == (1, 12)
