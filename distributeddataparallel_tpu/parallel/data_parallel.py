@@ -153,10 +153,15 @@ def bucket_gradients(
             if chain and len(dtypes) == 1
             else jnp.float32
         )
-        if compress == "bf16":
-            # bf16 comm-hook: every bucket crosses the wire at 2 B/elem
-            # regardless of leaf dtype (torch bf16_compress_hook
-            # semantics: compress -> average -> decompress).
+        if compress == "bf16" and all(
+            leaves[i].dtype == jnp.float32 for i in bucket
+        ):
+            # bf16 comm-hook (torch bf16_compress_hook semantics:
+            # compress -> average -> decompress), f32 buckets only — the
+            # same predicate the unbucketed leaf path applies.  A bucket
+            # holding sub-f32 leaves (bf16/fp16 grads) must not take a
+            # second precision hit, and an f64 leaf must not silently
+            # drop 45 mantissa bits on the wire.
             bdt = jnp.bfloat16
         if len(bucket) == 1:
             # Single-leaf bucket: skip the concat/flatten round-trip —

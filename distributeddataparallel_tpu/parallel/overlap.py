@@ -469,6 +469,44 @@ def cycles_by_scope(
     }
 
 
+_TPU_TOPOLOGY_PROBE: dict[str, bool] = {}
+
+
+def _probe_tpu_topology(topology: str, timeout_s: float = 20.0) -> None:
+    """Raise unless TPU AOT topology init is known to complete.
+
+    On a host with the TPU PJRT plugin installed but no TPU runtime,
+    ``get_topology_desc`` can block forever inside the plugin's C++
+    initialization (a retry loop the Python caller cannot interrupt)
+    instead of raising.  Probing in a throwaway subprocess under a
+    deadline converts that wedge into the prompt ``RuntimeError`` every
+    caller's degrade path already handles.  The verdict is cached per
+    topology string, so a process pays for the probe at most once.
+    """
+    if topology not in _TPU_TOPOLOGY_PROBE:
+        import subprocess
+        import sys
+
+        code = (
+            "from jax.experimental.topologies import get_topology_desc; "
+            f"get_topology_desc(platform='tpu', topology_name={topology!r})"
+        )
+        try:
+            res = subprocess.run(
+                [sys.executable, "-c", code],
+                capture_output=True,
+                timeout=timeout_s,
+            )
+            _TPU_TOPOLOGY_PROBE[topology] = res.returncode == 0
+        except subprocess.TimeoutExpired:
+            _TPU_TOPOLOGY_PROBE[topology] = False
+    if not _TPU_TOPOLOGY_PROBE[topology]:
+        raise RuntimeError(
+            f"TPU AOT topology {topology!r} unavailable: plugin init "
+            f"failed or wedged past {timeout_s:.0f}s in a probe subprocess"
+        )
+
+
 def tpu_topology_mesh(topology: str = "v5e:2x4", axis_names=("data",),
                       shape=None):
     """An n-chip TPU Mesh from an AOT topology description — no multi-chip
@@ -479,6 +517,7 @@ def tpu_topology_mesh(topology: str = "v5e:2x4", axis_names=("data",),
     from jax.experimental import topologies
     from jax.sharding import Mesh
 
+    _probe_tpu_topology(topology)
     topo = topologies.get_topology_desc(platform="tpu", topology_name=topology)
     devs = np.array(topo.devices)
     if shape is None:

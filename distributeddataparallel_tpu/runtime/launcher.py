@@ -17,7 +17,13 @@ forking.
   multi-host job), forks real OS processes, each with its own
   ``jax.distributed`` rendezvous over a localhost coordinator — the moral
   equivalent of the reference's TCPStore env:// rendezvous, but
-  self-contained (no MASTER_ADDR/MASTER_PORT to export; SURVEY.md §2d.1).
+  self-contained (no MASTER_ADDR/MASTER_PORT to export; SURVEY.md §2d.1);
+- with ``max_restarts > 0``, SUPERVISES: the worker gang always runs in
+  child processes (nprocs=1 included — the supervisor must survive the
+  worker's death), and any non-zero exit respawns the whole gang, up to
+  the budget.  Paired with checkpoint/elastic-resume in the worker, this
+  is the torchrun ``--max-restarts`` analog — the piece that turns a
+  preemption from a lost run into a resumed one.
 
 ``join=True`` semantics from the reference (block, propagate child failure)
 are preserved.
@@ -28,7 +34,28 @@ from __future__ import annotations
 import multiprocessing as mp
 import os
 import socket
+import time
 from typing import Any, Callable, Sequence
+
+
+MULTIPROCESS_UNSUPPORTED_EXIT = 86
+
+
+def guarded_worker(fn, process_id, *args):
+    """Run a gang worker, converting a backend capability gap into the
+    sentinel ``MULTIPROCESS_UNSUPPORTED_EXIT``: some PJRT clients (this
+    jaxlib's CPU backend among them) refuse any computation that spans
+    processes, and a supervisor or test harness wants to tell "this
+    environment cannot do multiprocess at all" apart from a real crash.
+    Wrap a worker with ``functools.partial(guarded_worker, fn)`` — the
+    partial of a module-level function survives the spawn pickling.
+    """
+    try:
+        fn(process_id, *args)
+    except Exception as exc:
+        if "Multiprocess computations aren't implemented" in str(exc):
+            raise SystemExit(MULTIPROCESS_UNSUPPORTED_EXIT) from exc
+        raise
 
 
 def _free_port() -> int:
@@ -41,32 +68,19 @@ def _child(fn, process_id, nprocs, coordinator, env, args):
     # Runs in a fresh interpreter (spawn start method): configure the JAX
     # runtime before anything imports jax.
     os.environ.update(env)
-    os.environ["JAX_COORDINATOR_ADDRESS"] = coordinator
-    os.environ["JAX_NUM_PROCESSES"] = str(nprocs)
-    os.environ["JAX_PROCESS_ID"] = str(process_id)
+    if nprocs > 1:
+        # A single supervised worker must NOT get distributed-init vars:
+        # it is a one-process job that happens to run in a child, and a
+        # stale JAX_COORDINATOR_ADDRESS would make it block on rendezvous.
+        os.environ["JAX_COORDINATOR_ADDRESS"] = coordinator
+        os.environ["JAX_NUM_PROCESSES"] = str(nprocs)
+        os.environ["JAX_PROCESS_ID"] = str(process_id)
     fn(process_id, *args)
 
 
-def spawn(
-    fn: Callable[..., Any],
-    args: Sequence[Any] = (),
-    nprocs: int = 1,
-    join: bool = True,
-    *,
-    env: dict[str, str] | None = None,
-):
-    """Run ``fn(i, *args)`` for i in range(nprocs).
-
-    nprocs=1 (the TPU-native default): direct call, no fork — one process
-    drives all local chips. nprocs>1: real OS processes with a localhost
-    coordinator, used to exercise the true multi-process code path on CPU.
-    """
-    if nprocs < 1:
-        raise ValueError(f"nprocs must be >= 1, got {nprocs}")
-    if nprocs == 1:
-        fn(0, *args)
-        return None
-
+def _run_gang(fn, args, nprocs, env) -> list:
+    """Fork one gang (fresh coordinator port per gang: a restarted gang
+    must not race the dead one's lingering socket)."""
     coordinator = f"127.0.0.1:{_free_port()}"
     ctx = mp.get_context("spawn")
     procs = []
@@ -78,13 +92,89 @@ def spawn(
         )
         p.start()
         procs.append(p)
-    if not join:
-        return procs
+    return procs
+
+
+def _join_gang(procs) -> list[tuple[int, int]]:
+    """Join every member; returns [(rank, exitcode)] for the failed ones."""
     failed = []
     for i, p in enumerate(procs):
         p.join()
         if p.exitcode != 0:
             failed.append((i, p.exitcode))
+    return failed
+
+
+def spawn(
+    fn: Callable[..., Any],
+    args: Sequence[Any] = (),
+    nprocs: int = 1,
+    join: bool = True,
+    *,
+    env: dict[str, str] | None = None,
+    max_restarts: int = 0,
+    restart_backoff_s: float = 1.0,
+):
+    """Run ``fn(i, *args)`` for i in range(nprocs).
+
+    nprocs=1 (the TPU-native default): direct call, no fork — one process
+    drives all local chips. nprocs>1: real OS processes with a localhost
+    coordinator, used to exercise the true multi-process code path on CPU.
+
+    ``max_restarts > 0`` adds supervision (torchrun ``--max-restarts``
+    semantics): the gang runs in child processes even for nprocs=1, and
+    when ANY member exits non-zero — a crash, a preemption kill, the step
+    watchdog's deliberate exit-75 — the WHOLE gang is respawned (after
+    joining the survivors; a partial gang cannot rendezvous) with a fresh
+    coordinator port, up to ``max_restarts`` times with linear backoff.
+    The worker owns resume correctness: it must restore from its latest
+    checkpoint on startup (``--resume`` / elastic restore), which is what
+    makes restart-from-zero into restart-from-last-epoch.  Requires
+    ``join=True`` — supervision IS a blocking join loop.
+    """
+    if nprocs < 1:
+        raise ValueError(f"nprocs must be >= 1, got {nprocs}")
+    if max_restarts < 0:
+        raise ValueError(f"max_restarts must be >= 0, got {max_restarts}")
+    if max_restarts > 0:
+        if not join:
+            raise ValueError(
+                "max_restarts needs join=True: supervision is a blocking "
+                "join-and-respawn loop, there is no handle to return"
+            )
+        from distributeddataparallel_tpu.utils.logging import get_logger
+
+        for attempt in range(max_restarts + 1):
+            # The worker can surface its incarnation (FaultCounters.restarts,
+            # log lines) without any side channel back from the supervisor.
+            gang_env = dict(env or {})
+            gang_env["DDP_RESTART_ATTEMPT"] = str(attempt)
+            procs = _run_gang(fn, args, nprocs, gang_env)
+            failed = _join_gang(procs)
+            if not failed:
+                return None
+            if attempt >= max_restarts:
+                raise RuntimeError(
+                    f"spawned processes failed (rank, exitcode): {failed} "
+                    f"— restart budget of {max_restarts} exhausted"
+                )
+            get_logger().warning(
+                "[supervisor] gang failed (rank, exitcode): %s — "
+                "restart %d/%d after %.1fs",
+                failed, attempt + 1, max_restarts,
+                restart_backoff_s * (attempt + 1),
+            )
+            time.sleep(restart_backoff_s * (attempt + 1))
+        return None  # unreachable
+
+    if nprocs == 1:
+        fn(0, *args)
+        return None
+
+    procs = _run_gang(fn, args, nprocs, env)
+    if not join:
+        return procs
+    failed = _join_gang(procs)
     if failed:
         # Mirror mp.spawn join=True: surface child failure in the parent.
         raise RuntimeError(f"spawned processes failed (rank, exitcode): {failed}")

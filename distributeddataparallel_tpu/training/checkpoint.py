@@ -106,6 +106,20 @@ class Checkpointer:
         state = _merge_arrays(state, restored)
         return state, step + 1
 
+    def _lacks_comm_state(self, step: int) -> bool:
+        """Structural check for a legacy (pre-``comm_state``) checkpoint:
+        ask the manager what keys the step actually holds rather than
+        pattern-matching orbax's error text, which changes across
+        versions.  ``item_metadata`` reads only the step's metadata files
+        — no array IO."""
+        try:
+            md = self._mgr.item_metadata(step)
+        except Exception:  # noqa: BLE001 — unreadable metadata is not
+            return False  # this fallback's case; let restore raise it
+        if md is None or not hasattr(md, "__contains__"):
+            return False
+        return "comm_state" not in md
+
     def _restore(self, step: int, template: Pytree) -> Pytree:
         """Standard restore, with a legacy fallback: checkpoints written
         before TrainState grew ``comm_state`` have no such node on disk,
@@ -118,11 +132,11 @@ class Checkpointer:
             return self._mgr.restore(
                 step, args=ocp.args.StandardRestore(template)
             )
-        except ValueError as e:
+        except ValueError:
             empty_comm = not jax.tree.leaves(
                 getattr(template, "comm_state", {"x": 1})
             )
-            if "comm_state" not in str(e) or not empty_comm:
+            if not empty_comm or not self._lacks_comm_state(step):
                 raise
             # One-off read-only manager: self._mgr bound its handler
             # registry to StandardRestore on first use and would reject

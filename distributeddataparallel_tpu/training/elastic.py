@@ -317,27 +317,20 @@ def elastic_restore(
             )
 
             # The old-degree residuals are restored only to satisfy the
-            # saved tree structure and then dropped.  Spread the
-            # throwaway rows over the new data axis when the counts
-            # divide (the common downsize path — keeps per-device peak
-            # at n_old/n_new x one residual tree); otherwise fall back
-            # to one device.
-            from jax.sharding import PartitionSpec as P_
-
-            if n_old % n_new == 0:
-                err_shard = NamedSharding(mesh, P_(data_axis))
-            else:
-                err_shard = jax.sharding.SingleDeviceSharding(
-                    jax.devices()[0]
-                )
+            # saved tree structure and then dropped — so restore them
+            # HOST-SIDE: a numpy template leaf makes orbax hand back a
+            # numpy array, touching no device memory at all.  (The
+            # previous scheme materialized the throwaway rows on
+            # jax.devices()[0] for non-divisible resizes — a single-device
+            # HBM spike sized by the OLD degree, exactly when a shrink is
+            # under memory pressure.)
             old_template = state.replace(
                 comm_state=jax.tree.map(
                     lambda e: (
                         None if e is None else PowerSGDLeaf(
                             q=e.q,
-                            err=jax.ShapeDtypeStruct(
-                                (n_old, *e.err.shape[1:]), e.err.dtype,
-                                sharding=err_shard,
+                            err=np.zeros(
+                                (n_old, *e.err.shape[1:]), e.err.dtype
                             ),
                         )
                     ),

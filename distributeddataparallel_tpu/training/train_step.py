@@ -65,6 +65,7 @@ def make_train_step(
     grad_clip: float | None = None,
     presynced: Callable[[tuple], bool] | None = None,
     grad_compress: str | None = None,
+    nonfinite_guard: bool = False,
 ):
     """Build the jit'd DP train step.
 
@@ -119,7 +120,12 @@ def make_train_step(
     state (warm Q + residual) updates once per sync boundary and is
     checkpointed with the rest of the state.  Lossy by design: replicas
     stay in exact lockstep, training tracks dense DP closely
-    (``tests/test_powersgd.py``); does not compose with ``presynced``.
+    (``tests/test_powersgd.py``); does not compose with ``presynced``,
+    and is REJECTED with ``tp_axis``/``ep_axis``: the hook's factor
+    all-reduce and error-feedback state are data-axis-only — a
+    TP/EP-sharded gradient leaf would be compressed per model-shard with
+    no cross-shard consistency, silently corrupting the low-rank
+    approximation rather than degrading gracefully.
 
     With ``zero=True``, optimizer state is ZeRO-1-sharded across the data
     axis (see ``parallel.zero``): grads reduce_scatter instead of
@@ -176,6 +182,21 @@ def make_train_step(
     leaves count once — every position computes the same global norm, so
     the scale stays uniform.
 
+    ``nonfinite_guard=True`` adds the numerical fault guard: before any
+    gradient leaves this position (sync, compression hook, optimizer),
+    the step computes a mesh-uniform "all gradients finite" bit
+    (``lax.pmin`` across the data and model axes, so every position
+    reaches the same verdict).  On a bad step the gradients are zeroed —
+    a NaN must never reach the powersgd error-feedback state or the
+    wire — and the ENTIRE state update is discarded (params, optimizer
+    moments, model buffers, comm hook state all keep their old values;
+    zeroed grads would still move Adam's moments, so masking grads alone
+    is not a skip).  Only ``state.step`` advances, and the step reports
+    ``metrics['nonfinite_grad']`` (0.0/1.0) for host-side accounting —
+    ``training.fault_tolerance.NonFiniteBreaker`` turns a run of them
+    into a hard stop.  This is the torch ``GradScaler.step``-skip analog
+    for bf16/f32 training, where there is no loss scale to shrink.
+
     ``ep_axis`` adds expert parallelism for MoE configs
     (``parallel.expert_parallel``): expert weight stacks shard over the
     axis, the batch replicates, and — as with TP — the MoE module's
@@ -211,6 +232,17 @@ def make_train_step(
         # hook could see them — the two mechanisms don't compose.
         raise ValueError("grad_compress='powersgd' does not compose with "
                          "presynced (in-scan-body grad sync)")
+    if grad_compress == "powersgd" and (
+        tp_axis is not None or ep_axis is not None
+    ):
+        # The hook all-reduces low-rank factors over the DATA axis only
+        # and its error-feedback state carries no model-axis sharding:
+        # a TP/EP-sharded leaf would be compressed per model shard with
+        # no cross-shard agreement on the factors — silent corruption,
+        # not graceful degradation.  Reject like presynced/zero above.
+        raise ValueError("grad_compress='powersgd' does not compose with "
+                         "tp_axis/ep_axis: the low-rank factor reduction "
+                         "and error-feedback state are data-axis-only")
     if grad_clip is not None and not grad_sync:
         # Unsynced per-replica grads have per-replica norms: clipping
         # would scale each replica differently (same divergence as the
@@ -243,6 +275,7 @@ def make_train_step(
     def _replica_step(state: TrainState, batch: Pytree, rng: jax.Array):
         # Runs per mesh position under shard_map: `batch` is this replica's
         # shard; params/opt state are replicated.
+        orig_state = state  # pre-update snapshot for the nonfinite guard
         idx = lax.axis_index(axis_name)
         rng = jax.random.fold_in(rng, idx)
         if cp_axis is not None:
@@ -303,6 +336,29 @@ def make_train_step(
             grads = jax.tree.map(lambda g: lax.pmean(g, cp_axis), grads)
             loss = lax.pmean(loss, cp_axis)
             aux = jax.tree.map(lambda a: lax.pmean(a, cp_axis), aux)
+
+        if nonfinite_guard:
+            # Decide BEFORE any gradient leaves this position: a NaN must
+            # never reach the wire, the powersgd error-feedback state, or
+            # ZeRO's reduce_scatter.  pmin over the data + model axes
+            # makes the verdict mesh-uniform — every position skips (or
+            # applies) together, keeping replicas in lockstep.  (cp_axis
+            # needs no pmin: grads were just pmean'd over it, so all CP
+            # positions already hold identical values.)
+            ok = jnp.bool_(True)
+            for g in jax.tree.leaves(grads):
+                ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(g)))
+            fin = ok.astype(jnp.float32)
+            for ax in (axis_name, tp_axis, ep_axis):
+                if ax is not None:
+                    fin = lax.pmin(fin, ax)
+            ok = fin > 0
+            # Zeroed (not masked-out) grads keep every downstream path —
+            # sync, compression, clip, update — shape- and control-flow-
+            # identical; the state select below undoes their effect.
+            grads = jax.tree.map(
+                lambda g: jnp.where(ok, g, jnp.zeros_like(g)), grads
+            )
 
         if zero:
             # ZeRO-1: reduce_scatter + sharded update + all_gather.
@@ -447,10 +503,23 @@ def make_train_step(
 
                 new_ms = jax.tree.map(_bcast, new_ms)
             new_state = new_state.replace(model_state=new_ms)
+        if nonfinite_guard:
+            # Skip-step semantics: zeroed grads still advance Adam's
+            # moments and weight decay, so masking grads alone is not a
+            # skip — discard the WHOLE update (params, optimizer moments,
+            # buffers, comm hook state) and let only the step counter
+            # advance, mirroring torch GradScaler's skipped step.
+            new_state = jax.tree.map(
+                lambda n, o: jnp.where(ok, n, o), new_state, orig_state
+            )
+            new_state = new_state.replace(step=orig_state.step + 1)
         metrics = {"loss": lax.pmean(loss, axis_name)}
         metrics.update(
             {k: lax.pmean(v, axis_name) for k, v in aux.items()}
         )
+        if nonfinite_guard:
+            # Already mesh-uniform (pmin above): no further reduction.
+            metrics["nonfinite_grad"] = 1.0 - fin
         return new_state, metrics
 
     # Params/opt-state replicated (P()), batch sharded on the data axis

@@ -1,5 +1,11 @@
-from distributeddataparallel_tpu.utils.logging import log0, get_logger  # noqa: F401
+from distributeddataparallel_tpu.utils.logging import (  # noqa: F401
+    get_logger,
+    log0,
+    warn0,
+    warn_all,
+)
 from distributeddataparallel_tpu.utils.metrics import (  # noqa: F401
+    FaultCounters,
     StepTimer,
     allreduce_bandwidth,
     overlap_probe,

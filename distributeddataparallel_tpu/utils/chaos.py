@@ -1,0 +1,230 @@
+"""Deterministic fault injection: the chaos harness behind ``--chaos``.
+
+Production fault tolerance that has never seen a fault is a hypothesis,
+not a feature.  This module makes every recovery path in
+``training.fault_tolerance`` testable on the 8-device CPU mesh by
+injecting the faults a real pod run produces — checkpoint-IO errors,
+slow/hung steps, non-finite gradients, worker preemption — at chosen,
+reproducible points:
+
+    DDP_CHAOS="ckpt-io@0,nan-grad@3,slow-step@5:2.5,preempt@12" python dpp.py ...
+    python dpp.py --chaos "preempt@12" --max-restarts 2 ...
+
+Spec grammar (comma-separated entries, all steps 0-based)::
+
+    ckpt-io@N[:K]      fail the N-th checkpoint *save call*'s first K
+                       attempts (default 1) with an injected IOError —
+                       exercises the bounded-retry path
+    nan-grad@S         poison the step-S batch with a NaN so the
+                       gradients go non-finite — exercises the skip-step
+                       guard (float batches only)
+    slow-step@S[:SEC]  sleep SEC seconds (default 30) before step S —
+                       exercises the step watchdog
+    preempt@S          raise SimulatedPreemption before step S — with
+                       launcher supervision (``--max-restarts``) the
+                       worker dies and resumes from the last checkpoint
+
+Determinism across restarts: with a ``state_dir`` (defaults to
+``<checkpoint_dir>/.chaos`` in the CLI), each entry fires AT MOST ONCE
+across process restarts — a marker file records the firing, so a
+restarted worker does not re-hit the same preemption and crash-loop.
+Without a state dir, entries fire once per process.
+
+Import-light by design (no jax at module import): the launcher's
+supervisor process and spec validation at CLI-parse time must not drag
+in a device runtime.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+__all__ = [
+    "FaultInjector",
+    "InjectedIOError",
+    "SimulatedPreemption",
+    "parse_chaos_spec",
+]
+
+KINDS = ("ckpt-io", "nan-grad", "slow-step", "preempt")
+
+
+class SimulatedPreemption(RuntimeError):
+    """An injected worker death — the chaos analog of a TPU-VM preemption
+    that delivers no graceful SIGTERM (the host just goes away)."""
+
+
+class InjectedIOError(IOError):
+    """An injected transient checkpoint-IO failure."""
+
+
+class _Entry:
+    __slots__ = ("kind", "step", "arg", "key")
+
+    def __init__(self, kind: str, step: int, arg: str | None):
+        self.kind = kind
+        self.step = step
+        self.arg = arg
+        # Stable identity for once-markers: the spec text itself.
+        self.key = f"{kind}@{step}" + (f":{arg}" if arg is not None else "")
+
+    def __repr__(self) -> str:  # error messages / logs
+        return self.key
+
+
+def parse_chaos_spec(spec: str) -> list[_Entry]:
+    """Parse ``kind@step[:arg]`` entries; raises ValueError with the
+    grammar on any malformed entry (surfaced as a SystemExit at CLI
+    parse time, not a crash mid-run)."""
+    entries: list[_Entry] = []
+    for raw in (spec or "").split(","):
+        raw = raw.strip()
+        if not raw:
+            continue
+        kind, sep, rest = raw.partition("@")
+        step_s, _, arg = rest.partition(":")
+        try:
+            if kind not in KINDS or not sep:
+                raise ValueError
+            step = int(step_s)
+            if step < 0:
+                raise ValueError
+            if arg:
+                # Validate eagerly: a typo'd argument must fail at parse,
+                # not at fire time deep into a run.
+                float(arg) if kind == "slow-step" else int(arg)
+            elif kind in ("slow-step", "ckpt-io"):
+                arg = ""
+            if kind in ("nan-grad", "preempt") and arg:
+                raise ValueError
+        except ValueError:
+            raise ValueError(
+                f"bad chaos entry {raw!r}: expected one of "
+                "ckpt-io@N[:K] | nan-grad@S | slow-step@S[:SECONDS] | "
+                "preempt@S (comma-separated)"
+            ) from None
+        entries.append(_Entry(kind, step, arg or None))
+    return entries
+
+
+class FaultInjector:
+    """Env/CLI-configurable deterministic fault injector.
+
+    ``spec`` is the chaos grammar above; ``state_dir`` (optional) makes
+    each entry fire at most once ACROSS restarts via marker files.  An
+    empty spec produces a disabled injector whose hooks are all no-ops,
+    so call sites need no conditional wiring.
+    """
+
+    def __init__(self, spec: str = "", state_dir: str | None = None):
+        self._entries = parse_chaos_spec(spec)
+        self._state_dir = state_dir
+        self._fired_local: set[str] = set()
+        # Entries this PROCESS started firing (a multi-attempt ckpt-io
+        # entry keeps failing attempts here even after its cross-restart
+        # marker is written).
+        self._owned: set[str] = set()
+        if self._entries and state_dir:
+            os.makedirs(state_dir, exist_ok=True)
+
+    @classmethod
+    def from_env(cls) -> "FaultInjector":
+        return cls(
+            os.environ.get("DDP_CHAOS", ""),
+            os.environ.get("DDP_CHAOS_STATE") or None,
+        )
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self._entries)
+
+    def wants(self, kind: str) -> bool:
+        return any(e.kind == kind for e in self._entries)
+
+    # -- once-semantics ------------------------------------------------
+    def _marker(self, key: str) -> str | None:
+        if self._state_dir is None:
+            return None
+        return os.path.join(
+            self._state_dir, key.replace("@", "_at_").replace(":", "_")
+        )
+
+    def _already_fired(self, key: str) -> bool:
+        if key in self._fired_local:
+            return True
+        m = self._marker(key)
+        return m is not None and os.path.exists(m)
+
+    def _mark(self, key: str) -> None:
+        self._fired_local.add(key)
+        m = self._marker(key)
+        if m is not None:
+            with open(m, "w") as fh:
+                fh.write(str(time.time()))
+
+    def _take(self, kind: str, step: int) -> _Entry | None:
+        """The unfired entry of ``kind`` scheduled for ``step``, marked
+        fired as a side effect (None when nothing fires)."""
+        for e in self._entries:
+            if e.kind == kind and e.step == step \
+                    and not self._already_fired(e.key):
+                # Mark BEFORE the fault takes effect: a preemption raise
+                # must not recur after the supervisor restarts us.
+                self._mark(e.key)
+                return e
+        return None
+
+    # -- injection hooks ----------------------------------------------
+    def before_step(self, step: int) -> None:
+        """Call at the top of each train-loop iteration with the global
+        step index.  May sleep (slow-step) or raise SimulatedPreemption."""
+        e = self._take("slow-step", step)
+        if e is not None:
+            time.sleep(float(e.arg or 30.0))
+        e = self._take("preempt", step)
+        if e is not None:
+            raise SimulatedPreemption(
+                f"chaos: simulated worker preemption at step {step}"
+            )
+
+    def corrupt_batch(self, batch, step: int):
+        """Return ``batch`` with one NaN planted in its first float leaf
+        when a ``nan-grad`` entry fires at ``step`` (identity otherwise).
+        One NaN input is enough: it propagates through the forward/backward
+        to every gradient leaf, which is exactly the shape of a real
+        numerical blow-up."""
+        if self._take("nan-grad", step) is None:
+            return batch
+        import jax
+        import jax.numpy as jnp
+
+        leaves, treedef = jax.tree.flatten(batch)
+        for i, leaf in enumerate(leaves):
+            if hasattr(leaf, "dtype") and jnp.issubdtype(
+                leaf.dtype, jnp.inexact
+            ):
+                leaves[i] = leaf.at[(0,) * leaf.ndim].set(jnp.nan)
+                return jax.tree.unflatten(treedef, leaves)
+        raise ValueError(
+            "chaos nan-grad needs a float leaf in the batch to poison "
+            "(integer-token LM batches cannot carry a NaN input)"
+        )
+
+    def fail_io(self, ordinal: int, attempt: int) -> None:
+        """Call from inside the checkpoint retry loop with the save-call
+        ordinal (0-based count of save() calls this process) and the
+        attempt index.  Raises InjectedIOError for the first K attempts
+        of a matching ``ckpt-io@N[:K]`` entry."""
+        for e in self._entries:
+            if e.kind != "ckpt-io" or e.step != ordinal:
+                continue
+            if e.key not in self._owned and self._already_fired(e.key):
+                continue  # injected by a previous incarnation
+            if attempt < int(e.arg or 1):
+                self._owned.add(e.key)
+                self._mark(e.key)
+                raise InjectedIOError(
+                    f"chaos: injected checkpoint-IO failure "
+                    f"({e.key}, attempt {attempt})"
+                )

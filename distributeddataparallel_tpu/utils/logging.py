@@ -38,3 +38,18 @@ def log0(msg: str, *args) -> None:
     """Log from process 0 only (analog of the rank-0 gate at ref dpp.py:54)."""
     if jax.process_index() == 0:
         get_logger().info(msg, *args)
+
+
+def warn0(msg: str, *args) -> None:
+    """Warning-level rank-0 log — fault-path events (checkpoint retries,
+    skipped non-finite steps, watchdog fires) that must stand out from
+    the loss cadence in the stream."""
+    if jax.process_index() == 0:
+        get_logger().warning(msg, *args)
+
+
+def warn_all(msg: str, *args) -> None:
+    """Warning from EVERY process, prefixed with its index — for faults
+    that are per-worker facts (a watchdog firing on rank 3 must not be
+    silenced by the rank-0 gate; rank 0 may be the healthy one)."""
+    get_logger().warning(f"[proc {jax.process_index()}] {msg}", *args)

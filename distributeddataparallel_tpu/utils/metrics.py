@@ -69,6 +69,41 @@ class StepTimer:
         return reading
 
 
+class FaultCounters:
+    """Run-level fault accounting — the observability face of the
+    fault-tolerance subsystem (``training.fault_tolerance``).
+
+    Mutated by the resilient checkpointer (IO retries, corrupt-step
+    fallbacks), the train loop (skipped non-finite steps), the watchdog,
+    and the supervisor; ``summary()`` goes into the end-of-run log so a
+    run that survived faults SAYS so — silent recovery hides operational
+    signal (a climbing retry count is a failing filesystem).
+    """
+
+    def __init__(self):
+        self.nonfinite_steps = 0
+        self.io_retries = 0
+        self.ckpt_fallbacks = 0
+        self.watchdog_fires = 0
+        self.restarts = 0
+
+    @property
+    def total(self) -> int:
+        return (
+            self.nonfinite_steps + self.io_retries + self.ckpt_fallbacks
+            + self.watchdog_fires + self.restarts
+        )
+
+    def summary(self) -> dict:
+        return {
+            "nonfinite_steps": self.nonfinite_steps,
+            "ckpt_io_retries": self.io_retries,
+            "ckpt_fallbacks": self.ckpt_fallbacks,
+            "watchdog_fires": self.watchdog_fires,
+            "restarts": self.restarts,
+        }
+
+
 @contextlib.contextmanager
 def profile_trace(log_dir: str | None, *, sync: object = None):
     """jax.profiler trace scope (XProf/TensorBoard).  No-op if dir is None.

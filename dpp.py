@@ -212,6 +212,37 @@ def parse_args(argv=None):
                    help="synthetic dataset size")
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--resume", action="store_true")
+    p.add_argument("--max-restarts", type=int, default=0,
+                   help="supervise the worker and restart it up to N "
+                        "times on any crash — preemption, watchdog "
+                        "exit, injected chaos (torchrun --max-restarts "
+                        "analog).  Requires --checkpoint-dir; each "
+                        "restart resumes from the newest intact "
+                        "checkpoint")
+    p.add_argument("--step-timeout", type=float, default=None,
+                   help="wall-clock deadline in seconds per train step "
+                        "(armed after the first, compile-bearing step): "
+                        "a wedged step logs a diagnostic, best-effort "
+                        "checkpoints the last completed state, and "
+                        "exits 75 instead of hanging — with "
+                        "--max-restarts the supervisor then restarts")
+    p.add_argument("--chaos", default=None, metavar="SPEC",
+                   help="deterministic fault injection for testing the "
+                        "recovery paths (utils.chaos; also via the "
+                        "DDP_CHAOS env var): comma-separated "
+                        "ckpt-io@N[:K] | nan-grad@S | slow-step@S[:SEC] "
+                        "| preempt@S")
+    p.add_argument("--nan-guard", action="store_true",
+                   help="skip-step numerical guard: a step whose "
+                        "gradients contain NaN/Inf applies NO update "
+                        "(params/opt state/hook state keep their "
+                        "values) and is counted; --max-bad-steps "
+                        "consecutive bad steps abort the run.  Adds "
+                        "one host sync per step")
+    p.add_argument("--max-bad-steps", type=int, default=5,
+                   help="with --nan-guard: consecutive non-finite-grad "
+                        "steps tolerated before the run aborts as "
+                        "diverged")
     p.add_argument("--eval", action="store_true", help="run eval after each epoch")
     p.add_argument("--decode-quant", choices=["int8"], default=None,
                    help="serve --generate with int8-quantized matrices "
@@ -251,8 +282,9 @@ def select_device(args) -> None:
     if args.fake_devices:
         if args.device not in ("auto", "cpu"):
             raise SystemExit("--fake-devices requires --device cpu")
-        jax.config.update("jax_platforms", "cpu")
-        jax.config.update("jax_num_cpu_devices", args.fake_devices)
+        from distributeddataparallel_tpu.compat import configure_cpu_devices
+
+        configure_cpu_devices(args.fake_devices)
     elif args.device == "cpu":
         jax.config.update("jax_platforms", "cpu")
     elif args.device in ("tpu", "cuda"):
@@ -394,6 +426,31 @@ def validate_args(args) -> None:
             )
     if args.grad_clip is not None and args.grad_clip <= 0:
         raise SystemExit("--grad-clip must be > 0")
+    if args.max_restarts:
+        if args.max_restarts < 0:
+            raise SystemExit("--max-restarts must be >= 0")
+        if not args.checkpoint_dir:
+            # A restart without a checkpoint replays the run from zero —
+            # that is a retry loop, not fault tolerance.
+            raise SystemExit("--max-restarts requires --checkpoint-dir "
+                             "(restarts resume from the last checkpoint)")
+    if args.step_timeout is not None and args.step_timeout <= 0:
+        raise SystemExit("--step-timeout must be > 0 seconds")
+    if args.chaos:
+        from distributeddataparallel_tpu.utils.chaos import parse_chaos_spec
+
+        try:
+            parse_chaos_spec(args.chaos)
+        except ValueError as e:
+            raise SystemExit(f"--chaos: {e}")
+    if args.nan_guard:
+        if args.fsdp or args.pp > 1:
+            # Those step factories own their update loops; the guard is
+            # wired through make_train_step only.
+            raise SystemExit("--nan-guard supports the DP/ZeRO/TP/EP/CP "
+                             "step; drop --fsdp/--pp")
+        if args.max_bad_steps < 1:
+            raise SystemExit("--max-bad-steps must be >= 1")
     if args.overlap:
         # ZeRO/FSDP/PP own their reductions (reduce_scatter / per-layer
         # gathers / stage collectives) — the chained-bucket overlap path
@@ -671,6 +728,8 @@ def train(args) -> float:
         allreduce_bandwidth,
         log0,
         profile_trace,
+        warn0,
+        warn_all,
     )
 
     mesh = setup(args)
@@ -956,6 +1015,7 @@ def train(args) -> float:
                            None)
                 else None
             ),
+            nonfinite_guard=args.nan_guard,
         )
 
     def full_params():
@@ -982,17 +1042,49 @@ def train(args) -> float:
             return jax.tree.map(jnp.asarray, host)
         return state.params
 
+    # Fault-tolerance wiring (training.fault_tolerance / utils.chaos):
+    # the injector is a no-op unless --chaos / DDP_CHAOS asks for faults;
+    # the counters make any recovery visible in the end-of-run log.
+    from distributeddataparallel_tpu.training.fault_tolerance import (
+        NonFiniteBreaker,
+        ResilientCheckpointer,
+        StepWatchdog,
+    )
+    from distributeddataparallel_tpu.utils.chaos import (
+        FaultInjector,
+        SimulatedPreemption,
+    )
+    from distributeddataparallel_tpu.utils.metrics import FaultCounters
+
+    counters = FaultCounters()
+    # Set by the launcher's supervision loop: which incarnation this is.
+    counters.restarts = int(os.environ.get("DDP_RESTART_ATTEMPT", "0") or 0)
+    if args.chaos:
+        # Marker state under the checkpoint dir: each chaos entry fires
+        # at most once ACROSS supervised restarts.
+        injector = FaultInjector(
+            args.chaos,
+            state_dir=(
+                os.path.join(args.checkpoint_dir, ".chaos")
+                if args.checkpoint_dir else None
+            ),
+        )
+    else:
+        injector = FaultInjector.from_env()
+    breaker = NonFiniteBreaker(args.max_bad_steps) if args.nan_guard else None
+
     ckpt = None
     start_epoch = 0
     preempted = {"signal": None}
     if args.checkpoint_dir:
-        from distributeddataparallel_tpu.training.checkpoint import Checkpointer
         from distributeddataparallel_tpu.training.elastic import (
             elastic_restore,
             topology_meta,
         )
 
-        ckpt = Checkpointer(args.checkpoint_dir)
+        ckpt = ResilientCheckpointer(
+            args.checkpoint_dir, injector=injector, counters=counters
+        )
         flat_tp = (
             "model"
             if ((args.fsdp or args.zero) and args.tp > 1)
@@ -1215,73 +1307,134 @@ def train(args) -> float:
         items_per_step, unit = args.batch_size * n_replicas, "img"
     timer = StepTimer(window=max(20, args.log_every))
 
+    # Step watchdog: a wedged collective or infeed stall should produce a
+    # diagnostic and a best-effort checkpoint, not a silent hang.  Armed
+    # only after the first step completes so compile time never counts
+    # against the deadline.
+    watchdog = None
+    if args.step_timeout:
+        def _on_wedge(diag):
+            counters.watchdog_fires += 1
+            if ckpt is None:
+                return
+            # Best-effort: saving may itself block on the wedged
+            # computation, in which case the watchdog's grace timer
+            # still terminates the process.
+            try:
+                last = diag.get("last_known_state") or {}
+                ckpt.save(state, int(last.get("epoch", start_epoch)),
+                          meta=ckpt_meta)
+            except Exception:  # noqa: BLE001 — the process is exiting
+                warn_all("watchdog: emergency checkpoint failed")
+        watchdog = StepWatchdog(args.step_timeout, on_timeout=_on_wedge)
+
+    # Global step index for the chaos schedule: stable across restarts
+    # because it is (epoch, batch)-derived, not a live counter.
+    spe = len(loader)
+    if args.steps_per_epoch:
+        spe = min(spe, args.steps_per_epoch)
+
     last_loss = float("nan")
     # Per-step RNG is a pure function of (seed, epoch, batch): a --resume'd
     # run continues the exact stochastic stream (dropout etc.) the
     # uninterrupted run would have used, instead of replaying epoch-0 keys.
     base_rng = jax.random.PRNGKey(args.seed + 1)
-    for epoch in range(start_epoch, args.epochs):        # ref dpp.py:44
-        epoch_rng = jax.random.fold_in(base_rng, epoch)
-        with profile_trace(
-            args.profile_dir if epoch == start_epoch else None,
-            sync=lambda: state.params,  # resolves to the latest state at exit
-        ):
-            loader.set_epoch(epoch)                      # ref dpp.py:46
-            for batch_idx, batch in enumerate(loader):   # ref dpp.py:47
-                if args.steps_per_epoch and batch_idx >= args.steps_per_epoch:
-                    break
-                sub = jax.random.fold_in(epoch_rng, batch_idx)
-                state, metrics = step_fn(state, batch, sub)
-                reading = timer.tick(items_per_step, sync=state.step)
-                if reading and not reading["warmup"]:
-                    log0(
-                        "throughput: %.0f %s/s (%.1f %s/s/chip)",
-                        reading["items_per_s"], unit,
-                        reading["items_per_s_per_chip"], unit,
+    try:
+        for epoch in range(start_epoch, args.epochs):    # ref dpp.py:44
+            epoch_rng = jax.random.fold_in(base_rng, epoch)
+            with profile_trace(
+                args.profile_dir if epoch == start_epoch else None,
+                sync=lambda: state.params,  # resolves to latest state at exit
+            ):
+                loader.set_epoch(epoch)                  # ref dpp.py:46
+                for batch_idx, batch in enumerate(loader):  # ref dpp.py:47
+                    if args.steps_per_epoch \
+                            and batch_idx >= args.steps_per_epoch:
+                        break
+                    gstep = epoch * spe + batch_idx
+                    injector.before_step(gstep)   # slow-step / preempt
+                    batch = injector.corrupt_batch(batch, gstep)
+                    sub = jax.random.fold_in(epoch_rng, batch_idx)
+                    state, metrics = step_fn(state, batch, sub)
+                    if breaker is not None:
+                        # Per-step sync, same cost shape as GradScaler's
+                        # found_inf readback — the price of the guard.
+                        bad = float(metrics["nonfinite_grad"])
+                        if bad:
+                            counters.nonfinite_steps += 1
+                            warn0(
+                                "non-finite gradients at epoch %d batch %d:"
+                                " update skipped", epoch, batch_idx,
+                            )
+                        breaker.observe(bad)
+                    if watchdog is not None:
+                        if watchdog.running:
+                            watchdog.beat(epoch=epoch, batch=batch_idx)
+                        else:
+                            jax.block_until_ready(state.step)
+                            watchdog.start(epoch=epoch, batch=batch_idx)
+                    reading = timer.tick(items_per_step, sync=state.step)
+                    if reading and not reading["warmup"]:
+                        log0(
+                            "throughput: %.0f %s/s (%.1f %s/s/chip)",
+                            reading["items_per_s"], unit,
+                            reading["items_per_s_per_chip"], unit,
+                        )
+                    if batch_idx % args.log_every == 0:  # ref dpp.py:54-55
+                        last_loss = float(metrics["loss"])
+                        log0("Epoch %d, Batch %d, Loss: %.4f",
+                             epoch, batch_idx, last_loss)
+                    if ckpt is not None and preempt_agreed(batch_idx):
+                        ckpt.save(state, epoch, meta=ckpt_meta)
+                        ckpt.wait()
+                        log0("preempted: checkpoint saved mid-epoch %d; "
+                             "--resume continues from epoch %d",
+                             epoch, epoch + 1)
+                        ddp.destroy_process_group()
+                        return float(metrics["loss"])
+            last_loss = float(metrics["loss"])
+            if eval_step is not None:
+                # Masked eval: each step returns (masked means, valid-row
+                # count); weighting means by counts is exactly the mean over
+                # unique samples — sampler pad duplicates contribute nothing.
+                # FSDP streams over the sharded flats; everything else gets
+                # the (possibly gathered) model-layout tree.
+                eval_params = state.params if args.fsdp else full_params()
+                evals = []
+                for b in eval_loader:
+                    m, cnt = (
+                        eval_step(eval_params, state.model_state, b)
+                        if has_ms and not cp
+                        else eval_step(eval_params, b)
                     )
-                if batch_idx % args.log_every == 0:      # ref dpp.py:54-55
-                    last_loss = float(metrics["loss"])
-                    log0("Epoch %d, Batch %d, Loss: %.4f",
-                         epoch, batch_idx, last_loss)
-                if ckpt is not None and preempt_agreed(batch_idx):
-                    ckpt.save(state, epoch, meta=ckpt_meta)
-                    ckpt.wait()
-                    log0("preempted: checkpoint saved mid-epoch %d; "
-                         "--resume continues from epoch %d", epoch, epoch + 1)
-                    ddp.destroy_process_group()
-                    return float(metrics["loss"])
-        last_loss = float(metrics["loss"])
-        if eval_step is not None:
-            # Masked eval: each step returns (masked means, valid-row
-            # count); weighting means by counts is exactly the mean over
-            # unique samples — sampler pad duplicates contribute nothing.
-            # FSDP streams over the sharded flats; everything else gets
-            # the (possibly gathered) model-layout tree.
-            eval_params = state.params if args.fsdp else full_params()
-            evals = []
-            for b in eval_loader:
-                m, cnt = (
-                    eval_step(eval_params, state.model_state, b)
-                    if has_ms and not cp
-                    else eval_step(eval_params, b)
-                )
-                evals.append((m, float(cnt)))
-            # Free the gathered copy NOW — keeping a full replicated
-            # param tree alive through the next training epoch would
-            # undo exactly the memory FSDP shards away.
-            del eval_params
-            if evals:
-                total = sum(n for _, n in evals)
-                mean = {
-                    k: float(sum(float(e[k]) * n for e, n in evals) / total)
-                    for k in evals[0][0]
-                }
-                log0("Epoch %d eval: %s", epoch, mean)
-        if ckpt is not None:
-            ckpt.save(state, epoch, meta=ckpt_meta)
-        if eval_step is not None or ckpt is not None:
-            # Don't let eval/checkpoint wall time pollute throughput.
-            timer.reset()
+                    evals.append((m, float(cnt)))
+                # Free the gathered copy NOW — keeping a full replicated
+                # param tree alive through the next training epoch would
+                # undo exactly the memory FSDP shards away.
+                del eval_params
+                if evals:
+                    total = sum(n for _, n in evals)
+                    mean = {
+                        k: float(sum(float(e[k]) * n for e, n in evals) / total)
+                        for k in evals[0][0]
+                    }
+                    log0("Epoch %d eval: %s", epoch, mean)
+            if ckpt is not None:
+                ckpt.save(state, epoch, meta=ckpt_meta)
+            if eval_step is not None or ckpt is not None:
+                # Don't let eval/checkpoint wall time pollute throughput.
+                timer.reset()
+    except SimulatedPreemption as pe:
+        # Chaos preemption dies the way a real one does — abruptly and
+        # nonzero, WITHOUT a parting checkpoint — so the supervisor
+        # (--max-restarts) resumes from the last durable epoch.
+        warn_all("%s", pe)
+        raise SystemExit(1) from pe
+    finally:
+        if watchdog is not None:
+            watchdog.stop()
+    if counters.total:
+        log0("fault summary: %s", counters.summary())
 
     if args.generate:
         # Demo of the KV-cache decode path: greedily continue a training
@@ -1321,9 +1474,44 @@ def train(args) -> float:
     return last_loss
 
 
+def _worker(process_id, argv, result_file=None):
+    """Supervised-run payload: one full train() in a child process.
+
+    Module-level (not a closure) so the spawn start method can pickle it;
+    the ``if __name__`` guard below keeps the re-import from recursing.
+    ``result_file``, when given, receives the final loss — the only
+    channel a crashed-and-restarted child has back to its test harness.
+    """
+    del process_id  # single-process gangs; jax sees a local mesh
+    args = parse_args(argv)
+    validate_args(args)
+    select_device(args)
+    loss = train(args)
+    if result_file:
+        with open(result_file, "w") as fh:
+            fh.write(repr(float(loss)))
+
+
 def main(argv=None):
     args = parse_args(argv)
     validate_args(args)
+    if args.max_restarts > 0 and not os.environ.get("_DDP_SUPERVISED"):
+        # Supervised mode: run the trainer in a child gang under
+        # runtime.launcher.spawn, which restarts it (up to the budget) on
+        # any nonzero exit — chaos preemption, watchdog exit code 75, a
+        # real crash.  The child argv gains --resume so every restart
+        # continues from the newest intact checkpoint instead of epoch 0.
+        from distributeddataparallel_tpu.runtime.launcher import spawn
+
+        child_argv = list(argv) if argv is not None else sys.argv[1:]
+        if "--resume" not in child_argv:
+            child_argv.append("--resume")
+        spawn(
+            _worker, args=(child_argv,), nprocs=1,
+            max_restarts=args.max_restarts,
+            env={"_DDP_SUPERVISED": "1"},
+        )
+        return
     select_device(args)
     train(args)
 

@@ -14,7 +14,19 @@ import jax
 import pytest
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # Older jax has no jax_num_cpu_devices option; the pre-backend-init
+    # XLA flag is the equivalent (read when the CPU client is created,
+    # which hasn't happened yet at conftest import time).
+    import os
+
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
 
 
 @pytest.fixture(scope="session")

@@ -40,7 +40,10 @@ def test_all_reduce_mean_matches_manual(devices):
     )(stacked)
     expected = jax.tree.map(lambda *xs: jnp.mean(jnp.stack(xs), 0), *trees)
     for k in expected:
-        np.testing.assert_allclose(out[k], expected[k], rtol=1e-6)
+        # rtol/atol: XLA's psum may reduce in a different association
+        # order than the host-side stack/mean — a few ulps of f32 slack
+        # (atol covers near-zero elements where rtol alone is too sharp).
+        np.testing.assert_allclose(out[k], expected[k], rtol=1e-5, atol=1e-7)
 
 
 def test_bucketed_equals_unbucketed(devices):

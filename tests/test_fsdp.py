@@ -389,8 +389,9 @@ def _mp_fsdp_gather_worker(process_id: int, world: int, tmpdir: str):
 
     import jax
 
-    jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 2)
+    from distributeddataparallel_tpu.compat import configure_cpu_devices
+
+    configure_cpu_devices(2)
 
     import jax.numpy as jnp
     import numpy as np
@@ -444,12 +445,18 @@ def _mp_fsdp_gather_worker(process_id: int, world: int, tmpdir: str):
 
 
 def test_multihost_fsdp_host_gather(tmp_path, devices):
+    import functools
     import json
 
-    from distributeddataparallel_tpu.runtime.launcher import spawn
+    from distributeddataparallel_tpu.runtime.launcher import (
+        MULTIPROCESS_UNSUPPORTED_EXIT,
+        guarded_worker,
+        spawn,
+    )
 
     procs = spawn(
-        _mp_fsdp_gather_worker, args=(2, str(tmp_path)), nprocs=2, join=False
+        functools.partial(guarded_worker, _mp_fsdp_gather_worker),
+        args=(2, str(tmp_path)), nprocs=2, join=False,
     )
     for p in procs:
         p.join(timeout=300)
@@ -457,6 +464,10 @@ def test_multihost_fsdp_host_gather(tmp_path, devices):
     for p in procs:
         if p.is_alive():
             p.terminate()
+    if MULTIPROCESS_UNSUPPORTED_EXIT in codes:
+        pytest.skip(
+            "this jaxlib's CPU backend cannot run multiprocess computations"
+        )
     assert codes == [0, 0], f"child exit codes {codes}"
     r = [json.load(open(tmp_path / f"g{i}.json")) for i in range(2)]
     assert r[0]["mismatch"] == 0 and r[1]["mismatch"] == 0
@@ -472,8 +483,9 @@ def _mp_fsdp_generate_worker(process_id: int, tmpdir: str):
 
     import jax
 
-    jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 2)
+    from distributeddataparallel_tpu.compat import configure_cpu_devices
+
+    configure_cpu_devices(2)
 
     import sys
 
@@ -504,10 +516,17 @@ def _mp_fsdp_generate_worker(process_id: int, tmpdir: str):
 
 
 def test_multihost_fsdp_generate_cli(tmp_path, devices):
-    from distributeddataparallel_tpu.runtime.launcher import spawn
+    import functools
+
+    from distributeddataparallel_tpu.runtime.launcher import (
+        MULTIPROCESS_UNSUPPORTED_EXIT,
+        guarded_worker,
+        spawn,
+    )
 
     procs = spawn(
-        _mp_fsdp_generate_worker, args=(str(tmp_path),), nprocs=2, join=False
+        functools.partial(guarded_worker, _mp_fsdp_generate_worker),
+        args=(str(tmp_path),), nprocs=2, join=False,
     )
     for p in procs:
         p.join(timeout=300)
@@ -515,5 +534,9 @@ def test_multihost_fsdp_generate_cli(tmp_path, devices):
     for p in procs:
         if p.is_alive():
             p.terminate()
+    if MULTIPROCESS_UNSUPPORTED_EXIT in codes:
+        pytest.skip(
+            "this jaxlib's CPU backend cannot run multiprocess computations"
+        )
     assert codes == [0, 0], f"child exit codes {codes}"
     assert (tmp_path / "ok0").exists() and (tmp_path / "ok1").exists()

@@ -1,11 +1,24 @@
 """Runtime tests: process-group lifecycle, mesh construction, launcher."""
 
+import functools
+
 import jax
 import numpy as np
 import pytest
 
 from distributeddataparallel_tpu.runtime import distributed as dist
-from distributeddataparallel_tpu.runtime.launcher import spawn
+from distributeddataparallel_tpu.runtime.launcher import (
+    MULTIPROCESS_UNSUPPORTED_EXIT,
+    guarded_worker,
+    spawn,
+)
+
+
+def _skip_if_mp_unsupported(codes):
+    if MULTIPROCESS_UNSUPPORTED_EXIT in codes:
+        pytest.skip(
+            "this jaxlib's CPU backend cannot run multiprocess computations"
+        )
 
 
 def test_init_destroy_lifecycle():
@@ -62,8 +75,9 @@ def _mp_dp_worker(process_id, tmpdir):
 
     import jax
 
-    jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 2)
+    from distributeddataparallel_tpu.compat import configure_cpu_devices
+
+    configure_cpu_devices(2)
 
     import jax.numpy as jnp
     import optax
@@ -128,13 +142,17 @@ def test_spawn_two_process_dp_step(tmp_path, devices):
     from distributeddataparallel_tpu.ops import cross_entropy_loss
     from distributeddataparallel_tpu.parallel.sampler import DistributedSampler
 
-    procs = spawn(_mp_dp_worker, args=(str(tmp_path),), nprocs=2, join=False)
+    procs = spawn(
+        functools.partial(guarded_worker, _mp_dp_worker),
+        args=(str(tmp_path),), nprocs=2, join=False,
+    )
     for p in procs:
         p.join(timeout=240)
     codes = [p.exitcode for p in procs]
     for p in procs:
         if p.is_alive():
             p.terminate()
+    _skip_if_mp_unsupported(codes)
     assert codes == [0, 0], f"child exit codes {codes}"
 
     results = [
@@ -183,8 +201,9 @@ def _mp_tp_worker(process_id, tmpdir):
 
     import jax
 
-    jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 2)
+    from distributeddataparallel_tpu.compat import configure_cpu_devices
+
+    configure_cpu_devices(2)
 
     import dataclasses
 
@@ -242,13 +261,17 @@ def test_spawn_two_process_dp_tp_step(tmp_path, devices):
     from distributeddataparallel_tpu.models import TransformerLM, tiny_lm
     from distributeddataparallel_tpu.ops import lm_cross_entropy
 
-    procs = spawn(_mp_tp_worker, args=(str(tmp_path),), nprocs=2, join=False)
+    procs = spawn(
+        functools.partial(guarded_worker, _mp_tp_worker),
+        args=(str(tmp_path),), nprocs=2, join=False,
+    )
     for p in procs:
         p.join(timeout=240)
     codes = [p.exitcode for p in procs]
     for p in procs:
         if p.is_alive():
             p.terminate()
+    _skip_if_mp_unsupported(codes)
     assert codes == [0, 0], f"child exit codes {codes}"
 
     results = [
@@ -278,8 +301,9 @@ def _mp_fsdp_worker(process_id, tmpdir):
 
     import jax
 
-    jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 2)
+    from distributeddataparallel_tpu.compat import configure_cpu_devices
+
+    configure_cpu_devices(2)
 
     import jax.numpy as jnp
     import numpy as np
@@ -330,13 +354,17 @@ def test_spawn_two_process_fsdp_step(tmp_path, devices):
     from distributeddataparallel_tpu.models import TransformerLM, tiny_lm
     from distributeddataparallel_tpu.ops import lm_cross_entropy
 
-    procs = spawn(_mp_fsdp_worker, args=(str(tmp_path),), nprocs=2, join=False)
+    procs = spawn(
+        functools.partial(guarded_worker, _mp_fsdp_worker),
+        args=(str(tmp_path),), nprocs=2, join=False,
+    )
     for p in procs:
         p.join(timeout=240)
     codes = [p.exitcode for p in procs]
     for p in procs:
         if p.is_alive():
             p.terminate()  # don't let a hung rank wedge the pytest exit
+    _skip_if_mp_unsupported(codes)
     assert codes == [0, 0], f"child exit codes {codes}"
 
     results = [
