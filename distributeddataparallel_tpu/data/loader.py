@@ -321,13 +321,22 @@ class DataLoader:
 
         Early consumer exit (step caps, exceptions) sets ``stop``; the
         producer polls it around its bounded put, so the thread winds
-        down promptly instead of blocking forever on a full queue."""
+        down promptly instead of blocking forever on a full queue.  The
+        generator's close path (the ``finally`` below) joins the thread
+        with a timeout and re-raises a pending producer exception — a
+        consumer that breaks out early must still see the producer's
+        failure, not leak a dead thread whose error nobody read."""
         import queue as queue_mod
         import threading
 
         q: queue_mod.Queue = queue_mod.Queue(maxsize=max(1, self.prefetch))
         done = object()
         stop = threading.Event()
+        # The producer parks its exception here as well as in the queue:
+        # the queue delivery only works while the consumer is still
+        # pulling — on early close the queue is drained blind, and this
+        # slot is the only way the error survives to the join.
+        pending_error: list[BaseException] = []
 
         def put(item) -> bool:
             while not stop.is_set():
@@ -345,16 +354,19 @@ class DataLoader:
                         return
                 put(done)
             except BaseException as e:  # noqa: BLE001 — surface to consumer
+                pending_error.append(e)
                 put(e)
 
         t = threading.Thread(target=produce, daemon=True)
         t.start()
+        raised = False
         try:
             while True:
                 item = q.get()
                 if item is done:
                     break
                 if isinstance(item, BaseException):
+                    raised = True
                     raise item
                 yield item
         finally:
@@ -362,3 +374,19 @@ class DataLoader:
             while not q.empty():  # release buffers the producer parked
                 q.get_nowait()
             t.join(timeout=5.0)
+            if t.is_alive():
+                from distributeddataparallel_tpu.utils.logging import (
+                    warn_all,
+                )
+
+                warn_all(
+                    "loader producer thread failed to stop within 5s of "
+                    "generator close; leaking a daemon thread"
+                )
+            # Early consumer exit (GeneratorExit / step cap): the
+            # producer may have died with an exception the __next__ path
+            # never delivered.  Re-raise it here — unless this close IS
+            # the unwind of that very exception propagating from the
+            # raise above.
+            if pending_error and not raised:
+                raise pending_error[0]

@@ -166,6 +166,30 @@ def make_mesh(
     return Mesh(mesh_devices, tuple(axes))
 
 
+def topology_fingerprint(mesh: Mesh | None = None) -> dict:
+    """Identity of the device world an executable was compiled for.
+
+    The warm-start store (``training.warm_start``) keys serialized
+    executables on this: an XLA binary is specific to the platform,
+    device kind, device count, process layout, and — when a mesh is
+    given — the mesh's axis names and shape.  Everything here is plain
+    JSON so keys compare by value across processes.
+    """
+    devs = (
+        list(mesh.devices.flat) if mesh is not None else list(jax.devices())
+    )
+    fp = {
+        "platform": devs[0].platform if devs else jax.default_backend(),
+        "device_kind": getattr(devs[0], "device_kind", "?") if devs else "?",
+        "n_devices": len(devs),
+        "process_count": jax.process_count(),
+    }
+    if mesh is not None:
+        fp["mesh_axes"] = list(mesh.axis_names)
+        fp["mesh_shape"] = [int(mesh.shape[a]) for a in mesh.axis_names]
+    return fp
+
+
 def barrier(name: str = "ddp_tpu_barrier") -> None:
     """Block until all processes reach this point.
 

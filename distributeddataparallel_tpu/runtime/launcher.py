@@ -68,6 +68,17 @@ def _child(fn, process_id, nprocs, coordinator, env, args):
     # Runs in a fresh interpreter (spawn start method): configure the JAX
     # runtime before anything imports jax.
     os.environ.update(env)
+    if os.environ.get("DDP_COMPILE_CACHE"):
+        # Inherit the parent's persistent compilation cache before the
+        # worker's first compile: this is what turns a supervised
+        # respawn's startup from a recompile into a cache hit, for ANY
+        # worker function — dpp's trainer reads the env itself, but test
+        # and bench workers get the cache here without extra plumbing.
+        from distributeddataparallel_tpu.training.warm_start import (
+            enable_compile_cache,
+        )
+
+        enable_compile_cache(os.environ["DDP_COMPILE_CACHE"])
     if nprocs > 1:
         # A single supervised worker must NOT get distributed-init vars:
         # it is a one-process job that happens to run in a child, and a

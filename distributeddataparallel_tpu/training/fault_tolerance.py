@@ -420,3 +420,29 @@ class NonFiniteBreaker:
         else:
             self.consecutive = 0
         return self.consecutive
+
+
+def note_warm_start(
+    counters, *, mode: str, first_step_s: float | None = None
+) -> None:
+    """Record how this incarnation obtained its train step.
+
+    Called once per process start (including every supervised respawn —
+    ``DDP_RESTART_ATTEMPT`` carries the attempt index) so the restart
+    path's warm-start behavior is visible in the normal run log and in
+    the fault summary: a respawn that was supposed to hit the cache but
+    logs ``cold`` is a warm-start regression, caught by reading logs
+    instead of by profiling.
+    """
+    from distributeddataparallel_tpu.utils.logging import log0
+
+    counters.warm_start_mode = mode
+    if first_step_s is not None:
+        counters.compile_s = first_step_s
+    attempt = int(os.environ.get("DDP_RESTART_ATTEMPT", "0") or 0)
+    log0(
+        "warm start: attempt %d acquired the train step via %s%s",
+        attempt, mode,
+        f" (first step ready in {first_step_s:.2f}s)"
+        if first_step_s is not None else "",
+    )

@@ -259,6 +259,32 @@ def make_train_step(
     if accum_steps < 1:
         raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
 
+    # Compilation-affecting factory flags, attached to the returned step
+    # as ``aot_signature`` — the warm-start store (training.warm_start)
+    # folds this into the executable's invalidation key, so a flag change
+    # (say, overlap on → off) can never silently reuse a stale binary.
+    # ``presynced`` is a predicate whose identity is process-local; the
+    # key can only honestly record its presence.
+    aot_signature = {
+        "factory": "make_train_step",
+        "axis_name": axis_name,
+        "accum_steps": accum_steps,
+        "bucket_bytes": bucket_bytes,
+        "overlap": overlap,
+        "donate": donate,
+        "with_model_state": with_model_state,
+        "zero": zero,
+        "grad_sync": grad_sync,
+        "buffer_sync": buffer_sync,
+        "cp_axis": cp_axis,
+        "tp_axis": tp_axis,
+        "ep_axis": ep_axis,
+        "grad_clip": grad_clip,
+        "presynced": presynced is not None,
+        "grad_compress": grad_compress,
+        "nonfinite_guard": nonfinite_guard,
+    }
+
     def _micro(params, model_state, mb, rng):
         """One microbatch: returns (loss, aux, new_model_state, grads)."""
         if with_model_state:
@@ -558,7 +584,9 @@ def make_train_step(
             out_specs=(P(), P()),
             check_vma=False,
         )
-        return jax.jit(sharded, **jit_kwargs)
+        jitted = jax.jit(sharded, **jit_kwargs)
+        jitted.aot_signature = aot_signature
+        return jitted
 
     # ZeRO / TP / EP: the state's leaves carry per-leaf shardings (ZeRO:
     # flat opt chunks over the data axis; TP/EP: Megatron/expert layouts
@@ -628,6 +656,7 @@ def make_train_step(
     step.lower = lambda state, batch, rng: _build(state).lower(
         state, batch, rng
     )
+    step.aot_signature = aot_signature
 
     return step
 

@@ -1,0 +1,515 @@
+"""Warm start: persistent compile cache, AOT executable store, dispatch.
+
+The reference trainer pays compile cost exactly once per process and
+nothing on the hot path; the JAX port recompiles the full train step on
+every process start — including every supervised gang respawn
+(``runtime.launcher.spawn(max_restarts=...)``) — and a naive loop blocks
+the host every step to read metrics.  This module is the warm-start +
+dispatch subsystem that closes both gaps:
+
+- ``enable_compile_cache``: one switch for JAX's persistent compilation
+  cache, exported through the environment so spawned/respawned gang
+  members (fresh interpreters) inherit it before their first compile.
+- ``ExecutableStore`` + ``warm_train_step``: ahead-of-time reuse of the
+  *serialized executable itself* — the compiled train step is saved
+  keyed by (topology, mesh, model config, step-factory flags, jax
+  versions) and a restarted process loads it back without tracing or
+  compiling anything.  Any key mismatch or load failure falls back
+  LOUDLY to the normal JIT path: a warm start is an optimization, never
+  a correctness gate.
+- ``BoundedDispatch``: the bounded async-dispatch queue for the train
+  loop — at most K steps in flight, host syncs only at window/checkpoint
+  boundaries (and, with the nan guard, on the oldest in-flight step's
+  flag once the queue is full, so the breaker observes every step with
+  a lag of at most K).
+
+Serialization detail that shapes the store layout: the treedefs returned
+by ``jax.experimental.serialize_executable.serialize`` carry the live
+``TrainState`` aux data (optax transform closures, the model's bound
+``apply_fn``) and are NOT picklable.  The store therefore persists only
+the XLA payload plus the metric key names, and rebuilds both treedefs at
+load time from the caller's live ``(state, batch, rng)`` — which is
+always available on the restart path, because the worker reconstructs
+its state before taking the first step.  A structural drift between save
+and load surfaces as the loaded executable rejecting the arguments
+(TypeError), which the wrapper converts into the same loud JIT fallback.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import time
+from typing import Any, Callable, Sequence
+
+import jax
+
+from distributeddataparallel_tpu.utils.logging import get_logger
+
+Pytree = Any
+
+STORE_VERSION = 1
+_AOT_SUFFIX = ".aotx"
+_META_SUFFIX = ".json"
+
+
+class WarmStartMismatch(RuntimeError):
+    """A stored executable's key does not match the live run (strict mode)."""
+
+
+def enable_compile_cache(
+    cache_dir: str, *, min_compile_time_s: float | None = None
+) -> str:
+    """Turn on JAX's persistent compilation cache rooted at ``cache_dir``.
+
+    Also exports ``JAX_COMPILATION_CACHE_DIR`` / ``DDP_COMPILE_CACHE`` so
+    child processes (supervised gang members, respawns, bench workers)
+    inherit the cache: they start in fresh interpreters, and the
+    environment is the only channel that survives the spawn.
+
+    ``min_compile_time_s=None`` keeps an inherited
+    ``JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS`` (or 0.0): a child
+    re-enabling the parent's cache must not silently raise the floor and
+    start skipping entries the parent intended to persist.
+    """
+    cache_dir = os.path.abspath(os.path.expanduser(cache_dir))
+    os.makedirs(cache_dir, exist_ok=True)
+    if min_compile_time_s is None:
+        min_compile_time_s = float(
+            os.environ.get("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", 0.0)
+        )
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update(
+        "jax_persistent_cache_min_compile_time_secs", float(min_compile_time_s)
+    )
+    os.environ["JAX_COMPILATION_CACHE_DIR"] = cache_dir
+    os.environ["DDP_COMPILE_CACHE"] = cache_dir
+    os.environ["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"] = str(
+        float(min_compile_time_s)
+    )
+    return cache_dir
+
+
+class CompileCacheStats:
+    """Persistent-cache hit/miss counters via ``jax.monitoring`` events.
+
+    The cache itself is silent at the API level; these counters are how
+    the fault summary distinguishes "respawn recompiled from scratch"
+    from "respawn hit the cache" — a warm-start regression shows up as
+    hits dropping to zero, not as a vague slowdown.
+    """
+
+    _HIT = "/jax/compilation_cache/cache_hits"
+    _MISS = "/jax/compilation_cache/cache_misses"
+
+    def __init__(self):
+        self.hits = 0
+        self.misses = 0
+        from jax._src import monitoring
+
+        def _on_event(event: str, **kw) -> None:
+            if event == self._HIT:
+                self.hits += 1
+            elif event == self._MISS:
+                self.misses += 1
+
+        self._cb = _on_event
+        monitoring.register_event_listener(_on_event)
+
+    def close(self) -> None:
+        from jax._src import monitoring
+
+        try:
+            monitoring._unregister_event_listener_by_callback(self._cb)
+        except Exception:  # noqa: BLE001 — already gone / private API drift
+            pass
+
+
+def _jsonable(value: Any) -> Any:
+    """Best-effort canonical JSON form: the key must compare by VALUE
+    across processes, so callables/objects collapse to their repr-ish
+    identity (a function's identity is not stable across interpreters —
+    presence/absence is what the key can honestly record)."""
+    if isinstance(value, (str, int, float, bool)) or value is None:
+        return value
+    if isinstance(value, (list, tuple)):
+        return [_jsonable(v) for v in value]
+    if isinstance(value, dict):
+        return {str(k): _jsonable(v) for k, v in sorted(value.items())}
+    if callable(value):
+        return f"<callable:{getattr(value, '__name__', 'fn')}>"
+    return repr(value)
+
+
+def runtime_versions() -> dict:
+    """The toolchain part of the invalidation key: an executable compiled
+    by one (jax, jaxlib, libtpu) triple must never be fed to another."""
+    import jaxlib
+
+    versions = {
+        "jax": jax.__version__,
+        "jaxlib": getattr(jaxlib, "__version__", "unknown"),
+    }
+    try:  # libtpu is absent on CPU/GPU installs — record that fact too.
+        from importlib import metadata
+
+        versions["libtpu"] = metadata.version("libtpu")
+    except Exception:  # noqa: BLE001
+        versions["libtpu"] = None
+    return versions
+
+
+def executable_key(
+    *,
+    mesh=None,
+    model_config: Any = None,
+    step_signature: dict | None = None,
+    extra: dict | None = None,
+) -> dict:
+    """Build the invalidation key for one compiled train step.
+
+    Anything that changes the compiled program must be in here:
+    topology (platform, device kind, counts), mesh axes/shape, the model
+    configuration, the step factory's compilation-affecting flags
+    (donation, overlap, accumulation, ...), and the jax/jaxlib/libtpu
+    versions.  Keys compare as plain JSON values — a mismatch on load is
+    reported field-by-field.
+    """
+    from distributeddataparallel_tpu.runtime.distributed import (
+        topology_fingerprint,
+    )
+
+    key = {
+        "store_version": STORE_VERSION,
+        "versions": runtime_versions(),
+        "topology": topology_fingerprint(mesh),
+    }
+    if model_config is not None:
+        key["model_config"] = _jsonable(
+            model_config.__dict__
+            if hasattr(model_config, "__dict__")
+            else model_config
+        )
+    if step_signature:
+        key["step_signature"] = _jsonable(step_signature)
+    if extra:
+        key["extra"] = _jsonable(extra)
+    return key
+
+
+def _key_diff(stored: dict, live: dict) -> list[str]:
+    fields = sorted(set(stored) | set(live))
+    return [f for f in fields if stored.get(f) != live.get(f)]
+
+
+class ExecutableStore:
+    """Directory of serialized train-step executables, one per name.
+
+    Layout (all under ``root``)::
+
+        <name>.aotx   pickled XLA payload from serialize_executable
+        <name>.json   {"version", "key", "metric_keys", "payload_bytes"}
+
+    ``save`` is atomic (tmp + rename) so a killed worker never leaves a
+    half-written artifact for its own respawn to trip over.  ``load``
+    verifies the FULL key dict, not a hash: on mismatch it warns with
+    the differing fields and returns None (or raises, ``strict=True``)
+    — the caller falls back to JIT, loudly, never silently runs a stale
+    binary.
+    """
+
+    def __init__(self, root: str):
+        self.root = os.path.abspath(os.path.expanduser(root))
+        os.makedirs(self.root, exist_ok=True)
+
+    def _paths(self, name: str) -> tuple[str, str]:
+        base = os.path.join(self.root, name)
+        return base + _AOT_SUFFIX, base + _META_SUFFIX
+
+    def meta(self, name: str) -> dict | None:
+        _, meta_path = self._paths(name)
+        try:
+            with open(meta_path) as fh:
+                return json.load(fh)
+        except (OSError, ValueError):
+            return None
+
+    def save(
+        self, name: str, key: dict, compiled, *, metric_keys: Sequence[str]
+    ) -> str:
+        """Serialize ``compiled`` under ``name``; returns the artifact path.
+
+        Only the XLA payload is persisted — the call treedefs carry live
+        closures (module docstring) and are rebuilt at load time.
+        """
+        from jax.experimental import serialize_executable
+
+        payload, _in_tree, _out_tree = serialize_executable.serialize(
+            compiled
+        )
+        blob = pickle.dumps(payload)
+        aot_path, meta_path = self._paths(name)
+        meta = {
+            "version": STORE_VERSION,
+            "key": key,
+            "metric_keys": sorted(metric_keys),
+            "payload_bytes": len(blob),
+        }
+        for path, data, write_mode in (
+            (aot_path, blob, "wb"),
+            (meta_path, json.dumps(meta, indent=1, sort_keys=True), "w"),
+        ):
+            tmp = path + ".tmp"
+            with open(tmp, write_mode) as fh:
+                fh.write(data)
+            os.replace(tmp, path)
+        return aot_path
+
+    def load(
+        self,
+        name: str,
+        key: dict,
+        *,
+        example_args: tuple,
+        state,
+        strict: bool = False,
+    ):
+        """Deserialize ``name`` if its stored key matches ``key``.
+
+        ``example_args`` is the live ``(state, batch, rng)`` the step
+        will be called with; ``state`` seeds the output template.
+        Returns the loaded executable, or None after a LOUD warning on
+        any mismatch/corruption (``strict=True`` raises instead).
+        """
+        aot_path, _ = self._paths(name)
+        meta = self.meta(name)
+        if meta is None or not os.path.exists(aot_path):
+            return None  # nothing stored — a cold start, not a fault
+        log = get_logger()
+        diff = _key_diff(meta.get("key", {}), key)
+        if diff:
+            detail = "; ".join(
+                f"{f}: stored={meta.get('key', {}).get(f)!r} "
+                f"live={key.get(f)!r}"
+                for f in diff
+            )
+            msg = (
+                f"AOT executable '{name}' key mismatch ({detail}) — "
+                "falling back to JIT compile"
+            )
+            if strict:
+                raise WarmStartMismatch(msg)
+            log.warning("%s", msg)
+            return None
+        try:
+            from jax.experimental import serialize_executable
+
+            with open(aot_path, "rb") as fh:
+                payload = pickle.loads(fh.read())
+            in_tree = jax.tree_util.tree_flatten((tuple(example_args), {}))[1]
+            out_template = (
+                state,
+                {k: 0.0 for k in meta.get("metric_keys", [])},
+            )
+            out_tree = jax.tree_util.tree_flatten(out_template)[1]
+            return serialize_executable.deserialize_and_load(
+                payload, in_tree, out_tree
+            )
+        except Exception as exc:  # noqa: BLE001 — any load fault → JIT
+            msg = (
+                f"AOT executable '{name}' failed to load "
+                f"({type(exc).__name__}: {exc}) — falling back to JIT "
+                "compile"
+            )
+            if strict:
+                raise WarmStartMismatch(msg) from exc
+            log.warning("%s", msg)
+            return None
+
+
+def _metric_keys_of(compiled) -> list[str]:
+    """Metric names from a compiled step's output treedef: unflattening
+    with dummy leaves yields the (state, metrics) skeleton — the dict
+    keys are structural aux data, no execution needed."""
+    out_tree = compiled.out_tree
+    skeleton = jax.tree_util.tree_unflatten(
+        out_tree, [0] * out_tree.num_leaves
+    )
+    return sorted(skeleton[1].keys())
+
+
+def warm_train_step(
+    step_fn: Callable,
+    *,
+    store: ExecutableStore,
+    key: dict,
+    name: str = "train_step",
+    on_ready: Callable[[dict], None] | None = None,
+):
+    """Wrap a jit'd train step with the AOT store's load-or-compile-and-save.
+
+    The first call resolves the executable: load from the store when the
+    key matches (the restart fast path — no trace, no compile), else
+    lower+compile through ``step_fn`` (hitting the persistent cache when
+    one is enabled) and save the result for the next incarnation.  Every
+    failure mode — missing ``.lower``, key mismatch, serialization not
+    supported on this backend, the loaded binary rejecting the live
+    argument shapes — degrades loudly to the plain JIT path.
+
+    ``on_ready(report)`` fires once after resolution with
+    ``{"mode": "aot"|"cache-hit"|"cold"|"jit", "load_s"|"compile_s": ...,
+    "cache_hits": int}``; ``wrapped.report`` keeps the same dict (mode
+    becomes ``"jit-fallback"`` if the AOT binary is later rejected).
+    """
+    box: dict[str, Any] = {"fn": None}
+    wrapped_report: dict[str, Any] = {"mode": "unresolved"}
+
+    def _resolve(args) -> None:
+        log = get_logger()
+        state = args[0]
+        loaded = None
+        t0 = time.perf_counter()
+        try:
+            loaded = store.load(
+                name, key, example_args=args, state=state
+            )
+        except Exception as exc:  # noqa: BLE001 — strict=False already
+            # guards; this catches store-level surprises (bad perms, ...)
+            log.warning(
+                "AOT store load failed (%s: %s) — falling back to JIT",
+                type(exc).__name__, exc,
+            )
+        if loaded is not None:
+            box["fn"] = loaded
+            wrapped_report.update(
+                mode="aot", load_s=round(time.perf_counter() - t0, 3)
+            )
+            return
+        if not hasattr(step_fn, "lower"):
+            log.warning(
+                "train step has no .lower — AOT store disabled for this "
+                "path, using plain JIT"
+            )
+            box["fn"] = step_fn
+            wrapped_report.update(mode="jit")
+            return
+        stats = CompileCacheStats()
+        try:
+            t0 = time.perf_counter()
+            compiled = step_fn.lower(*args).compile()
+            compile_s = time.perf_counter() - t0
+        except Exception as exc:  # noqa: BLE001
+            stats.close()
+            log.warning(
+                "explicit lower/compile failed (%s: %s) — using plain JIT",
+                type(exc).__name__, exc,
+            )
+            box["fn"] = step_fn
+            wrapped_report.update(mode="jit")
+            return
+        stats.close()
+        box["fn"] = compiled
+        wrapped_report.update(
+            mode="cache-hit" if stats.hits else "cold",
+            compile_s=round(compile_s, 3),
+            cache_hits=stats.hits,
+        )
+        try:
+            # Save only a FRESH compile: re-serializing an executable the
+            # persistent cache handed back produced incomplete payloads
+            # ("Symbols not found" on the next load) on this jaxlib.
+            if stats.hits == 0 or store.meta(name) is None:
+                store.save(
+                    name, key, compiled,
+                    metric_keys=_metric_keys_of(compiled),
+                )
+        except Exception as exc:  # noqa: BLE001 — saving is best-effort
+            log.warning(
+                "AOT store save failed (%s: %s) — next start will "
+                "recompile", type(exc).__name__, exc,
+            )
+
+    def resolve(state, batch, rng) -> dict:
+        """Acquire the executable for these arguments WITHOUT running a
+        step; returns the report.  Lets benches/tools time acquisition
+        (compile vs cache vs AOT load) separately from step execution.
+        Idempotent: subsequent calls (and ``wrapped`` itself) reuse the
+        resolved executable."""
+        if box["fn"] is None:
+            _resolve((state, batch, rng))
+            if on_ready is not None:
+                on_ready(dict(wrapped_report))
+        return dict(wrapped_report)
+
+    def wrapped(state, batch, rng):
+        resolve(state, batch, rng)
+        try:
+            return box["fn"](state, batch, rng)
+        except TypeError as exc:
+            if wrapped_report.get("mode") != "aot":
+                raise
+            # The loaded binary rejected the live arguments (shape/dtype
+            # /sharding drift the key could not see).  The argument check
+            # happens before any donation, so the inputs are still alive
+            # — rerun through JIT and stay there.
+            get_logger().warning(
+                "AOT executable rejected live arguments (%s) — falling "
+                "back to JIT for the rest of the run", exc,
+            )
+            box["fn"] = step_fn
+            wrapped_report["mode"] = "jit-fallback"
+            return step_fn(state, batch, rng)
+
+    wrapped.report = wrapped_report
+    wrapped.resolve = resolve
+    wrapped.lower = getattr(step_fn, "lower", None)
+    return wrapped
+
+
+class BoundedDispatch:
+    """Bounded async dispatch: at most ``depth`` steps in flight.
+
+    The train loop pushes one handle per step (the nan guard's
+    ``nonfinite_grad`` flag, or the loss when no guard is armed); once
+    more than ``depth`` are outstanding the OLDEST is handed back to be
+    settled (blocked on / read), so the host never runs more than
+    ``depth`` steps ahead of the devices — backpressure without a
+    per-step sync.  ``depth=0`` degenerates to the fully synchronous
+    per-step pattern.
+
+    Interaction with the nan guard: the in-graph ``nonfinite_guard``
+    already discards a bad step's update on-device, so steps dispatched
+    past a bad one are state no-ops, not corruption.  The host-side
+    breaker observes every flag in order with a lag of at most ``depth``
+    steps and therefore still trips within ``max_bad_steps + depth``
+    steps of the first bad one.  ``drain()`` at checkpoint/eval/window
+    boundaries restores full synchronization — the breaker's decision
+    point is never crossed unobserved.
+    """
+
+    def __init__(self, depth: int):
+        if depth < 0:
+            raise ValueError(f"dispatch depth must be >= 0, got {depth}")
+        self.depth = depth
+        import collections
+
+        self._q: Any = collections.deque()
+
+    def __len__(self) -> int:
+        return len(self._q)
+
+    def push(self, handle, meta=None) -> list[tuple[Any, Any]]:
+        """Enqueue one step's handle; returns the (handle, meta) pairs
+        that fell out of the window and must be settled NOW."""
+        self._q.append((handle, meta))
+        out = []
+        while len(self._q) > self.depth:
+            out.append(self._q.popleft())
+        return out
+
+    def drain(self) -> list[tuple[Any, Any]]:
+        """Hand back everything in flight (boundary sync)."""
+        out = list(self._q)
+        self._q.clear()
+        return out

@@ -24,14 +24,23 @@ class StepTimer:
     """Windowed throughput meter: items/s and items/s/chip.
 
     ``tick(items)`` per step; every ``window`` steps it blocks on the
-    given array (or skips the sync if none) and emits a reading.  The
-    first window after construction includes compile time and is marked
-    ``warmup=True`` — report it separately or drop it.
+    given array (or skips the sync if none) and emits a reading.
+
+    The FIRST tick is special: it carries compile (or AOT-load) time, so
+    it is timed separately — the timer blocks on ``sync``, records the
+    wall time as ``compile_s``, and excludes that step from every
+    throughput window instead of letting it poison the first reading.
+    ``compile_s`` is emitted once, in the first reading after it is
+    known; readings keep a ``warmup`` key (now always False once the
+    compile step is split out) for backward compatibility.
     """
 
     def __init__(self, window: int = 50, n_chips: int | None = None):
         self.window = window
         self.n_chips = n_chips or len(jax.devices())
+        self.compile_s: float | None = None
+        self._first_pending = True
+        self._compile_emitted = False
         self._t0 = time.perf_counter()
         self._items = 0
         self._steps = 0
@@ -39,7 +48,9 @@ class StepTimer:
 
     def reset(self) -> None:
         """Restart the current window — call after off-path work (eval,
-        checkpoint save) so its wall time doesn't pollute the reading."""
+        checkpoint save) so its wall time doesn't pollute the reading.
+        The compile-step accounting is not reset: compilation happens
+        once per process, not once per window."""
         self._t0 = time.perf_counter()
         self._items = 0
         self._steps = 0
@@ -47,6 +58,16 @@ class StepTimer:
     def tick(self, items: int, sync: object = None) -> dict | None:
         """Record one step of `items` processed; returns a reading dict at
         window boundaries, else None."""
+        if self._first_pending:
+            # The compile step: sync NOW so its wall time is attributed
+            # here and nowhere else, then start the first window clean.
+            if sync is not None:
+                jax.block_until_ready(sync)
+            t1 = time.perf_counter()
+            self.compile_s = t1 - self._t0
+            self._first_pending = False
+            self._t0 = t1
+            return None
         self._items += items
         self._steps += 1
         if self._steps < self.window:
@@ -60,8 +81,11 @@ class StepTimer:
             "items_per_s_per_chip": self._items / dt / self.n_chips,
             "steps_per_s": self._steps / dt,
             "window_s": dt,
-            "warmup": self._windows == 0,
+            "warmup": False,
         }
+        if self.compile_s is not None and not self._compile_emitted:
+            reading["compile_s"] = round(self.compile_s, 3)
+            self._compile_emitted = True
         self._t0 = t1
         self._items = 0
         self._steps = 0
@@ -86,6 +110,14 @@ class FaultCounters:
         self.ckpt_fallbacks = 0
         self.watchdog_fires = 0
         self.restarts = 0
+        # Warm-start accounting (training.warm_start): how this
+        # incarnation got its train step — "aot" (loaded executable),
+        # "cache-hit" (persistent compile cache), "cold" (full compile),
+        # "jit"/"jit-fallback" — and the wall seconds to the first step.
+        # Not faults, so excluded from ``total``; surfaced in summary()
+        # so a respawn that silently recompiles is visible per attempt.
+        self.warm_start_mode: str | None = None
+        self.compile_s: float | None = None
 
     @property
     def total(self) -> int:
@@ -95,13 +127,18 @@ class FaultCounters:
         )
 
     def summary(self) -> dict:
-        return {
+        out = {
             "nonfinite_steps": self.nonfinite_steps,
             "ckpt_io_retries": self.io_retries,
             "ckpt_fallbacks": self.ckpt_fallbacks,
             "watchdog_fires": self.watchdog_fires,
             "restarts": self.restarts,
         }
+        if self.warm_start_mode is not None:
+            out["warm_start"] = self.warm_start_mode
+        if self.compile_s is not None:
+            out["first_step_s"] = round(self.compile_s, 3)
+        return out
 
 
 @contextlib.contextmanager

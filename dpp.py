@@ -205,6 +205,18 @@ def parse_args(argv=None):
                         "'mean' averages running stats (SyncBN-flavored), "
                         "'broadcast' adopts replica 0's (exact DDP "
                         "broadcast_buffers semantics)")
+    p.add_argument("--compile-cache", default=None, metavar="DIR",
+                   help="persistent compilation cache + AOT executable "
+                        "store rooted at DIR (env: DDP_COMPILE_CACHE). "
+                        "Spawned/respawned gang members inherit it, so a "
+                        "supervised restart reloads the serialized train "
+                        "step instead of recompiling")
+    p.add_argument("--dispatch-depth", type=int, default=2,
+                   help="bounded async dispatch: keep up to K steps in "
+                        "flight; the host syncs only at metrics-window and "
+                        "checkpoint/eval boundaries (the nan guard then "
+                        "observes each step's flag with a lag of at most "
+                        "K).  0 = fully synchronous per-step loop")
     p.add_argument("--log-every", type=int, default=100)     # ref dpp.py:54
     p.add_argument("--steps-per-epoch", type=int, default=None,
                    help="cap steps per epoch (smoke runs)")
@@ -267,6 +279,15 @@ def parse_args(argv=None):
     # callers (tests, notebooks) get the same behavior as main().
     if args.dataset is None:
         args.dataset = "synthetic-lm" if is_lm(args) else "synthetic"
+    # Env fallback so supervised respawns (fresh interpreters launched
+    # with the original argv) and library callers pick up a cache the
+    # parent enabled without threading the flag everywhere.
+    if args.compile_cache is None:
+        args.compile_cache = os.environ.get("DDP_COMPILE_CACHE") or None
+    if args.dispatch_depth < 0:
+        raise SystemExit(
+            f"--dispatch-depth must be >= 0, got {args.dispatch_depth}"
+        )
     return args
 
 
@@ -732,6 +753,16 @@ def train(args) -> float:
         warn_all,
     )
 
+    if args.compile_cache:
+        # Before the first compile: the persistent cache makes every
+        # later start of this process shape — including a supervised
+        # respawn — a cache hit instead of a recompile.
+        from distributeddataparallel_tpu.training.warm_start import (
+            enable_compile_cache,
+        )
+
+        enable_compile_cache(args.compile_cache)
+
     mesh = setup(args)
     n_replicas = mesh.shape["data"]
     log0(
@@ -1016,6 +1047,48 @@ def train(args) -> float:
                 else None
             ),
             nonfinite_guard=args.nan_guard,
+        )
+
+    warm_report = {}
+    if args.compile_cache:
+        # AOT executable store under the cache dir: load the serialized
+        # train step on restart, compile-and-save otherwise.  The key
+        # must cover everything the CLI can change about the compiled
+        # program — including optimizer hyperparameters, which optax
+        # bakes into the executable as constants (a stale-lr binary
+        # would train silently wrong, which is exactly what the key
+        # check turns into a loud JIT fallback).
+        from distributeddataparallel_tpu.training.warm_start import (
+            ExecutableStore,
+            executable_key,
+            warm_train_step,
+        )
+
+        step_fn = warm_train_step(
+            step_fn,
+            store=ExecutableStore(os.path.join(args.compile_cache, "aot")),
+            key=executable_key(
+                mesh=mesh,
+                model_config=getattr(model, "cfg", None),
+                step_signature=getattr(step_fn, "aot_signature", None),
+                extra={
+                    "model": args.model,
+                    "batch_size": args.batch_size,
+                    "seq_len": args.seq_len if lm else None,
+                    "optimizer": args.optimizer,
+                    "lr": args.lr,
+                    "momentum": args.momentum,
+                    "weight_decay": args.weight_decay,
+                    "lr_schedule": args.lr_schedule,
+                    "warmup_steps": args.warmup_steps,
+                    "min_lr": args.min_lr,
+                    "fsdp": args.fsdp,
+                    "pp": args.pp,
+                    "pp_schedule": args.pp_schedule,
+                    "pp_virtual": args.pp_virtual,
+                },
+            ),
+            on_ready=lambda rep: warm_report.update(rep),
         )
 
     def full_params():
@@ -1307,6 +1380,47 @@ def train(args) -> float:
         items_per_step, unit = args.batch_size * n_replicas, "img"
     timer = StepTimer(window=max(20, args.log_every))
 
+    # Bounded async dispatch (training.warm_start.BoundedDispatch): the
+    # loop no longer blocks the host every step — up to --dispatch-depth
+    # steps stay in flight, and each step's guard handle (the nan flag
+    # when --nan-guard is armed, else the loss) is settled when it falls
+    # out of the window or at a boundary drain.  Numerically inert: the
+    # devices execute the identical step sequence either way; only WHEN
+    # the host reads the results changes.
+    from distributeddataparallel_tpu.training.fault_tolerance import (
+        note_warm_start,
+    )
+    from distributeddataparallel_tpu.training.warm_start import (
+        BoundedDispatch,
+    )
+
+    dispatch = BoundedDispatch(args.dispatch_depth)
+
+    def settle(handle, where) -> None:
+        """Host-sync one in-flight step: read the nan flag into the
+        breaker (which may raise TrainingDiverged — within depth steps
+        of the threshold crossing), or just block on the handle."""
+        if breaker is None:
+            jax.block_until_ready(handle)
+            return
+        bad = float(handle)
+        if bad:
+            counters.nonfinite_steps += 1
+            e, b = where
+            warn0(
+                "non-finite gradients at epoch %d batch %d:"
+                " update skipped", e, b,
+            )
+        breaker.observe(bad)
+
+    def drain() -> None:
+        """Boundary sync: settle everything in flight.  Runs at metrics
+        windows, log lines, checkpoint/eval edges, and epoch ends, so
+        those points always observe fully-synced state and the nan
+        guard's decision point is never crossed unobserved."""
+        for h, w in dispatch.drain():
+            settle(h, w)
+
     # Step watchdog: a wedged collective or infeed stall should produce a
     # diagnostic and a best-effort checkpoint, not a silent hang.  Armed
     # only after the first step completes so compile time never counts
@@ -1335,6 +1449,7 @@ def train(args) -> float:
         spe = min(spe, args.steps_per_epoch)
 
     last_loss = float("nan")
+    warm_logged = False
     # Per-step RNG is a pure function of (seed, epoch, batch): a --resume'd
     # run continues the exact stochastic stream (dropout etc.) the
     # uninterrupted run would have used, instead of replaying epoch-0 keys.
@@ -1356,17 +1471,16 @@ def train(args) -> float:
                     batch = injector.corrupt_batch(batch, gstep)
                     sub = jax.random.fold_in(epoch_rng, batch_idx)
                     state, metrics = step_fn(state, batch, sub)
-                    if breaker is not None:
-                        # Per-step sync, same cost shape as GradScaler's
-                        # found_inf readback — the price of the guard.
-                        bad = float(metrics["nonfinite_grad"])
-                        if bad:
-                            counters.nonfinite_steps += 1
-                            warn0(
-                                "non-finite gradients at epoch %d batch %d:"
-                                " update skipped", epoch, batch_idx,
-                            )
-                        breaker.observe(bad)
+                    # Bounded async dispatch: enqueue this step's guard
+                    # handle and settle only what falls out of the
+                    # K-deep window (the old pattern blocked here every
+                    # step when the nan guard was armed).
+                    guard = (
+                        metrics["nonfinite_grad"] if breaker is not None
+                        else metrics["loss"]
+                    )
+                    for h, w in dispatch.push(guard, (epoch, batch_idx)):
+                        settle(h, w)
                     if watchdog is not None:
                         if watchdog.running:
                             watchdog.beat(epoch=epoch, batch=batch_idx)
@@ -1374,17 +1488,31 @@ def train(args) -> float:
                             jax.block_until_ready(state.step)
                             watchdog.start(epoch=epoch, batch=batch_idx)
                     reading = timer.tick(items_per_step, sync=state.step)
-                    if reading and not reading["warmup"]:
+                    if timer.compile_s is not None and not warm_logged:
+                        # First step done: record how it was acquired
+                        # (aot / cache-hit / cold / jit) + time-to-ready,
+                        # per incarnation — the restart path's warm-start
+                        # regression signal.
+                        warm_logged = True
+                        note_warm_start(
+                            counters,
+                            mode=warm_report.get("mode", "jit"),
+                            first_step_s=timer.compile_s,
+                        )
+                    if reading:
+                        drain()  # window boundary: fully-synced state
                         log0(
                             "throughput: %.0f %s/s (%.1f %s/s/chip)",
                             reading["items_per_s"], unit,
                             reading["items_per_s_per_chip"], unit,
                         )
                     if batch_idx % args.log_every == 0:  # ref dpp.py:54-55
+                        drain()
                         last_loss = float(metrics["loss"])
                         log0("Epoch %d, Batch %d, Loss: %.4f",
                              epoch, batch_idx, last_loss)
                     if ckpt is not None and preempt_agreed(batch_idx):
+                        drain()  # checkpoint edge: fully-synced state
                         ckpt.save(state, epoch, meta=ckpt_meta)
                         ckpt.wait()
                         log0("preempted: checkpoint saved mid-epoch %d; "
@@ -1392,6 +1520,7 @@ def train(args) -> float:
                              epoch, epoch + 1)
                         ddp.destroy_process_group()
                         return float(metrics["loss"])
+            drain()  # epoch edge: eval/checkpoint see fully-synced state
             last_loss = float(metrics["loss"])
             if eval_step is not None:
                 # Masked eval: each step returns (masked means, valid-row
@@ -1430,6 +1559,13 @@ def train(args) -> float:
         # (--max-restarts) resumes from the last durable epoch.
         warn_all("%s", pe)
         raise SystemExit(1) from pe
+    except BaseException:
+        # Divergence (nan-guard breaker) or any other abort must not
+        # strand the process group: the next train() in this process —
+        # a supervised respawn runs in a fresh one — would hit the
+        # init-twice guard.
+        ddp.destroy_process_group()
+        raise
     finally:
         if watchdog is not None:
             watchdog.stop()
@@ -1503,6 +1639,16 @@ def main(argv=None):
         # continues from the newest intact checkpoint instead of epoch 0.
         from distributeddataparallel_tpu.runtime.launcher import spawn
 
+        if args.compile_cache:
+            # Export the cache through the environment BEFORE spawning:
+            # gang members and respawns are fresh interpreters, and the
+            # env (plus the child argv) is what makes every restart a
+            # cache hit / AOT load instead of a cold compile.
+            from distributeddataparallel_tpu.training.warm_start import (
+                enable_compile_cache,
+            )
+
+            enable_compile_cache(args.compile_cache)
         child_argv = list(argv) if argv is not None else sys.argv[1:]
         if "--resume" not in child_argv:
             child_argv.append("--resume")

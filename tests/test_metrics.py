@@ -14,16 +14,22 @@ from distributeddataparallel_tpu.utils import (
 
 def test_step_timer_windows():
     t = StepTimer(window=3, n_chips=4)
+    # First tick is the compile step: timed separately, never a reading.
+    assert t.tick(8) is None
+    assert t.compile_s is not None and t.compile_s >= 0
     assert t.tick(8) is None
     assert t.tick(8) is None
     r = t.tick(8)
-    assert r is not None and r["warmup"]
+    assert r is not None and not r["warmup"]
     assert r["items_per_s"] > 0
     assert abs(r["items_per_s_per_chip"] - r["items_per_s"] / 4) < 1e-6
+    # compile_s rides along exactly once, on the first reading.
+    assert r["compile_s"] == round(t.compile_s, 3)
     for _ in range(2):
         assert t.tick(8) is None
     r2 = t.tick(8)
     assert r2 is not None and not r2["warmup"]
+    assert "compile_s" not in r2
 
 
 def test_allreduce_bandwidth_probe(devices):
