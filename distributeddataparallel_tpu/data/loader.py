@@ -115,6 +115,7 @@ class DataLoader:
         workers: int = 0,
         with_mask: bool = False,
         augment=None,
+        starvation_window: int = 50,
     ):
         """``place_fn(host_batch) -> device_batch`` overrides the default
         data-axis ``shard_batch`` placement (e.g. ``shard_lm_batch`` for
@@ -184,6 +185,13 @@ class DataLoader:
             lambda b: shard_batch(b, self.mesh, self.axis_name)
         )
         self._epoch = 0
+        # Prefetch-pipeline depth for the observability gauge: a zero-arg
+        # callable bound by whichever pipeline is active (threaded queue
+        # or inline deque); None between iterations.  Reading it is a
+        # qsize()/len() call — cheap enough to sample every export.
+        self._depth_fn = None
+        self.starvation_window = starvation_window
+        self._starved_warned = False
 
         self._samplers = [
             DistributedSampler(
@@ -210,6 +218,20 @@ class DataLoader:
 
     def __len__(self) -> int:
         return self.steps_per_epoch
+
+    @property
+    def prefetch_depth(self) -> int:
+        """Batches currently buffered ahead of the consumer (threaded
+        queue or inline deque); 0 when no iteration is active.  This is
+        the public face of the pipeline's internal buffer — bind it to a
+        metrics gauge instead of reaching into the private queue."""
+        fn = self._depth_fn
+        if fn is None:
+            return 0
+        try:
+            return int(fn())
+        except Exception:
+            return 0
 
     def _gather(self, idx: np.ndarray, image_gather=None) -> Pytree:
         """Materialize rows `idx` as a dict-of-arrays batch.
@@ -308,12 +330,16 @@ class DataLoader:
         # Software pipeline: keep `prefetch` batches in flight on device so
         # host gather overlaps device compute (DataLoader-workers analog).
         queue: collections.deque = collections.deque()
-        for host_batch in it:
-            queue.append(self._place_fn(host_batch))
-            if len(queue) > self.prefetch:
+        self._depth_fn = lambda: len(queue)
+        try:
+            for host_batch in it:
+                queue.append(self._place_fn(host_batch))
+                if len(queue) > self.prefetch:
+                    yield queue.popleft()
+            while queue:
                 yield queue.popleft()
-        while queue:
-            yield queue.popleft()
+        finally:
+            self._depth_fn = None
 
     def _threaded_iter(self, it: Iterator[Pytree]) -> Iterator[Pytree]:
         """Background-thread pipeline: gather + device placement run off
@@ -360,8 +386,31 @@ class DataLoader:
         t = threading.Thread(target=produce, daemon=True)
         t.start()
         raised = False
+        self._depth_fn = q.qsize
+        # Starvation signal: count CONSECUTIVE consumer arrivals that
+        # find the queue empty.  One empty get is normal pipelining; a
+        # full throughput window of them means the producer cannot keep
+        # up and the training loop is input-bound — warn once per run.
+        empty_streak = 0
         try:
             while True:
+                if q.empty():
+                    empty_streak += 1
+                    if (
+                        empty_streak >= self.starvation_window
+                        and not self._starved_warned
+                    ):
+                        self._starved_warned = True
+                        from distributeddataparallel_tpu.utils import logging
+
+                        logging.warn_all(
+                            "loader prefetch queue empty for %d consecutive "
+                            "steps — input pipeline is starving the train "
+                            "loop (consider more workers or faster storage)",
+                            empty_streak,
+                        )
+                else:
+                    empty_streak = 0
                 item = q.get()
                 if item is done:
                     break
@@ -370,6 +419,7 @@ class DataLoader:
                     raise item
                 yield item
         finally:
+            self._depth_fn = None
             stop.set()
             while not q.empty():  # release buffers the producer parked
                 q.get_nowait()

@@ -1,0 +1,58 @@
+"""Observability subsystem: spans, metrics, per-worker event logs, and
+XLA profiler orchestration.
+
+One coherent data model for everything the trainer used to print as
+free-form text: ``EventLog`` writes schema-versioned JSONL per worker,
+``Tracer`` times nested scopes without device syncs, ``MetricsRegistry``
+holds the counters/gauges/histograms every subsystem registers into,
+and ``ProfilerOrchestrator`` captures XLA traces on a step window or on
+the first anomaly.  ``merge_timeline`` folds the per-worker files into
+one gang timeline.
+
+Everything here is import-light (no jax at module scope): the chaos
+injector, the launcher supervisor, and ``scripts/check_events.py`` all
+import from this package in contexts where jax must not load.
+"""
+
+from .events import EventLog, events_path, merge_timeline, read_events
+from .profiler import ProfilerOrchestrator, parse_profile_steps, profile_trace
+from .registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    JsonlExporter,
+    MetricsRegistry,
+    TextExporter,
+)
+from .schema import (
+    ENVELOPE,
+    EVENT_KINDS,
+    SCHEMA_VERSION,
+    json_safe,
+    validate_file,
+    validate_record,
+)
+from .trace import Tracer
+
+__all__ = [
+    "ENVELOPE",
+    "EVENT_KINDS",
+    "SCHEMA_VERSION",
+    "Counter",
+    "EventLog",
+    "Gauge",
+    "Histogram",
+    "JsonlExporter",
+    "MetricsRegistry",
+    "ProfilerOrchestrator",
+    "TextExporter",
+    "Tracer",
+    "events_path",
+    "json_safe",
+    "merge_timeline",
+    "parse_profile_steps",
+    "profile_trace",
+    "read_events",
+    "validate_file",
+    "validate_record",
+]

@@ -1,0 +1,168 @@
+"""XLA profiler orchestration: windowed capture + capture-on-anomaly.
+
+``profile_trace`` (promoted here from ``utils/metrics.py``; a compat
+re-export remains there) is the one-shot context manager.  On top of it,
+``ProfilerOrchestrator`` drives ``jax.profiler`` across the train loop:
+
+- ``--profile-steps A:B`` opens a trace when the global step enters
+  [A, B) and closes it when it leaves — the routine way to grab exactly
+  the steady-state steps an XProf analysis wants, instead of a whole
+  epoch of warmup noise;
+- capture-on-anomaly: the FIRST nan-guard trip or watchdog fire starts a
+  short trace (``anomaly_steps`` steps) so the pathological region is
+  captured while it is happening — by the time a human reads the log the
+  opportunity is gone.  First-anomaly-only: one trace per incarnation,
+  no risk of the profiler churning on a pathological run.
+
+Only one trace can be active at a time (jax.profiler is global); the
+orchestrator guards every transition and degrades to a warning rather
+than letting telemetry kill the run.
+
+Module-import rule: stdlib only at module scope — ``jax`` is imported
+inside functions so this module stays importable in import-light
+contexts (chaos injector, supervisor, check_events).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+
+
+@contextlib.contextmanager
+def profile_trace(log_dir: str | None, *, sync: object = None):
+    """jax.profiler trace scope (XProf/TensorBoard).  No-op if dir is None.
+
+    ``sync`` is blocked on before stopping so the trace covers the async
+    device work launched inside the scope; pass a zero-arg callable to
+    resolve it at exit (e.g. ``lambda: state`` when the loop rebinds it).
+    """
+    if not log_dir:
+        yield
+        return
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        target = sync() if callable(sync) else sync
+        if target is not None:
+            jax.block_until_ready(target)
+        jax.profiler.stop_trace()
+
+
+def parse_profile_steps(spec: str | None) -> tuple[int, int] | None:
+    """Parse ``"A:B"`` into a half-open global-step window [A, B)."""
+    if not spec:
+        return None
+    try:
+        a_s, b_s = spec.split(":")
+        a, b = int(a_s), int(b_s)
+    except ValueError:
+        raise ValueError(
+            f"--profile-steps wants A:B (two ints, e.g. 10:20), got {spec!r}"
+        ) from None
+    if a < 0 or b <= a:
+        raise ValueError(
+            f"--profile-steps window must satisfy 0 <= A < B, got {spec!r}"
+        )
+    return a, b
+
+
+class ProfilerOrchestrator:
+    """Drives jax.profiler from the train loop.
+
+    Call ``on_step_start(gstep)`` before dispatching a step and
+    ``on_step_end(gstep, sync=...)`` after; ``trigger_anomaly(reason,
+    step)`` from fault paths.  ``sync`` on the closing step lets the
+    trace cover the async device work it launched; anomaly-triggered
+    stops pass the handle the loop is already about to settle, so no
+    EXTRA sync is introduced.
+    """
+
+    def __init__(
+        self,
+        log_dir: str | None,
+        *,
+        window: tuple[int, int] | None = None,
+        anomaly_steps: int = 3,
+        events=None,
+    ):
+        self.log_dir = log_dir
+        self.window = window
+        self.anomaly_steps = anomaly_steps
+        self.events = events
+        self.active = False
+        self._anomaly_used = False
+        self._stop_after: int | None = None
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.log_dir)
+
+    def _start(self, reason: str, step: int) -> None:
+        if self.active or not self.enabled:
+            return
+        import jax
+
+        os.makedirs(self.log_dir, exist_ok=True)
+        try:
+            jax.profiler.start_trace(self.log_dir)
+        except Exception as exc:  # another trace active, backend refusal
+            self._warn("profiler start failed (%s): %s", reason, exc)
+            return
+        self.active = True
+        if self.events is not None:
+            self.events.emit(
+                "profile_start", reason=reason, step=step, dir=self.log_dir
+            )
+
+    def _stop(self, step: int, sync=None) -> None:
+        if not self.active:
+            return
+        import jax
+
+        try:
+            if sync is not None:
+                jax.block_until_ready(sync)
+            jax.profiler.stop_trace()
+        except Exception as exc:
+            self._warn("profiler stop failed: %s", exc)
+        self.active = False
+        self._stop_after = None
+        if self.events is not None:
+            self.events.emit("profile_stop", step=step)
+
+    def _warn(self, fmt, *args) -> None:
+        from distributeddataparallel_tpu.utils.logging import get_logger
+
+        get_logger().warning("[profiler] " + fmt, *args)
+
+    def on_step_start(self, gstep: int) -> None:
+        if self.window and not self.active and gstep == self.window[0]:
+            self._start("window", gstep)
+
+    def on_step_end(self, gstep: int, sync=None) -> None:
+        if not self.active:
+            return
+        if self.window and self._stop_after is None and gstep >= self.window[1] - 1:
+            self._stop(gstep, sync=sync)
+        elif self._stop_after is not None and gstep >= self._stop_after:
+            self._stop(gstep, sync=sync)
+
+    def trigger_anomaly(self, reason: str, step: int, *, immediate: bool = False):
+        """First anomaly starts a short capture.  ``immediate=True``
+        (watchdog: the loop may never reach another step) stops the
+        trace right away instead of letting it run ``anomaly_steps``."""
+        if self._anomaly_used or not self.enabled or self.active:
+            return
+        self._anomaly_used = True
+        self._start(f"anomaly:{reason}", step)
+        if immediate:
+            self._stop(step)
+        else:
+            self._stop_after = step + self.anomaly_steps
+
+    def close(self, sync=None) -> None:
+        self._stop(-1, sync=sync)

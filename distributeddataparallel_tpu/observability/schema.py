@@ -1,0 +1,133 @@
+"""Event-record schema for the observability subsystem.
+
+Every record written to a per-worker events JSONL file is a flat JSON
+object carrying a fixed envelope plus kind-specific fields.  The schema
+is versioned (``SCHEMA_VERSION``) so downstream consumers — the gang
+timeline merger, ``scripts/check_events.py``, external log shippers —
+can reject records they don't understand instead of misparsing them.
+
+Module-import rule: stdlib only.  This file is imported by the chaos
+injector and the launcher supervisor, both of which must stay cheap to
+import in a fresh interpreter (no jax at module scope).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+
+SCHEMA_VERSION = 1
+
+# Fields every record carries, in canonical order:
+#   v    — schema version (int)
+#   ts   — host UNIX timestamp, seconds (float); comparable across the
+#          gang to clock-sync precision, which is exact for the
+#          single-host CPU-simulation gangs this repo runs
+#   seq  — per-writer monotonic sequence number; total-orders records
+#          from one process even when ts ties at clock resolution
+#   proc — writer identity: process index (int) or "supervisor"
+#   kind — record type, one of EVENT_KINDS
+ENVELOPE = ("v", "ts", "seq", "proc", "kind")
+
+# kind -> required kind-specific fields.  Extra fields are allowed (the
+# schema is open for forward-compat); missing required fields are not.
+EVENT_KINDS: dict[str, tuple[str, ...]] = {
+    "run_start": ("argv",),
+    "run_end": ("status",),
+    "span": ("name", "dur_s"),
+    "metrics": ("snapshot",),
+    "warm_start": ("mode",),
+    "nan_skip": ("step",),
+    "watchdog_fire": ("seconds_since_heartbeat",),
+    "ckpt_retry": ("attempt",),
+    "ckpt_fallback": (),
+    "ckpt_save": ("epoch",),
+    "chaos_inject": ("entry", "step"),
+    "restart_attempt": ("attempt",),
+    "restart_exhausted": ("attempt",),
+    "profile_start": ("reason",),
+    "profile_stop": (),
+    "loader_starved": ("window",),
+}
+
+
+def json_safe(value):
+    """Coerce ``value`` to something ``json.dumps`` accepts losslessly
+    enough for telemetry: numpy scalars/0-d arrays -> Python scalars,
+    non-finite floats -> their repr string ("nan"/"inf"/"-inf") since
+    JSON has no spelling for them, containers recursively, and anything
+    else -> ``str``.  Bool is checked before int (bool is an int
+    subclass) so True doesn't silently become 1... it stays True."""
+    if value is None or isinstance(value, (bool, str)):
+        return value
+    if isinstance(value, int):
+        return int(value)  # np.int* subclasses included
+    if isinstance(value, float):
+        # Normalize through float(): np.float64 SUBCLASSES float, and
+        # its repr ("np.float64(nan)") must not leak into records.
+        value = float(value)
+        return value if math.isfinite(value) else repr(value)
+    # numpy scalar / 0-d array without importing numpy: duck-type on
+    # ndim==0 + .item().  (A 0-d ndarray is not Sized — len() raises —
+    # so this check must come before any container handling.)
+    if getattr(value, "ndim", None) == 0 and callable(getattr(value, "item", None)):
+        return json_safe(value.item())
+    if isinstance(value, dict):
+        return {str(k): json_safe(v) for k, v in value.items()}
+    if isinstance(value, (list, tuple, set, frozenset)):
+        return [json_safe(v) for v in value]
+    try:
+        json.dumps(value)
+        return value
+    except (TypeError, ValueError):
+        return str(value)
+
+
+def validate_record(rec, *, lineno: int | None = None) -> list[str]:
+    """Return a list of problems with one decoded record (empty = valid)."""
+    where = f"line {lineno}: " if lineno is not None else ""
+    problems = []
+    if not isinstance(rec, dict):
+        return [f"{where}record is not a JSON object: {type(rec).__name__}"]
+    for field in ENVELOPE:
+        if field not in rec:
+            problems.append(f"{where}missing envelope field {field!r}")
+    v = rec.get("v")
+    if v is not None and v != SCHEMA_VERSION:
+        problems.append(
+            f"{where}schema version {v!r} != supported {SCHEMA_VERSION}"
+        )
+    kind = rec.get("kind")
+    if kind is not None:
+        if kind not in EVENT_KINDS:
+            problems.append(f"{where}unknown kind {kind!r}")
+        else:
+            for field in EVENT_KINDS[kind]:
+                if field not in rec:
+                    problems.append(
+                        f"{where}kind {kind!r} missing required field {field!r}"
+                    )
+    ts = rec.get("ts")
+    if ts is not None and not isinstance(ts, (int, float)):
+        problems.append(f"{where}ts is not a number: {ts!r}")
+    seq = rec.get("seq")
+    if seq is not None and not isinstance(seq, int):
+        problems.append(f"{where}seq is not an int: {seq!r}")
+    return problems
+
+
+def validate_file(path) -> list[str]:
+    """Validate one JSONL events file; returns all problems found."""
+    problems = []
+    with open(path) as fh:
+        for lineno, line in enumerate(fh, start=1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError as exc:
+                problems.append(f"line {lineno}: invalid JSON: {exc}")
+                continue
+            problems.extend(validate_record(rec, lineno=lineno))
+    return problems

@@ -125,6 +125,7 @@ def spawn(
     env: dict[str, str] | None = None,
     max_restarts: int = 0,
     restart_backoff_s: float = 1.0,
+    events_dir: str | None = None,
 ):
     """Run ``fn(i, *args)`` for i in range(nprocs).
 
@@ -142,6 +143,13 @@ def spawn(
     checkpoint on startup (``--resume`` / elastic restore), which is what
     makes restart-from-zero into restart-from-last-epoch.  Requires
     ``join=True`` — supervision IS a blocking join loop.
+
+    ``events_dir`` enables supervisor-side observability: restart
+    attempts are recorded in ``events-supervisor.jsonl`` (the supervisor
+    is the only process that SEES a gang die, so only it can log the
+    respawn), workers inherit the directory via ``DDP_EVENTS_DIR``, and
+    on exit every per-writer file is merged into one gang
+    ``timeline.jsonl`` ordered by (ts, seq).
     """
     if nprocs < 1:
         raise ValueError(f"nprocs must be >= 1, got {nprocs}")
@@ -155,28 +163,63 @@ def spawn(
             )
         from distributeddataparallel_tpu.utils.logging import get_logger
 
-        for attempt in range(max_restarts + 1):
-            # The worker can surface its incarnation (FaultCounters.restarts,
-            # log lines) without any side channel back from the supervisor.
-            gang_env = dict(env or {})
-            gang_env["DDP_RESTART_ATTEMPT"] = str(attempt)
-            procs = _run_gang(fn, args, nprocs, gang_env)
-            failed = _join_gang(procs)
-            if not failed:
-                return None
-            if attempt >= max_restarts:
-                raise RuntimeError(
-                    f"spawned processes failed (rank, exitcode): {failed} "
-                    f"— restart budget of {max_restarts} exhausted"
-                )
-            get_logger().warning(
-                "[supervisor] gang failed (rank, exitcode): %s — "
-                "restart %d/%d after %.1fs",
-                failed, attempt + 1, max_restarts,
-                restart_backoff_s * (attempt + 1),
+        sup_events = None
+        if events_dir:
+            from distributeddataparallel_tpu.observability.events import (
+                EventLog,
             )
-            time.sleep(restart_backoff_s * (attempt + 1))
-        return None  # unreachable
+
+            sup_events = EventLog(
+                os.path.join(events_dir, "events-supervisor.jsonl"),
+                "supervisor",
+            )
+        try:
+            for attempt in range(max_restarts + 1):
+                # The worker can surface its incarnation
+                # (FaultCounters.restarts, log lines) without any side
+                # channel back from the supervisor.
+                gang_env = dict(env or {})
+                gang_env["DDP_RESTART_ATTEMPT"] = str(attempt)
+                if events_dir:
+                    gang_env.setdefault("DDP_EVENTS_DIR", events_dir)
+                procs = _run_gang(fn, args, nprocs, gang_env)
+                failed = _join_gang(procs)
+                if not failed:
+                    return None
+                if attempt >= max_restarts:
+                    if sup_events is not None:
+                        sup_events.emit(
+                            "restart_exhausted",
+                            attempt=attempt, failed=failed,
+                            max_restarts=max_restarts,
+                        )
+                    raise RuntimeError(
+                        f"spawned processes failed (rank, exitcode): {failed} "
+                        f"— restart budget of {max_restarts} exhausted"
+                    )
+                if sup_events is not None:
+                    sup_events.emit(
+                        "restart_attempt",
+                        attempt=attempt + 1, failed=failed,
+                        max_restarts=max_restarts,
+                    )
+                get_logger().warning(
+                    "[supervisor] gang failed (rank, exitcode): %s — "
+                    "restart %d/%d after %.1fs",
+                    failed, attempt + 1, max_restarts,
+                    restart_backoff_s * (attempt + 1),
+                )
+                time.sleep(restart_backoff_s * (attempt + 1))
+            return None  # unreachable
+        finally:
+            if sup_events is not None:
+                sup_events.close()
+            if events_dir:
+                from distributeddataparallel_tpu.observability.events import (
+                    merge_timeline,
+                )
+
+                merge_timeline(events_dir)
 
     if nprocs == 1:
         fn(0, *args)

@@ -114,12 +114,17 @@ class ResilientCheckpointer(Checkpointer):
         policy: RetryPolicy | None = None,
         injector=None,
         counters=None,
+        events=None,
     ):
         super().__init__(directory, max_to_keep=max_to_keep)
         self._max_to_keep = max_to_keep
         self._policy = policy or RetryPolicy()
         self._injector = injector
         self._counters = counters
+        # Optional observability EventLog: retries, fallbacks, and
+        # committed saves land in the per-worker event stream alongside
+        # the chaos injections that caused them.
+        self._events = events
         self._saves = 0
 
     # -- save: bounded retry + verification ----------------------------
@@ -138,6 +143,10 @@ class ResilientCheckpointer(Checkpointer):
                 # scope: orbax surfaces async IO errors at wait time.
                 super().wait()
                 self._verify_saved(epoch)
+                if self._events is not None:
+                    self._events.emit(
+                        "ckpt_save", epoch=epoch, attempts=attempt + 1
+                    )
                 return
             except Exception as e:  # noqa: BLE001 — retrying IO boundary
                 last_err = e
@@ -145,6 +154,11 @@ class ResilientCheckpointer(Checkpointer):
                     break
                 if self._counters is not None:
                     self._counters.io_retries += 1
+                if self._events is not None:
+                    self._events.emit(
+                        "ckpt_retry",
+                        epoch=epoch, attempt=attempt, error=str(e),
+                    )
                 # A failed async save can leave the manager poisoned
                 # (pending tmp dirs, a dead background thread): rebuild
                 # it; CheckpointManager init sweeps incomplete step dirs.
@@ -205,6 +219,10 @@ class ResilientCheckpointer(Checkpointer):
             except Exception as e:  # noqa: BLE001 — fault boundary
                 if self._counters is not None:
                     self._counters.ckpt_fallbacks += 1
+                if self._events is not None:
+                    self._events.emit(
+                        "ckpt_fallback", step=step, error=str(e)
+                    )
                 warn_all(
                     "checkpoint step %d is corrupt or unreadable (%s: %s) "
                     "— quarantining it and falling back to the previous "
@@ -423,7 +441,7 @@ class NonFiniteBreaker:
 
 
 def note_warm_start(
-    counters, *, mode: str, first_step_s: float | None = None
+    counters, *, mode: str, first_step_s: float | None = None, events=None
 ) -> None:
     """Record how this incarnation obtained its train step.
 
@@ -440,6 +458,11 @@ def note_warm_start(
     if first_step_s is not None:
         counters.compile_s = first_step_s
     attempt = int(os.environ.get("DDP_RESTART_ATTEMPT", "0") or 0)
+    if events is not None:
+        events.emit(
+            "warm_start",
+            mode=mode, first_step_s=first_step_s, attempt=attempt,
+        )
     log0(
         "warm start: attempt %d acquired the train step via %s%s",
         attempt, mode,

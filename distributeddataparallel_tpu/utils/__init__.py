@@ -1,4 +1,5 @@
 from distributeddataparallel_tpu.utils.logging import (  # noqa: F401
+    debug0,
     get_logger,
     log0,
     warn0,

@@ -117,9 +117,15 @@ class FaultInjector:
     so call sites need no conditional wiring.
     """
 
-    def __init__(self, spec: str = "", state_dir: str | None = None):
+    def __init__(
+        self, spec: str = "", state_dir: str | None = None, events=None
+    ):
         self._entries = parse_chaos_spec(spec)
         self._state_dir = state_dir
+        # Optional observability EventLog: every injection that fires is
+        # recorded as a ``chaos_inject`` event, so the gang timeline
+        # shows cause (injection) next to effect (skip/retry/restart).
+        self.events = events
         self._fired_local: set[str] = set()
         # Entries this PROCESS started firing (a multi-attempt ckpt-io
         # entry keeps failing attempts here even after its cross-restart
@@ -172,6 +178,8 @@ class FaultInjector:
                 # Mark BEFORE the fault takes effect: a preemption raise
                 # must not recur after the supervisor restarts us.
                 self._mark(e.key)
+                if self.events is not None:
+                    self.events.emit("chaos_inject", entry=e.key, step=step)
                 return e
         return None
 
@@ -224,6 +232,11 @@ class FaultInjector:
             if attempt < int(e.arg or 1):
                 self._owned.add(e.key)
                 self._mark(e.key)
+                if self.events is not None:
+                    self.events.emit(
+                        "chaos_inject",
+                        entry=e.key, step=ordinal, attempt=attempt,
+                    )
                 raise InjectedIOError(
                     f"chaos: injected checkpoint-IO failure "
                     f"({e.key}, attempt {attempt})"

@@ -11,11 +11,25 @@ jax.Arrays; formatting them forces the sync, so format only when printing).
 from __future__ import annotations
 
 import logging
+import os
 import sys
 
 import jax
 
 _LOGGER: logging.Logger | None = None
+
+
+def _level_from_env() -> int:
+    """Resolve DDP_LOG_LEVEL ("DEBUG"/"INFO"/"warning"/numeric) to a
+    logging level; unknown values fall back to INFO rather than erroring
+    — a typo in an env var must not take down a training run."""
+    name = os.environ.get("DDP_LOG_LEVEL", "").strip()
+    if not name:
+        return logging.INFO
+    if name.isdigit():
+        return int(name)
+    level = logging.getLevelName(name.upper())
+    return level if isinstance(level, int) else logging.INFO
 
 
 def get_logger() -> logging.Logger:
@@ -28,8 +42,8 @@ def get_logger() -> logging.Logger:
                 logging.Formatter("[%(asctime)s ddp-tpu] %(message)s", "%H:%M:%S")
             )
             logger.addHandler(h)
-            logger.setLevel(logging.INFO)
             logger.propagate = False
+        logger.setLevel(_level_from_env())
         _LOGGER = logger
     return _LOGGER
 
@@ -38,6 +52,13 @@ def log0(msg: str, *args) -> None:
     """Log from process 0 only (analog of the rank-0 gate at ref dpp.py:54)."""
     if jax.process_index() == 0:
         get_logger().info(msg, *args)
+
+
+def debug0(msg: str, *args) -> None:
+    """Debug-level rank-0 log — fault-path tracing that stays silent at
+    the default INFO level; enable with ``DDP_LOG_LEVEL=DEBUG``."""
+    if jax.process_index() == 0:
+        get_logger().debug(msg, *args)
 
 
 def warn0(msg: str, *args) -> None:

@@ -14,10 +14,17 @@ sync at window boundaries; per-step it just stamps the host clock.
 
 from __future__ import annotations
 
-import contextlib
 import time
 
 import jax
+
+# profile_trace moved to the observability subsystem (PR 3); re-exported
+# here so existing imports (`from distributeddataparallel_tpu.utils import
+# profile_trace`) keep working.
+from distributeddataparallel_tpu.observability.profiler import (  # noqa: F401
+    profile_trace,
+)
+from distributeddataparallel_tpu.observability.schema import json_safe
 
 
 class StepTimer:
@@ -137,29 +144,11 @@ class FaultCounters:
         if self.warm_start_mode is not None:
             out["warm_start"] = self.warm_start_mode
         if self.compile_s is not None:
-            out["first_step_s"] = round(self.compile_s, 3)
-        return out
-
-
-@contextlib.contextmanager
-def profile_trace(log_dir: str | None, *, sync: object = None):
-    """jax.profiler trace scope (XProf/TensorBoard).  No-op if dir is None.
-
-    ``sync`` is blocked on before stopping so the trace covers the async
-    device work launched inside the scope; pass a zero-arg callable to
-    resolve it at exit (e.g. ``lambda: state`` when the loop rebinds it).
-    """
-    if not log_dir:
-        yield
-        return
-    jax.profiler.start_trace(log_dir)
-    try:
-        yield
-    finally:
-        target = sync() if callable(sync) else sync
-        if target is not None:
-            jax.block_until_ready(target)
-        jax.profiler.stop_trace()
+            # compile_s may arrive as a numpy scalar or nan (warm-start
+            # timing of a failed acquisition); round() keeps those alive,
+            # so coerce — this dict goes into the JSONL event log.
+            out["first_step_s"] = round(float(self.compile_s), 3)
+        return json_safe(out)
 
 
 # Peak bidirectional ICI bandwidth per chip, bytes/s.  Used as the

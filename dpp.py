@@ -266,7 +266,30 @@ def parse_args(argv=None):
                         "training prompt via the KV-cache decode path "
                         "(LM models with replicated params: plain DP/ZeRO)")
     p.add_argument("--profile-dir", default=None,
-                   help="write a jax.profiler trace for epoch 0 here")
+                   help="write a jax.profiler trace for epoch 0 here "
+                        "(legacy whole-epoch capture; --profile-steps "
+                        "supersedes it when both are given)")
+    p.add_argument("--events-dir", default=None, metavar="DIR",
+                   help="observability: write schema-versioned JSONL "
+                        "events (spans, metrics snapshots, fault events) "
+                        "to DIR, one file per worker (env: "
+                        "DDP_EVENTS_DIR).  With --max-restarts the "
+                        "supervisor also logs restart attempts and "
+                        "merges everything into DIR/timeline.jsonl on "
+                        "exit")
+    p.add_argument("--metrics-every", type=int, default=100,
+                   help="export a metrics-registry snapshot every N "
+                        "steps into the event log (host-only work: no "
+                        "device sync).  0 disables periodic export; "
+                        "end-of-run export always happens")
+    p.add_argument("--profile-steps", default=None, metavar="A:B",
+                   help="capture a jax.profiler trace covering global "
+                        "steps [A, B) — a windowed alternative to "
+                        "--profile-dir's whole-epoch trace.  Traces go "
+                        "to --profile-dir if set, else "
+                        "EVENTS_DIR/xprof.  Also arms capture-on-"
+                        "anomaly: the first nan-guard trip or watchdog "
+                        "fire grabs a short trace")
     p.add_argument("--bw-probe", action="store_true",
                    help="measure grad all-reduce bandwidth utilization "
                         "over the data axis before training")
@@ -284,10 +307,21 @@ def parse_args(argv=None):
     # parent enabled without threading the flag everywhere.
     if args.compile_cache is None:
         args.compile_cache = os.environ.get("DDP_COMPILE_CACHE") or None
+    if args.events_dir is None:
+        args.events_dir = os.environ.get("DDP_EVENTS_DIR") or None
     if args.dispatch_depth < 0:
         raise SystemExit(
             f"--dispatch-depth must be >= 0, got {args.dispatch_depth}"
         )
+    if args.profile_steps is not None:
+        from distributeddataparallel_tpu.observability import (
+            parse_profile_steps,
+        )
+
+        try:
+            parse_profile_steps(args.profile_steps)
+        except ValueError as e:
+            raise SystemExit(str(e)) from None
     return args
 
 
@@ -771,6 +805,65 @@ def train(args) -> float:
         args.batch_size * n_replicas,
     )
 
+    # Observability (distributeddataparallel_tpu.observability): one
+    # schema-versioned JSONL event log + metrics registry per process,
+    # and an XLA-profiler orchestrator for windowed / on-anomaly capture.
+    # Everything stays host-side — emitting an event or exporting a
+    # snapshot never reads a device value, so none of it adds a sync.
+    events = tracer = registry = prof = None
+    if args.events_dir or args.profile_steps:
+        from distributeddataparallel_tpu.observability import (
+            EventLog,
+            JsonlExporter,
+            MetricsRegistry,
+            ProfilerOrchestrator,
+            TextExporter,
+            Tracer,
+            events_path,
+            parse_profile_steps,
+        )
+
+        proc = jax.process_index()
+        if args.events_dir:
+            events = EventLog(events_path(args.events_dir, proc), proc)
+            events.emit(
+                "run_start",
+                argv=sys.argv[1:],
+                attempt=int(os.environ.get("DDP_RESTART_ATTEMPT", "0") or 0),
+                devices=ddp.global_device_count(),
+            )
+            registry = MetricsRegistry()
+            registry.add_exporter(JsonlExporter(events))
+            if proc == 0:
+                # Rank-0 plaintext /metrics-style snapshot, refreshed at
+                # every export — the file a human or node scraper reads.
+                registry.add_exporter(
+                    TextExporter(os.path.join(args.events_dir, "metrics.txt"))
+                )
+            tracer = Tracer(events, registry)
+        # Trace destination: --profile-dir when given, else a subdir of
+        # the events dir.  The orchestrator is armed whenever it has
+        # somewhere to write — --profile-steps drives the window, and
+        # the first nan-guard trip or watchdog fire grabs a short
+        # anomaly capture either way.
+        prof_dir = args.profile_dir or (
+            os.path.join(args.events_dir, "xprof") if args.events_dir
+            else None
+        )
+        if prof_dir:
+            prof = ProfilerOrchestrator(
+                prof_dir,
+                window=parse_profile_steps(args.profile_steps),
+                events=events,
+            )
+
+    def _span(name, **attrs):
+        if tracer is not None:
+            return tracer.span(name, **attrs)
+        import contextlib
+
+        return contextlib.nullcontext()
+
     cp = args.cp > 1
     if cp:
         from distributeddataparallel_tpu.data import shard_lm_batch
@@ -1132,6 +1225,12 @@ def train(args) -> float:
     counters = FaultCounters()
     # Set by the launcher's supervision loop: which incarnation this is.
     counters.restarts = int(os.environ.get("DDP_RESTART_ATTEMPT", "0") or 0)
+    if registry is not None:
+        # Every subsystem's telemetry registers here instead of owning a
+        # private dict; values are pulled lazily at export time (pure
+        # host reads — the loader gauge is a qsize() call).
+        registry.bind("faults", counters.summary)
+        registry.bind("loader_prefetch_depth", lambda: loader.prefetch_depth)
     if args.chaos:
         # Marker state under the checkpoint dir: each chaos entry fires
         # at most once ACROSS supervised restarts.
@@ -1144,6 +1243,10 @@ def train(args) -> float:
         )
     else:
         injector = FaultInjector.from_env()
+    # Injections land in the event stream next to their effects
+    # (nan_skip / ckpt_retry / restart_attempt) — the gang timeline's
+    # cause-and-effect pairs.
+    injector.events = events
     breaker = NonFiniteBreaker(args.max_bad_steps) if args.nan_guard else None
 
     ckpt = None
@@ -1156,7 +1259,8 @@ def train(args) -> float:
         )
 
         ckpt = ResilientCheckpointer(
-            args.checkpoint_dir, injector=injector, counters=counters
+            args.checkpoint_dir, injector=injector, counters=counters,
+            events=events,
         )
         flat_tp = (
             "model"
@@ -1407,6 +1511,12 @@ def train(args) -> float:
         if bad:
             counters.nonfinite_steps += 1
             e, b = where
+            if events is not None:
+                events.emit("nan_skip", step=e * spe + b, epoch=e, batch=b)
+            if prof is not None:
+                # First anomaly grabs a short trace of the steps right
+                # after the blow-up — while it is still happening.
+                prof.trigger_anomaly("nan_grad", e * spe + b)
             warn0(
                 "non-finite gradients at epoch %d batch %d:"
                 " update skipped", e, b,
@@ -1429,6 +1539,25 @@ def train(args) -> float:
     if args.step_timeout:
         def _on_wedge(diag):
             counters.watchdog_fires += 1
+            last = diag.get("last_known_state") or {}
+            if events is not None:
+                events.emit(
+                    "watchdog_fire",
+                    seconds_since_heartbeat=diag.get(
+                        "seconds_since_heartbeat"
+                    ),
+                    last_known_state=last,
+                )
+                events.flush()  # the process is about to exit 75
+            if prof is not None:
+                # immediate=True: the loop is wedged — there may never
+                # be another step to close a windowed capture on.
+                prof.trigger_anomaly(
+                    "watchdog",
+                    int(last.get("epoch", 0)) * spe
+                    + int(last.get("batch", 0)),
+                    immediate=True,
+                )
             if ckpt is None:
                 return
             # Best-effort: saving may itself block on the wedged
@@ -1457,8 +1586,12 @@ def train(args) -> float:
     try:
         for epoch in range(start_epoch, args.epochs):    # ref dpp.py:44
             epoch_rng = jax.random.fold_in(base_rng, epoch)
-            with profile_trace(
-                args.profile_dir if epoch == start_epoch else None,
+            # Legacy whole-epoch trace only when the windowed capture
+            # isn't driving the (global, single-slot) profiler.
+            with _span("epoch", epoch=epoch), profile_trace(
+                args.profile_dir
+                if epoch == start_epoch and not args.profile_steps
+                else None,
                 sync=lambda: state.params,  # resolves to latest state at exit
             ):
                 loader.set_epoch(epoch)                  # ref dpp.py:46
@@ -1467,20 +1600,30 @@ def train(args) -> float:
                             and batch_idx >= args.steps_per_epoch:
                         break
                     gstep = epoch * spe + batch_idx
+                    if prof is not None:
+                        prof.on_step_start(gstep)
                     injector.before_step(gstep)   # slow-step / preempt
                     batch = injector.corrupt_batch(batch, gstep)
                     sub = jax.random.fold_in(epoch_rng, batch_idx)
-                    state, metrics = step_fn(state, batch, sub)
-                    # Bounded async dispatch: enqueue this step's guard
-                    # handle and settle only what falls out of the
-                    # K-deep window (the old pattern blocked here every
-                    # step when the nan guard was armed).
-                    guard = (
-                        metrics["nonfinite_grad"] if breaker is not None
-                        else metrics["loss"]
-                    )
-                    for h, w in dispatch.push(guard, (epoch, batch_idx)):
-                        settle(h, w)
+                    # The step span times host-side dispatch (plus any
+                    # window-overflow settles) — the honest per-step
+                    # number for an async loop; device wall time lands
+                    # in the readings at drain boundaries.
+                    with _span("step", step=gstep):
+                        state, metrics = step_fn(state, batch, sub)
+                        # Bounded async dispatch: enqueue this step's
+                        # guard handle and settle only what falls out of
+                        # the K-deep window (the old pattern blocked
+                        # here every step when the nan guard was armed).
+                        guard = (
+                            metrics["nonfinite_grad"]
+                            if breaker is not None
+                            else metrics["loss"]
+                        )
+                        for h, w in dispatch.push(guard, (epoch, batch_idx)):
+                            settle(h, w)
+                    if prof is not None:
+                        prof.on_step_end(gstep)
                     if watchdog is not None:
                         if watchdog.running:
                             watchdog.beat(epoch=epoch, batch=batch_idx)
@@ -1498,14 +1641,33 @@ def train(args) -> float:
                             counters,
                             mode=warm_report.get("mode", "jit"),
                             first_step_s=timer.compile_s,
+                            events=events,
                         )
                     if reading:
                         drain()  # window boundary: fully-synced state
+                        if registry is not None:
+                            # StepTimer readings feed the registry; the
+                            # values are already host floats.
+                            g = registry.gauge
+                            g("items_per_s").set(reading["items_per_s"])
+                            g("items_per_s_per_chip").set(
+                                reading["items_per_s_per_chip"]
+                            )
+                            g("steps_per_s").set(reading["steps_per_s"])
                         log0(
                             "throughput: %.0f %s/s (%.1f %s/s/chip)",
                             reading["items_per_s"], unit,
                             reading["items_per_s_per_chip"], unit,
                         )
+                    if (
+                        registry is not None
+                        and args.metrics_every
+                        and gstep % args.metrics_every == 0
+                    ):
+                        # Periodic snapshot into the event log: pure
+                        # host reads (counters, gauges, the loader's
+                        # qsize), so this cadence adds no device sync.
+                        registry.export(step=gstep)
                     if batch_idx % args.log_every == 0:  # ref dpp.py:54-55
                         drain()
                         last_loss = float(metrics["loss"])
@@ -1513,8 +1675,9 @@ def train(args) -> float:
                              epoch, batch_idx, last_loss)
                     if ckpt is not None and preempt_agreed(batch_idx):
                         drain()  # checkpoint edge: fully-synced state
-                        ckpt.save(state, epoch, meta=ckpt_meta)
-                        ckpt.wait()
+                        with _span("ckpt_save", epoch=epoch):
+                            ckpt.save(state, epoch, meta=ckpt_meta)
+                            ckpt.wait()
                         log0("preempted: checkpoint saved mid-epoch %d; "
                              "--resume continues from epoch %d",
                              epoch, epoch + 1)
@@ -1549,7 +1712,8 @@ def train(args) -> float:
                     }
                     log0("Epoch %d eval: %s", epoch, mean)
             if ckpt is not None:
-                ckpt.save(state, epoch, meta=ckpt_meta)
+                with _span("ckpt_save", epoch=epoch):
+                    ckpt.save(state, epoch, meta=ckpt_meta)
             if eval_step is not None or ckpt is not None:
                 # Don't let eval/checkpoint wall time pollute throughput.
                 timer.reset()
@@ -1569,6 +1733,33 @@ def train(args) -> float:
     finally:
         if watchdog is not None:
             watchdog.stop()
+        if prof is not None:
+            prof.close()
+        if registry is not None:
+            # Final snapshot always lands, whatever the exit path.
+            try:
+                registry.export(final=True)
+            except Exception:  # noqa: BLE001 — telemetry must not mask
+                pass
+        if events is not None:
+            exc = sys.exc_info()[1]
+            events.emit(
+                "run_end",
+                status="ok" if exc is None else type(exc).__name__,
+                faults=counters.summary(),
+            )
+            events.close()
+            if jax.process_index() == 0 and not os.environ.get(
+                "_DDP_SUPERVISED"
+            ):
+                # Unsupervised runs merge their own gang timeline; under
+                # supervision the launcher does it after the LAST
+                # incarnation, so the merge sees every attempt's events.
+                from distributeddataparallel_tpu.observability import (
+                    merge_timeline,
+                )
+
+                merge_timeline(args.events_dir)
     if counters.total:
         log0("fault summary: %s", counters.summary())
 
@@ -1656,6 +1847,10 @@ def main(argv=None):
             _worker, args=(child_argv,), nprocs=1,
             max_restarts=args.max_restarts,
             env={"_DDP_SUPERVISED": "1"},
+            # Supervisor-side observability: restart attempts land in
+            # events-supervisor.jsonl and the per-worker logs merge into
+            # one gang timeline.jsonl when supervision ends.
+            events_dir=args.events_dir,
         )
         return
     select_device(args)

@@ -1,0 +1,88 @@
+#!/usr/bin/env python
+"""Validate observability events JSONL files against the schema.
+
+Usage:
+    python scripts/check_events.py EVENTS.jsonl [MORE.jsonl ...]
+    python scripts/check_events.py --expect-order k1,k2,k3 timeline.jsonl
+
+Exit 0 when every record in every file is schema-valid (and, with
+``--expect-order``, the listed kinds appear in that relative order);
+exit 1 otherwise, printing each problem.  Used by tests/test_observability
+and by the README smoke step; importable (``main(argv)``) so tests can
+call it in-process.
+
+Import-light on purpose: pulls in only the observability schema (stdlib),
+never jax — it must run anywhere, including a bare CI box.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from distributeddataparallel_tpu.observability.schema import (  # noqa: E402
+    validate_file,
+)
+
+
+def check_order(path: str, kinds: list[str]) -> list[str]:
+    """Check the listed kinds occur in the file in that relative order
+    (other records may interleave).  Greedy first-occurrence matching:
+    causal order in a (ts, seq)-sorted timeline."""
+    import json
+
+    want = list(kinds)
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line or not want:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if rec.get("kind") == want[0]:
+                want.pop(0)
+    if want:
+        return [
+            f"{path}: expected kind order {','.join(kinds)} but never "
+            f"reached {want[0]!r} (missing: {','.join(want)})"
+        ]
+    return []
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("files", nargs="+", help="events JSONL file(s)")
+    ap.add_argument(
+        "--expect-order",
+        default=None,
+        metavar="K1,K2,...",
+        help="comma-separated event kinds that must appear in this "
+        "relative order in each file",
+    )
+    args = ap.parse_args(argv)
+
+    problems = []
+    for path in args.files:
+        if not os.path.exists(path):
+            problems.append(f"{path}: no such file")
+            continue
+        problems.extend(f"{path}: {p}" for p in validate_file(path))
+        if args.expect_order:
+            problems.extend(
+                check_order(path, [k.strip() for k in args.expect_order.split(",")])
+            )
+    for p in problems:
+        print(p, file=sys.stderr)
+    if not problems:
+        n = len(args.files)
+        print(f"check_events: {n} file(s) OK")
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
