@@ -1,0 +1,19 @@
+"""ddplint: static SPMD-invariant checking for the DDP reproduction.
+
+Two layers — graph rules over the traced/lowered train step
+(``graph_lint``) and AST rules over the package source (``ast_rules``)
+— with a shared rule registry (``rules``).  CLI: ``scripts/ddplint.py``.
+
+Import note: this package root only re-exports the stdlib-only pieces;
+``graph_lint`` (which imports jax) is imported lazily by the callers
+that need it, so ``analysis.ast_rules`` stays usable in jax-free
+interpreters.
+"""
+
+from distributeddataparallel_tpu.analysis.rules import (  # noqa: F401
+    RULES,
+    Finding,
+    collective_manifest,
+    format_findings,
+    rule_table,
+)

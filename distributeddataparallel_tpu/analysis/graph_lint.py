@@ -1,0 +1,392 @@
+"""Graph lint: verify SPMD invariants on the *traced/lowered* train step.
+
+The checks run on ``jax.make_jaxpr`` output (and, for donation, on the
+lowered StableHLO module) of the real step function — the same program
+XLA compiles — so they hold regardless of how the Python source is
+organized.  Nothing here compiles or executes device code: tracing and
+lowering are pure host work, which is what lets tier-1 CI run these on
+a CPU box and the trainer run them before its first compile
+(``dpp.py --lint-step``).
+
+What is checked (rule ids in ``analysis.rules``):
+
+- GL001: the gradient-reduction collectives per mesh axis match the
+  factory's manifest (exactly one leaf-wise psum family for plain DP,
+  reduce_scatter+all_gather for ZeRO/FSDP, ppermute on the pipe axis,
+  ...) — a dropped psum or a doubled sync is a count mismatch;
+- GL002: the collective *sequence* fingerprint is stable across two
+  independent traces — the determinism every gang relies on (all ranks
+  must issue collectives in the same order), and the artifact to
+  compare across ranks or against a ``warm_start.ExecutableStore``
+  entry's program;
+- GL003: ``donate=True`` actually produced input->output buffer
+  aliasing covering params + optimizer state in the lowered module;
+- GL004: no bf16->f32 promotion — neither on the wire (f32 gradient
+  reduction under uniformly-bf16 params) nor in the returned state
+  (output param dtypes must equal input param dtypes);
+- GL005: no host callbacks (io_callback / pure_callback /
+  debug_callback / debug.print) inside the jitted step.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import re
+from typing import Any
+
+import jax
+
+from distributeddataparallel_tpu.analysis.rules import (
+    Finding,
+    collective_manifest,
+)
+
+#: collective primitives tracked for counting/fingerprinting
+COLLECTIVE_PRIMS = frozenset({
+    "psum", "psum2", "psum_invariant", "pmin", "pmax", "pbroadcast",
+    "all_gather", "all_gather_invariant", "reduce_scatter",
+    "psum_scatter", "ppermute", "pgather", "all_to_all",
+})
+
+#: reduction collectives that move gradient-sized payloads — an
+#: unexpected one on an unexpected axis is a double-sync bug
+REDUCE_PRIMS = frozenset({
+    "psum", "psum2", "psum_invariant", "reduce_scatter", "psum_scatter",
+})
+
+#: host round-trip primitives forbidden inside the step (GL005)
+HOST_CALLBACK_PRIMS = frozenset({
+    "io_callback", "pure_callback", "debug_callback", "debug_print",
+    "callback",
+})
+
+#: donated-argument markers in the lowered StableHLO entry function;
+#: which one appears depends on whether XLA committed the alias at
+#: lowering (tf.aliasing_output) or deferred it (jax.buffer_donor)
+_DONATION_RE = re.compile(r"tf\.aliasing_output|jax\.buffer_donor")
+
+
+@dataclasses.dataclass(frozen=True)
+class Collective:
+    """One collective eqn seen in the jaxpr walk (deterministic order)."""
+
+    prim: str
+    axes: tuple[str, ...]
+    shapes: tuple[tuple[int, ...], ...]
+    dtypes: tuple[str, ...]
+
+    @property
+    def nonscalar(self) -> bool:
+        return any(len(s) > 0 for s in self.shapes)
+
+    def key(self) -> tuple:
+        return (self.prim, self.axes, self.shapes, self.dtypes)
+
+
+def _subjaxprs(params: dict):
+    """Yield every jaxpr nested in an eqn's params (pjit/shard_map/scan
+    bodies, cond branches, custom_vjp rules, ...)."""
+    for v in params.values():
+        items = v if isinstance(v, (list, tuple)) else (v,)
+        for it in items:
+            if hasattr(it, "eqns"):           # raw Jaxpr
+                yield it
+            elif hasattr(it, "jaxpr"):        # ClosedJaxpr
+                yield it.jaxpr
+
+
+def _axes_of(params: dict) -> tuple[str, ...]:
+    axes = params.get("axes")
+    if axes is None:
+        axes = params.get("axis_name")
+    if axes is None:
+        return ()
+    if isinstance(axes, (list, tuple)):
+        return tuple(str(a) for a in axes)
+    return (str(axes),)
+
+
+def walk_jaxpr(jaxpr):
+    """Depth-first deterministic walk over every eqn, nested included."""
+    stack = [jaxpr]
+    while stack:
+        jx = stack.pop()
+        for eqn in jx.eqns:
+            yield eqn
+            stack.extend(_subjaxprs(eqn.params))
+
+
+def collect_collectives(closed_jaxpr) -> list[Collective]:
+    out = []
+    for eqn in walk_jaxpr(closed_jaxpr.jaxpr):
+        name = eqn.primitive.name
+        if name in COLLECTIVE_PRIMS:
+            out.append(Collective(
+                prim=name,
+                axes=_axes_of(eqn.params),
+                shapes=tuple(
+                    tuple(getattr(v.aval, "shape", ())) for v in eqn.invars
+                ),
+                dtypes=tuple(
+                    str(getattr(v.aval, "dtype", "?")) for v in eqn.invars
+                ),
+            ))
+    return out
+
+
+def collect_host_callbacks(closed_jaxpr) -> list[str]:
+    return [
+        eqn.primitive.name
+        for eqn in walk_jaxpr(closed_jaxpr.jaxpr)
+        if eqn.primitive.name in HOST_CALLBACK_PRIMS
+    ]
+
+
+def collective_fingerprint(collectives) -> str:
+    """Stable digest of the collective sequence (prim, axes, operand
+    shapes/dtypes, in deterministic jaxpr walk order).  Identical
+    Python -> identical fingerprint, so two ranks (or two incarnations
+    restoring from the same ``warm_start.ExecutableStore`` entry) can
+    compare a 16-hex string instead of diffing HLO."""
+    h = hashlib.sha256()
+    for c in collectives:
+        h.update(repr(c.key()).encode())
+    return h.hexdigest()[:16]
+
+
+def _donated_args(lowered_text: str) -> int:
+    return len(_DONATION_RE.findall(lowered_text))
+
+
+def _lower_fn(step):
+    """Best-effort access to the step's AOT ``lower`` without compiling.
+
+    Step factories return either a jitted callable (has ``.lower``), a
+    wrapper with ``.lower`` attached (ZeRO/TP/EP path), or a wrapper
+    exposing the inner jit as ``.jitted`` once traced (FSDP/PP paths) —
+    ``lint_train_step`` traces first, so ``.jitted`` is populated by
+    the time this runs.
+    """
+    jitted = getattr(step, "jitted", None)
+    if jitted is not None and hasattr(jitted, "lower"):
+        return jitted.lower
+    if hasattr(step, "lower"):
+        return step.lower
+    return None
+
+
+def default_manifest(axis_name: str = "data", *, donate: bool = True) -> dict:
+    """Fallback contract for steps whose factory attaches no manifest:
+    at least one gradient-sized psum over the data axis."""
+    return collective_manifest(
+        "generic-dp",
+        grad_reduce={axis_name: {"psum": (1, None)}},
+        donate=donate,
+    )
+
+
+@dataclasses.dataclass
+class GraphReport:
+    """Lint outcome + the artifacts worth logging even when clean."""
+
+    mode: str
+    findings: list
+    fingerprint: str
+    collective_counts: dict
+    donated_args: int | None = None
+    donation_expected: int | None = None
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings
+
+
+def _check_counts(colls, manifest, n_param_leaves, where) -> list[Finding]:
+    findings = []
+    counts: dict[tuple[str, str], int] = {}
+    for c in colls:
+        if not c.nonscalar:
+            continue
+        for ax in c.axes:
+            counts[(ax, c.prim)] = counts.get((ax, c.prim), 0) + 1
+
+    grad_reduce = manifest["grad_reduce"]
+    for axis, prims in grad_reduce.items():
+        for prim, (mn, mx) in prims.items():
+            n = counts.get((axis, prim), 0)
+            if n < mn:
+                findings.append(Finding(
+                    "GL001", where,
+                    f"expected >= {mn} gradient-sized {prim} over axis "
+                    f"{axis!r}, found {n} — gradient reduction dropped?",
+                ))
+            elif mx is not None and n > mx:
+                findings.append(Finding(
+                    "GL001", where,
+                    f"expected <= {mx} gradient-sized {prim} over axis "
+                    f"{axis!r}, found {n} — duplicated sync?",
+                ))
+    for axis in manifest["per_leaf_axes"]:
+        n = counts.get((axis, "psum"), 0)
+        if n != n_param_leaves:
+            findings.append(Finding(
+                "GL001", where,
+                f"leaf-wise sync over axis {axis!r}: expected exactly "
+                f"{n_param_leaves} psums (one per param leaf), found {n}",
+            ))
+    for (axis, prim), n in sorted(counts.items()):
+        if prim in REDUCE_PRIMS and axis not in grad_reduce:
+            findings.append(Finding(
+                "GL001", where,
+                f"{n} gradient-sized {prim} over UNEXPECTED axis {axis!r} "
+                f"(manifest for mode {manifest['mode']!r} declares "
+                f"{sorted(grad_reduce)})",
+            ))
+    return findings
+
+
+def _check_dtypes(colls, manifest, params, out_params, where) -> list:
+    findings = []
+    in_leaves = jax.tree.leaves(params)
+    all_bf16 = bool(in_leaves) and all(
+        str(l.dtype) == "bfloat16" for l in in_leaves
+    )
+    if all_bf16 and not manifest["allow_f32_reduce"]:
+        for c in colls:
+            if (
+                c.prim in REDUCE_PRIMS
+                and c.nonscalar
+                and any(d == "float32" for d in c.dtypes)
+                and any(ax in manifest["grad_reduce"] for ax in c.axes)
+            ):
+                findings.append(Finding(
+                    "GL004", where,
+                    f"{c.prim} over {c.axes} carries float32 operands "
+                    f"{c.shapes} while params are uniformly bf16 — "
+                    "gradients promoted before the wire (2x bytes)",
+                ))
+                break  # one finding per step is enough signal
+    if out_params is not None:
+        for (path, a), b in zip(
+            jax.tree_util.tree_flatten_with_path(params)[0],
+            jax.tree.leaves(out_params),
+        ):
+            if str(a.dtype) != str(b.dtype):
+                name = "/".join(
+                    str(getattr(k, "key", getattr(k, "idx", k)))
+                    for k in path
+                )
+                findings.append(Finding(
+                    "GL004", where,
+                    f"param {name!r} enters {a.dtype} but the updated "
+                    f"state returns {b.dtype} — state dtype promoted",
+                ))
+    return findings
+
+
+def lint_train_step(
+    step,
+    state,
+    batch,
+    rng,
+    *,
+    manifest: dict | None = None,
+    check_order: bool = True,
+    check_donation: bool = True,
+    mode: str | None = None,
+) -> GraphReport:
+    """Trace ``step(state, batch, rng)`` and verify the manifest.
+
+    Pure host work: ``make_jaxpr`` twice (once for the rules, once for
+    the GL002 order fingerprint) plus — when donation is claimed — one
+    lowering for the GL003 aliasing check.  No compile is triggered, so
+    the trainer can run this and still fail fast *before* paying the
+    first XLA compile.  Inputs may be concrete arrays or
+    ``jax.ShapeDtypeStruct`` trees.
+    """
+    manifest = manifest or getattr(step, "collective_manifest", None) \
+        or default_manifest()
+    where = f"graph:{mode or manifest['mode']}"
+    findings: list[Finding] = []
+
+    jaxpr, out_shape = jax.make_jaxpr(step, return_shape=True)(
+        state, batch, rng
+    )
+    colls = collect_collectives(jaxpr)
+    fingerprint = collective_fingerprint(colls)
+
+    n_param_leaves = len(jax.tree.leaves(state.params))
+    findings += _check_counts(colls, manifest, n_param_leaves, where)
+
+    out_params = None
+    out_state = out_shape[0] if isinstance(out_shape, tuple) else out_shape
+    if hasattr(out_state, "params"):
+        out_params = out_state.params
+    findings += _check_dtypes(
+        colls, manifest, state.params, out_params, where
+    )
+
+    for prim in sorted(set(collect_host_callbacks(jaxpr))):
+        findings.append(Finding(
+            "GL005", where,
+            f"host callback primitive {prim!r} inside the jitted step — "
+            "every step round-trips to Python",
+        ))
+
+    if check_order:
+        jaxpr2 = jax.make_jaxpr(step)(state, batch, rng)
+        fp2 = collective_fingerprint(collect_collectives(jaxpr2))
+        if fp2 != fingerprint:
+            findings.append(Finding(
+                "GL002", where,
+                f"collective sequence fingerprint changed between two "
+                f"traces of the same step ({fingerprint} != {fp2}) — "
+                "nondeterministic collective order will wedge the gang",
+            ))
+
+    donated = expected = None
+    if check_donation and manifest["donate"]:
+        lower = _lower_fn(step)
+        if lower is not None:
+            donated, expected = donation_report(
+                step, state, batch, rng, lower=lower
+            )
+            if donated < expected:
+                findings.append(Finding(
+                    "GL003", where,
+                    f"donate=True but only {donated} of {expected} "
+                    "params+opt-state inputs are aliased to outputs in "
+                    "the lowered module — donation lost (2x state "
+                    "memory at runtime)",
+                ))
+
+    counts: dict[str, int] = {}
+    for c in colls:
+        if c.nonscalar:
+            for ax in c.axes:
+                k = f"{ax}:{c.prim}"
+                counts[k] = counts.get(k, 0) + 1
+    return GraphReport(
+        mode=mode or manifest["mode"],
+        findings=findings,
+        fingerprint=fingerprint,
+        collective_counts=counts,
+        donated_args=donated,
+        donation_expected=expected,
+    )
+
+
+def donation_report(step, state, batch, rng, *, lower=None) -> tuple:
+    """(donated_arg_count, expected_count) from the lowered module —
+    expected covers params + optimizer state (the buffers the step
+    claims to update in place).  Lowering only; no compile."""
+    lower = lower or _lower_fn(step)
+    if lower is None:
+        raise ValueError(
+            "step exposes no .lower/.jitted handle; trace it once first "
+            "or pass lower= explicitly"
+        )
+    text = lower(state, batch, rng).as_text()
+    expected = len(jax.tree.leaves((state.params, state.opt_state)))
+    return _donated_args(text), expected

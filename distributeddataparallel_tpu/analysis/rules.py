@@ -1,0 +1,165 @@
+"""ddplint rule registry: ids, descriptions, waivers, and manifests.
+
+The static-analysis subsystem checks the repo's SPMD invariants in two
+layers (following the pjit-at-scale practice of validating the *lowered
+program* rather than trusting the Python source):
+
+- **graph rules (GL*)** run over the jaxpr / lowered module of a real
+  train step (``analysis.graph_lint``) — they see what XLA will see, so
+  a dropped ``psum`` or a lost ``donate_argnums`` cannot hide behind a
+  refactor;
+- **AST rules (AL*)** run over the package source
+  (``analysis.ast_rules``) — they catch host-side hot-path hazards
+  (accidental device syncs, wall-clock/RNG inside traced code,
+  swallowed exceptions, unregistered telemetry kinds) that never show
+  up in a jaxpr because they happen *around* it.
+
+Waivers: AST findings can be waived per line with a pragma comment
+``# ddplint: allow[<tag>]`` on the offending line (or the line directly
+above, for wrapped statements).  Graph rules have no pragma — they are
+driven by the step factory's collective manifest, so the factory itself
+declares what the lowered program is supposed to contain.
+
+Module-import rule: stdlib only.  Both the AST layer and
+``scripts/check_events.py`` import this file in jax-free interpreters.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+#: rule id -> (layer, name, what it catches, waiver)
+RULES: dict[str, tuple[str, str, str, str]] = {
+    "GL001": (
+        "graph", "grad-reduce-count",
+        "missing/extra gradient-sized reduction collectives per mesh "
+        "axis (a dropped psum trains on per-replica grads; a doubled "
+        "one pays the wire twice)",
+        "factory manifest (grad_reduce bounds)",
+    ),
+    "GL002": (
+        "graph", "collective-order",
+        "collective sequence fingerprint differs between two traces of "
+        "the same step (nondeterministic collective order deadlocks a "
+        "gang: ranks would issue collectives in different orders)",
+        "none",
+    ),
+    "GL003": (
+        "graph", "donation-coverage",
+        "factory requested donate=True but the lowered module does not "
+        "alias params+optimizer-state inputs to outputs (silent 2x "
+        "state memory)",
+        "factory manifest (donate=False)",
+    ),
+    "GL004": (
+        "graph", "dtype-promotion",
+        "bf16 params/grads promoted to f32 — on the wire (f32 "
+        "gradient reduction under uniformly-bf16 params) or in the "
+        "updated state (output param dtype != input param dtype)",
+        "factory manifest (allow_f32_reduce)",
+    ),
+    "GL005": (
+        "graph", "host-callback",
+        "io_callback/pure_callback/debug_callback/debug.print inside "
+        "the jitted step (host round-trip serializes every step)",
+        "none",
+    ),
+    "AL101": (
+        "ast", "host-sync",
+        "block_until_ready / .item() / float(<call>) / np.asarray in "
+        "hot-path modules (each is a device->host sync on a jax array)",
+        "# ddplint: allow[host-sync]",
+    ),
+    "AL102": (
+        "ast", "time-in-jit",
+        "time.*/np.random/random/datetime.now inside jit-decorated or "
+        "make_*_step inner functions (baked in as a trace-time "
+        "constant — silently frozen, not per-step)",
+        "# ddplint: allow[time-in-jit]",
+    ),
+    "AL103": (
+        "ast", "broad-except",
+        "bare except / except (Base)Exception without justification "
+        "(swallows KeyboardInterrupt or masks real faults)",
+        "# ddplint: allow[broad-except]",
+    ),
+    "AL104": (
+        "ast", "event-kind",
+        "EventLog.emit(kind) string literal not registered in "
+        "observability.schema.EVENT_KINDS (schema drift: consumers "
+        "reject or misparse the record)",
+        "# ddplint: allow[event-kind]",
+    ),
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One lint violation.  ``rule`` is the table id (GL001...); ``where``
+    is a file:line for AST findings or a mode/step label for graph
+    findings."""
+
+    rule: str
+    where: str
+    message: str
+
+    @property
+    def name(self) -> str:
+        return RULES[self.rule][1]
+
+    def __str__(self) -> str:  # the CLI's one-line format
+        return f"{self.where}: {self.rule} [{self.name}] {self.message}"
+
+
+def format_findings(findings) -> str:
+    return "\n".join(str(f) for f in findings)
+
+
+def rule_table() -> str:
+    """The rule table as aligned text (CLI --list-rules; README source)."""
+    rows = [("id", "layer", "name", "catches", "waiver")]
+    for rid, (layer, name, what, waiver) in sorted(RULES.items()):
+        rows.append((rid, layer, name, what, waiver))
+    return "\n".join(
+        f"{r[0]:<7} {r[1]:<6} {r[2]:<18} {r[3]}  [waiver: {r[4]}]"
+        for r in rows[1:]
+    )
+
+
+def collective_manifest(
+    mode: str,
+    *,
+    grad_reduce: dict,
+    donate: bool = True,
+    allow_f32_reduce: bool = False,
+    per_leaf_axes: tuple = (),
+) -> dict:
+    """The expected-collective manifest a step factory attaches to its
+    returned step (``step.collective_manifest``) — the contract the
+    graph linter verifies the lowered program against.
+
+    ``grad_reduce`` maps mesh axis name -> {primitive: (min, max|None)}
+    bounds on the number of *gradient-sized* (non-scalar operand)
+    collectives over that axis.  Scalar reductions (loss/metric pmean,
+    the nonfinite-guard pmin) are never counted.  Axes not listed at
+    all must carry NO gradient-sized reduction — an unexpected axis is
+    a double-sync bug, not forward-compat.
+
+    ``per_leaf_axes``: axes where the count must EQUAL the number of
+    parameter leaves (the unbucketed leaf-wise psum layout) — this is
+    what turns "synced twice" into a countable violation.
+
+    ``allow_f32_reduce``: waives the GL004 wire check for modes whose
+    reduction legitimately runs f32 (legacy coalesced buckets, ZeRO/
+    FSDP f32 master flats).
+    """
+    return {
+        "mode": mode,
+        "grad_reduce": {
+            str(ax): {str(p): tuple(b) for p, b in prims.items()}
+            for ax, prims in grad_reduce.items()
+        },
+        "donate": bool(donate),
+        "allow_f32_reduce": bool(allow_f32_reduce),
+        "per_leaf_axes": tuple(str(a) for a in per_leaf_axes),
+    }

@@ -192,6 +192,10 @@ class DataLoader:
         self._depth_fn = None
         self.starvation_window = starvation_window
         self._starved_warned = False
+        # Optional observability EventLog; when set (dpp.py wires it),
+        # starvation emits a structured "loader_starved" record next to
+        # the human warning.
+        self.events = None
 
         self._samplers = [
             DistributedSampler(
@@ -230,7 +234,7 @@ class DataLoader:
             return 0
         try:
             return int(fn())
-        except Exception:
+        except (TypeError, ValueError, NotImplementedError, OSError):
             return 0
 
     def _gather(self, idx: np.ndarray, image_gather=None) -> Pytree:
@@ -379,6 +383,8 @@ class DataLoader:
                     if not put(self._place_fn(host_batch)):
                         return
                 put(done)
+            # ddplint: allow[broad-except] — producer thread: transports ANY
+            # failure (incl. KeyboardInterrupt) to the consumer via the queue
             except BaseException as e:  # noqa: BLE001 — surface to consumer
                 pending_error.append(e)
                 put(e)
@@ -409,6 +415,12 @@ class DataLoader:
                             "loop (consider more workers or faster storage)",
                             empty_streak,
                         )
+                        if self.events is not None:
+                            self.events.emit(
+                                "loader_starved",
+                                window=empty_streak,
+                                epoch=self._epoch,
+                            )
                 else:
                     empty_streak = 0
                 item = q.get()

@@ -72,6 +72,7 @@ def _build() -> bool:
             )
         os.replace(tmp_path, _SO)
         return True
+    # ddplint: allow[broad-except] — any build failure degrades to NumPy
     except Exception as e:
         # Every failure mode logs (make error, timeout, missing make,
         # rename failure) — native degrades to NumPy, never silently.

@@ -48,6 +48,7 @@ def profile_trace(log_dir: str | None, *, sync: object = None):
     finally:
         target = sync() if callable(sync) else sync
         if target is not None:
+            # ddplint: allow[host-sync] — trace must drain before stop_trace
             jax.block_until_ready(target)
         jax.profiler.stop_trace()
 
@@ -109,6 +110,7 @@ class ProfilerOrchestrator:
         os.makedirs(self.log_dir, exist_ok=True)
         try:
             jax.profiler.start_trace(self.log_dir)
+        # ddplint: allow[broad-except] — profiling is advisory, never fatal
         except Exception as exc:  # another trace active, backend refusal
             self._warn("profiler start failed (%s): %s", reason, exc)
             return
@@ -125,8 +127,10 @@ class ProfilerOrchestrator:
 
         try:
             if sync is not None:
+                # ddplint: allow[host-sync] — trace window must cover the step
                 jax.block_until_ready(sync)
             jax.profiler.stop_trace()
+        # ddplint: allow[broad-except] — profiling is advisory, never fatal
         except Exception as exc:
             self._warn("profiler stop failed: %s", exc)
         self.active = False

@@ -61,6 +61,8 @@ class Gauge:
         if self._fn is not None:
             try:
                 return self._fn()
+            # ddplint: allow[broad-except] — user gauge callback; a broken
+            # gauge must read None, not kill the metrics scrape
             except Exception:
                 return None
         return self.value

@@ -337,6 +337,7 @@ def ep_memory_evidence(
                 if any(ax is not None for ax in sp)
             )
             out["expert_leaf_sharding"] = str(ex)
+        # ddplint: allow[broad-except] — best-effort diagnostics field only
         except Exception:
             pass
         return out

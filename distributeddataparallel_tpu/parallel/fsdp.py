@@ -666,6 +666,27 @@ def make_fsdp_train_step(
         return compiled(state, batch, rng)
 
     step.jitted = None
+
+    # Expected-collective manifest for the graph linter: FSDP's step is
+    # all_gather(params) + reduce_scatter(grads) over the data axis
+    # (plus activation psums over the TP axis when two-level).  The f32
+    # master flats make f32 reduction the design, not a promotion bug.
+    from distributeddataparallel_tpu.analysis.rules import (
+        collective_manifest,
+    )
+
+    _reduce = {data_axis: {"all_gather": (1, None),
+                           "reduce_scatter": (1, None),
+                           "psum": (0, None)}}
+    if tp_axis is not None:
+        _reduce[tp_axis] = {"psum": (0, None), "all_gather": (0, None),
+                            "reduce_scatter": (0, None)}
+    step.collective_manifest = collective_manifest(
+        "fsdp",
+        grad_reduce=_reduce,
+        donate=donate,
+        allow_f32_reduce=True,
+    )
     return step
 
 

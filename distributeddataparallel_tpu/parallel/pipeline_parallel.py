@@ -1053,4 +1053,36 @@ def make_pp_train_step(
         return compiled(state, batch, rng)
 
     step.jitted = None
+
+    # Expected-collective manifest for the graph linter: activations
+    # flow between stages via ppermute on the pipe axis; gradients
+    # reduce over data (psum, or reduce_scatter/all_gather under ZeRO)
+    # and over pipe for the replicated "rest" params.
+    from distributeddataparallel_tpu.analysis.rules import (
+        collective_manifest,
+    )
+
+    _any = {p: (0, None) for p in ("psum", "reduce_scatter",
+                                   "psum_scatter", "all_gather",
+                                   "ppermute", "all_to_all")}
+    if zero:
+        _data = {"reduce_scatter": (1, None), "all_gather": (1, None),
+                 "psum": (0, None)}
+    elif grad_sync:
+        _data = {"psum": (1, None)}
+    else:
+        _data = {"psum": (0, None)}
+    _reduce = {
+        data_axis: _data,
+        pp_axis: {"ppermute": (1, None), "psum": (0, None)},
+    }
+    for ax in (cfg.cp_axis, cfg.tp_axis, cfg.ep_axis):
+        if ax is not None:
+            _reduce.setdefault(ax, dict(_any))
+    step.collective_manifest = collective_manifest(
+        "pp-zero" if zero else "pp",
+        grad_reduce=_reduce,
+        donate=donate,
+        allow_f32_reduce=True,
+    )
     return step

@@ -52,6 +52,8 @@ def guarded_worker(fn, process_id, *args):
     """
     try:
         fn(process_id, *args)
+    # ddplint: allow[broad-except] — re-raises; only maps one message to a
+    # sentinel exit code
     except Exception as exc:
         if "Multiprocess computations aren't implemented" in str(exc):
             raise SystemExit(MULTIPROCESS_UNSUPPORTED_EXIT) from exc

@@ -114,6 +114,7 @@ class Checkpointer:
         — no array IO."""
         try:
             md = self._mgr.item_metadata(step)
+        # ddplint: allow[broad-except] — orbax raises version-dependent types
         except Exception:  # noqa: BLE001 — unreadable metadata is not
             return False  # this fallback's case; let restore raise it
         if md is None or not hasattr(md, "__contains__"):

@@ -148,6 +148,7 @@ class ResilientCheckpointer(Checkpointer):
                         "ckpt_save", epoch=epoch, attempts=attempt + 1
                     )
                 return
+            # ddplint: allow[broad-except] — retrying IO boundary
             except Exception as e:  # noqa: BLE001 — retrying IO boundary
                 last_err = e
                 if attempt >= self._policy.retries:
@@ -191,6 +192,7 @@ class ResilientCheckpointer(Checkpointer):
 
         try:
             self._mgr.close()
+        # ddplint: allow[broad-except] — closing an already-broken manager
         except Exception:  # noqa: BLE001 — already-broken manager
             pass
         self._mgr = ocp.CheckpointManager(
@@ -216,6 +218,7 @@ class ResilientCheckpointer(Checkpointer):
                 return state, 0
             try:
                 return super().restore_latest(state, template=template)
+            # ddplint: allow[broad-except] — corrupt-ckpt fault boundary
             except Exception as e:  # noqa: BLE001 — fault boundary
                 if self._counters is not None:
                     self._counters.ckpt_fallbacks += 1
@@ -325,6 +328,7 @@ class StepWatchdog:
             return self
         try:
             self._devices = [str(d) for d in jax.devices()]
+        # ddplint: allow[broad-except] — diagnostics only
         except Exception:  # noqa: BLE001 — diagnostics only
             self._devices = ["<device query failed>"]
         with self._lock:

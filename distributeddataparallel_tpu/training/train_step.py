@@ -285,6 +285,51 @@ def make_train_step(
         "nonfinite_guard": nonfinite_guard,
     }
 
+    # Expected-collective manifest for the graph linter
+    # (analysis.graph_lint): which gradient-sized collectives this
+    # configuration is SUPPOSED to lower to, per mesh axis.  Kept next
+    # to aot_signature because they answer the same question at
+    # different layers — "what program did this factory promise?".
+    from distributeddataparallel_tpu.analysis.rules import (
+        collective_manifest,
+    )
+
+    _any_coll = {
+        p: (0, None)
+        for p in ("psum", "reduce_scatter", "psum_scatter", "all_gather",
+                  "ppermute", "all_to_all")
+    }
+    if zero:
+        _reduce = {axis_name: {"reduce_scatter": (1, None),
+                               "all_gather": (1, None),
+                               "psum": (0, None)}}
+    elif not grad_sync:
+        # no_sync analog: gradients stay per-replica; scalar metric
+        # pmeans are uncounted, so just declare the axis with no floor.
+        _reduce = {axis_name: {"psum": (0, None)}}
+    else:
+        _reduce = {axis_name: {"psum": (1, None)}}
+    for ax in (cp_axis, tp_axis, ep_axis):
+        if ax is not None:
+            _reduce.setdefault(ax, dict(_any_coll))
+    # The unbucketed leaf-wise layout is exactly countable: one psum per
+    # param leaf, no more (a second sync is the classic 2x-wire bug).
+    _exact = (
+        grad_sync and not zero and bucket_bytes is None and not overlap
+        and grad_compress is None and not with_model_state
+        and not nonfinite_guard and grad_clip is None
+    )
+    collective_manifest_ = collective_manifest(
+        "zero" if zero else "dp",
+        grad_reduce=_reduce,
+        donate=donate,
+        # coalesced buckets and ZeRO master flats legitimately reduce f32
+        allow_f32_reduce=bool(
+            bucket_bytes or overlap or zero or grad_compress
+        ),
+        per_leaf_axes=(axis_name,) if _exact else (),
+    )
+
     def _micro(params, model_state, mb, rng):
         """One microbatch: returns (loss, aux, new_model_state, grads)."""
         if with_model_state:
@@ -586,6 +631,7 @@ def make_train_step(
         )
         jitted = jax.jit(sharded, **jit_kwargs)
         jitted.aot_signature = aot_signature
+        jitted.collective_manifest = collective_manifest_
         return jitted
 
     # ZeRO / TP / EP: the state's leaves carry per-leaf shardings (ZeRO:
@@ -657,6 +703,7 @@ def make_train_step(
         state, batch, rng
     )
     step.aot_signature = aot_signature
+    step.collective_manifest = collective_manifest_
 
     return step
 

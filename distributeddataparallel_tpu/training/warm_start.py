@@ -122,6 +122,7 @@ class CompileCacheStats:
 
         try:
             monitoring._unregister_event_listener_by_callback(self._cb)
+        # ddplint: allow[broad-except] — already gone / private API drift
         except Exception:  # noqa: BLE001 — already gone / private API drift
             pass
 
@@ -155,6 +156,7 @@ def runtime_versions() -> dict:
         from importlib import metadata
 
         versions["libtpu"] = metadata.version("libtpu")
+    # ddplint: allow[broad-except] — absent/odd libtpu metadata is a value
     except Exception:  # noqa: BLE001
         versions["libtpu"] = None
     return versions
@@ -316,6 +318,7 @@ class ExecutableStore:
             return serialize_executable.deserialize_and_load(
                 payload, in_tree, out_tree
             )
+        # ddplint: allow[broad-except] — any load fault falls back to JIT
         except Exception as exc:  # noqa: BLE001 — any load fault → JIT
             msg = (
                 f"AOT executable '{name}' failed to load "
@@ -374,6 +377,7 @@ def warm_train_step(
             loaded = store.load(
                 name, key, example_args=args, state=state
             )
+        # ddplint: allow[broad-except] — store-level surprises → JIT
         except Exception as exc:  # noqa: BLE001 — strict=False already
             # guards; this catches store-level surprises (bad perms, ...)
             log.warning(
@@ -399,6 +403,7 @@ def warm_train_step(
             t0 = time.perf_counter()
             compiled = step_fn.lower(*args).compile()
             compile_s = time.perf_counter() - t0
+        # ddplint: allow[broad-except] — compile failure → plain JIT
         except Exception as exc:  # noqa: BLE001
             stats.close()
             log.warning(
@@ -424,6 +429,7 @@ def warm_train_step(
                     name, key, compiled,
                     metric_keys=_metric_keys_of(compiled),
                 )
+        # ddplint: allow[broad-except] — saving is best-effort
         except Exception as exc:  # noqa: BLE001 — saving is best-effort
             log.warning(
                 "AOT store save failed (%s: %s) — next start will "

@@ -293,6 +293,11 @@ def parse_args(argv=None):
     p.add_argument("--bw-probe", action="store_true",
                    help="measure grad all-reduce bandwidth utilization "
                         "over the data axis before training")
+    p.add_argument("--lint-step", action="store_true",
+                   help="graph-lint the selected train step "
+                        "(analysis.graph_lint) on the first batch and "
+                        "abort on violations — trace-only, so it fails "
+                        "fast BEFORE the first XLA compile")
     p.add_argument("--coordinator", default=None,
                    help="host:port for multi-process rendezvous")
     p.add_argument("--num-processes", type=int, default=None)
@@ -883,6 +888,10 @@ def train(args) -> float:
         shuffle=True, seed=args.seed, place_fn=place_fn,
         workers=args.workers, augment=augment,
     )
+    # Structured starvation events land in the same per-worker log as
+    # everything else (events is None without --events-dir — the loader
+    # then only warns).
+    loader.events = events
 
     lm = is_lm(args)
     num_classes = getattr(dataset, "num_classes", None)
@@ -1141,6 +1150,10 @@ def train(args) -> float:
             ),
             nonfinite_guard=args.nan_guard,
         )
+
+    # Graph lint wants the RAW factory step: the warm-start wrapper below
+    # may swap in a deserialized AOT executable, which cannot be traced.
+    lint_target = step_fn if args.lint_step else None
 
     warm_report = {}
     if args.compile_cache:
@@ -1567,6 +1580,7 @@ def train(args) -> float:
                 last = diag.get("last_known_state") or {}
                 ckpt.save(state, int(last.get("epoch", start_epoch)),
                           meta=ckpt_meta)
+            # ddplint: allow[broad-except] — the process is exiting
             except Exception:  # noqa: BLE001 — the process is exiting
                 warn_all("watchdog: emergency checkpoint failed")
         watchdog = StepWatchdog(args.step_timeout, on_timeout=_on_wedge)
@@ -1605,6 +1619,30 @@ def train(args) -> float:
                     injector.before_step(gstep)   # slow-step / preempt
                     batch = injector.corrupt_batch(batch, gstep)
                     sub = jax.random.fold_in(epoch_rng, batch_idx)
+                    if lint_target is not None:
+                        # First batch: everything the step consumes is
+                        # now concrete, and nothing is compiled yet —
+                        # trace-only lint fails fast before the compile.
+                        from distributeddataparallel_tpu.analysis import (
+                            graph_lint,
+                        )
+
+                        rep = graph_lint.lint_train_step(
+                            lint_target, state, batch, sub
+                        )
+                        lint_target = None
+                        if rep.findings:
+                            raise SystemExit(
+                                "--lint-step: train step violates its "
+                                "collective manifest:\n" + "\n".join(
+                                    str(f) for f in rep.findings
+                                )
+                            )
+                        log0(
+                            "lint-step [%s] clean: collective fp=%s %s",
+                            rep.mode, rep.fingerprint,
+                            rep.collective_counts,
+                        )
                     # The step span times host-side dispatch (plus any
                     # window-overflow settles) — the honest per-step
                     # number for an async loop; device wall time lands
@@ -1723,6 +1761,7 @@ def train(args) -> float:
         # (--max-restarts) resumes from the last durable epoch.
         warn_all("%s", pe)
         raise SystemExit(1) from pe
+    # ddplint: allow[broad-except] — re-raises after releasing the group
     except BaseException:
         # Divergence (nan-guard breaker) or any other abort must not
         # strand the process group: the next train() in this process —
@@ -1739,6 +1778,7 @@ def train(args) -> float:
             # Final snapshot always lands, whatever the exit path.
             try:
                 registry.export(final=True)
+            # ddplint: allow[broad-except] — telemetry must not mask exit
             except Exception:  # noqa: BLE001 — telemetry must not mask
                 pass
         if events is not None:

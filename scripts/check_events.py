@@ -5,11 +5,21 @@ Usage:
     python scripts/check_events.py EVENTS.jsonl [MORE.jsonl ...]
     python scripts/check_events.py --expect-order k1,k2,k3 timeline.jsonl
 
+Usage (static, no JSONL files — cross-check emitters vs the registry):
+    python scripts/check_events.py --schema-sync
+
 Exit 0 when every record in every file is schema-valid (and, with
 ``--expect-order``, the listed kinds appear in that relative order);
 exit 1 otherwise, printing each problem.  Used by tests/test_observability
 and by the README smoke step; importable (``main(argv)``) so tests can
 call it in-process.
+
+``--schema-sync`` needs no event files: it scans the source tree with
+the ddplint AST layer (``analysis.ast_rules.collect_emitted_kinds``)
+and fails on drift between ``EventLog.emit(kind=...)`` literals and
+``observability.schema.EVENT_KINDS`` — in BOTH directions: an emitted
+kind missing from the registry (consumers would reject the record) and
+a registered kind nothing emits (dead schema that silently rots).
 
 Import-light on purpose: pulls in only the observability schema (stdlib),
 never jax — it must run anywhere, including a bare CI box.
@@ -24,6 +34,7 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from distributeddataparallel_tpu.observability.schema import (  # noqa: E402
+    EVENT_KINDS,
     validate_file,
 )
 
@@ -54,9 +65,35 @@ def check_order(path: str, kinds: list[str]) -> list[str]:
     return []
 
 
+def check_schema_sync(root: str | None = None) -> list[str]:
+    """Two-way diff of statically-emitted kinds vs EVENT_KINDS."""
+    from distributeddataparallel_tpu.analysis.ast_rules import (
+        collect_emitted_kinds,
+    )
+
+    root = root or os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))
+    )
+    emitted = collect_emitted_kinds(root)
+    problems = []
+    for kind in sorted(set(emitted) - set(EVENT_KINDS)):
+        problems.append(
+            f"schema-sync: kind {kind!r} emitted at "
+            f"{', '.join(emitted[kind])} but not registered in "
+            "observability.schema.EVENT_KINDS"
+        )
+    for kind in sorted(set(EVENT_KINDS) - set(emitted)):
+        problems.append(
+            f"schema-sync: kind {kind!r} registered in EVENT_KINDS but "
+            "no emit site in the tree — dead schema (remove it or emit "
+            "it)"
+        )
+    return problems
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("files", nargs="+", help="events JSONL file(s)")
+    ap.add_argument("files", nargs="*", help="events JSONL file(s)")
     ap.add_argument(
         "--expect-order",
         default=None,
@@ -64,9 +101,19 @@ def main(argv: list[str] | None = None) -> int:
         help="comma-separated event kinds that must appear in this "
         "relative order in each file",
     )
+    ap.add_argument(
+        "--schema-sync",
+        action="store_true",
+        help="statically cross-check EventLog.emit kinds against "
+        "EVENT_KINDS (both directions); needs no event files",
+    )
     args = ap.parse_args(argv)
+    if not args.files and not args.schema_sync:
+        ap.error("provide events JSONL file(s) and/or --schema-sync")
 
     problems = []
+    if args.schema_sync:
+        problems.extend(check_schema_sync())
     for path in args.files:
         if not os.path.exists(path):
             problems.append(f"{path}: no such file")
@@ -79,8 +126,15 @@ def main(argv: list[str] | None = None) -> int:
     for p in problems:
         print(p, file=sys.stderr)
     if not problems:
-        n = len(args.files)
-        print(f"check_events: {n} file(s) OK")
+        parts = []
+        if args.files:
+            parts.append(f"{len(args.files)} file(s) OK")
+        if args.schema_sync:
+            parts.append(
+                f"schema-sync OK ({len(EVENT_KINDS)} kinds, "
+                "emitters and registry agree)"
+            )
+        print("check_events: " + "; ".join(parts))
     return 1 if problems else 0
 
 

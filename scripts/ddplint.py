@@ -1,0 +1,282 @@
+#!/usr/bin/env python
+"""ddplint — static SPMD-invariant checker for the DDP reproduction.
+
+Two layers (rule table: ``--list-rules``; registry in
+``distributeddataparallel_tpu/analysis/rules.py``):
+
+  --ast     AST rules over the package source, dpp.py, and scripts/.
+            Stdlib-only: runs in any interpreter, no jax import.
+  --graph   Graph rules over the *traced/lowered* train steps of the
+            repo's own factories, exercised on tiny CPU-sized configs.
+            Traces and lowers but never compiles, so it is fast and
+            CPU-safe (forces JAX_PLATFORMS=cpu + 8 host devices).
+
+With neither flag, both layers run.  ``--changed-only`` narrows the AST
+layer to files in ``git diff --name-only HEAD`` and skips the graph
+layer unless step-defining code changed — the fast local pre-push mode.
+
+Exit status: 0 clean, 1 findings, 2 operational error.
+
+Examples:
+    python scripts/ddplint.py --graph --ast       # what CI runs
+    python scripts/ddplint.py --ast --changed-only
+    python scripts/ddplint.py --graph --modes all # adds fsdp + pp
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(ROOT))
+
+#: a graph-layer run is warranted when any of these changed
+_GRAPH_TRIGGERS = (
+    "distributeddataparallel_tpu/analysis/",
+    "distributeddataparallel_tpu/parallel/",
+    "distributeddataparallel_tpu/training/",
+    "distributeddataparallel_tpu/ops/",
+    "dpp.py",
+)
+
+#: graph-lint driver modes; "all" expands to every key
+DEFAULT_MODES = ("dp", "zero", "bucket", "bf16")
+ALL_MODES = ("dp", "zero", "bucket", "bf16", "fsdp", "pp")
+
+
+def _ensure_cpu() -> None:
+    """Make tracing CPU-safe with a multi-device mesh BEFORE jax loads.
+
+    Must run before the first jax import: device count is fixed at
+    backend init (jax 0.4.x has no jax_num_cpu_devices config), so if
+    jax is already in, we trust the host process set things up.
+    """
+    if "jax" in sys.modules:
+        return
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+
+
+def _changed_files() -> list[str]:
+    out = subprocess.run(
+        ["git", "diff", "--name-only", "HEAD"],
+        cwd=ROOT, capture_output=True, text=True, check=True,
+    ).stdout
+    return [l.strip() for l in out.splitlines() if l.strip()]
+
+
+def run_ast(changed_only: bool) -> list:
+    from distributeddataparallel_tpu.analysis import ast_rules
+
+    targets = ast_rules.default_targets(ROOT)
+    if changed_only:
+        changed = set(_changed_files())
+        targets = [
+            t for t in targets
+            if t.relative_to(ROOT).as_posix() in changed
+        ]
+        if not targets:
+            return []
+    return ast_rules.lint_paths(targets, ROOT)
+
+
+def _graph_cases(modes):
+    """Yield (mode, step, state, batch, rng) on tiny configs — small
+    enough that every trace is sub-second on CPU."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    import distributeddataparallel_tpu as ddp
+    from distributeddataparallel_tpu.data.loader import shard_batch
+    from distributeddataparallel_tpu.models.simple_cnn import TinyMLP
+    from distributeddataparallel_tpu.ops.losses import cross_entropy_loss
+    from distributeddataparallel_tpu.training.train_step import (
+        make_train_step,
+    )
+
+    rng = jax.random.PRNGKey(0)
+    mesh = ddp.make_mesh(("data",))
+    model = TinyMLP(features=(32,), num_classes=10)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8)))["params"]
+
+    def loss_fn(params, batch, _rng):
+        logits = model.apply({"params": params}, batch["image"])
+        return cross_entropy_loss(logits, batch["label"]), {}
+
+    def mlp_state(p):
+        return ddp.TrainState.create(
+            apply_fn=model.apply, params=p, tx=optax.sgd(0.1)
+        )
+
+    batch = {
+        "image": jnp.zeros((8, 8)),
+        "label": jnp.zeros((8,), jnp.int32),
+    }
+    factory_kw = {
+        "dp": {},
+        "zero": {"zero": True},
+        "bucket": {"bucket_bytes": 1 << 20},
+    }
+    for mode in ("dp", "zero", "bucket"):
+        if mode in modes:
+            step = make_train_step(loss_fn, mesh=mesh, **factory_kw[mode])
+            yield mode, step, mlp_state(params), batch, rng
+    if "bf16" in modes:
+        bf16 = jax.tree.map(
+            lambda x: x.astype(jnp.bfloat16), params
+        )
+        step = make_train_step(loss_fn, mesh=mesh)
+        yield "bf16", step, mlp_state(bf16), batch, rng
+
+    if not ({"fsdp", "pp"} & set(modes)):
+        return
+    from distributeddataparallel_tpu.models import TransformerLM, tiny_lm
+
+    nprng = np.random.default_rng(0)
+    if "fsdp" in modes:
+        from distributeddataparallel_tpu.parallel.fsdp import (
+            fsdp_state,
+            make_fsdp_train_step,
+        )
+
+        cfg = tiny_lm(
+            num_layers=2, num_heads=2, d_model=32, d_ff=64,
+            max_seq_len=32, scan_layers=True,
+        )
+        p = TransformerLM(cfg).init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 16), jnp.int32)
+        )["params"]
+        st = fsdp_state(cfg, p, optax.adam(1e-2), mesh)
+        b = shard_batch(
+            {"tokens": nprng.integers(
+                0, 256, size=(8, 17)).astype(np.int32)},
+            mesh,
+        )
+        yield "fsdp", make_fsdp_train_step(cfg, mesh=mesh), st, b, rng
+    if "pp" in modes:
+        from distributeddataparallel_tpu.parallel import (
+            make_pp_train_step,
+            shard_state_pp,
+        )
+
+        mesh2 = ddp.make_mesh(("data", "pipe"), shape=(2, 4))
+        cfg = tiny_lm(
+            num_layers=4, num_heads=2, d_model=32, d_ff=64,
+            max_seq_len=32, scan_layers=True,
+        )
+        p = TransformerLM(cfg).init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 32), jnp.int32)
+        )["params"]
+        st = shard_state_pp(
+            ddp.TrainState.create(
+                apply_fn=None, params=p, tx=optax.adam(1e-2)
+            ),
+            mesh2,
+        )
+        b = shard_batch(
+            {"tokens": nprng.integers(
+                0, 256, size=(8, 33)).astype(np.int32)},
+            mesh2,
+        )
+        step = make_pp_train_step(cfg, mesh=mesh2, microbatches=2)
+        yield "pp", step, st, b, rng
+
+
+def run_graph(modes, *, verbose: bool = True) -> list:
+    _ensure_cpu()
+    from distributeddataparallel_tpu.analysis.graph_lint import (
+        lint_train_step,
+    )
+
+    findings = []
+    for mode, step, state, batch, rng in _graph_cases(modes):
+        rep = lint_train_step(step, state, batch, rng, mode=mode)
+        findings += rep.findings
+        if verbose:
+            counts = " ".join(
+                f"{k}={v}" for k, v in sorted(rep.collective_counts.items())
+            )
+            donate = (
+                f" donated={rep.donated_args}/{rep.donation_expected}"
+                if rep.donated_args is not None else ""
+            )
+            status = "ok" if rep.ok else f"{len(rep.findings)} finding(s)"
+            print(
+                f"ddplint graph [{mode}] {status} "
+                f"fp={rep.fingerprint} {counts}{donate}"
+            )
+    return findings
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="ddplint",
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument("--ast", action="store_true",
+                    help="run the AST layer (AL1xx rules)")
+    ap.add_argument("--graph", action="store_true",
+                    help="run the graph layer (GL0xx rules)")
+    ap.add_argument("--changed-only", action="store_true",
+                    help="lint only files changed vs HEAD; skip the "
+                         "graph layer unless step code changed")
+    ap.add_argument("--modes", default=",".join(DEFAULT_MODES),
+                    help="graph-lint configurations, comma-separated "
+                         f"(default: %(default)s; 'all' = "
+                         f"{','.join(ALL_MODES)})")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule table and exit")
+    args = ap.parse_args(argv)
+
+    from distributeddataparallel_tpu.analysis.rules import (
+        format_findings,
+        rule_table,
+    )
+
+    if args.list_rules:
+        print(rule_table())
+        return 0
+
+    do_ast = args.ast or not args.graph
+    do_graph = args.graph or not args.ast
+    modes = ALL_MODES if args.modes == "all" else tuple(
+        m.strip() for m in args.modes.split(",") if m.strip()
+    )
+    unknown = set(modes) - set(ALL_MODES)
+    if unknown:
+        ap.error(f"unknown --modes {sorted(unknown)}; pick from "
+                 f"{','.join(ALL_MODES)} or 'all'")
+
+    findings = []
+    if do_ast:
+        findings += run_ast(args.changed_only)
+    if do_graph:
+        if args.changed_only and not any(
+            c.startswith(_GRAPH_TRIGGERS) for c in _changed_files()
+        ):
+            print("ddplint graph: skipped (no step-defining changes)")
+        else:
+            findings += run_graph(modes)
+
+    if findings:
+        print(format_findings(findings), file=sys.stderr)
+        print(f"ddplint: {len(findings)} finding(s)", file=sys.stderr)
+        return 1
+    print("ddplint: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    _ensure_cpu()
+    sys.exit(main())
