@@ -1320,7 +1320,9 @@ def _observability_child(out_path, events_dir, env):
 
     - step_s_off / step_s_on: the SAME compiled GPT-2 124M step timed
       with observability disabled, then wired exactly as dpp.py wires it
-      (per-step span, profiler hooks, --metrics-every export cadence);
+      (per-step span, profiler hooks, steps_total counter,
+      --metrics-every export cadence, and the PR 5 attribution layer:
+      MFU meter + memory sampling at the window boundary);
     - syncs_off / syncs_on: jax.block_until_ready call counts in each
       loop — the telemetry-on loop must add ZERO;
     - telemetry_us_per_step: the per-step telemetry work microbenchmarked
@@ -1340,10 +1342,14 @@ def _observability_child(out_path, events_dir, env):
     from distributeddataparallel_tpu.observability import (
         EventLog,
         JsonlExporter,
+        MemoryTelemetry,
         MetricsRegistry,
+        MFUMeter,
         ProfilerOrchestrator,
         Tracer,
         events_path,
+        train_step_flops,
+        transformer_fwd_flops,
         validate_file,
     )
     from distributeddataparallel_tpu.training.train_step import (
@@ -1374,7 +1380,8 @@ def _observability_child(out_path, events_dir, env):
         # ~1 min, so the loop length is the child's time budget.
         ITERS = 2
 
-        def loop(tracer=None, prof=None, registry=None, metrics_every=100):
+        def loop(tracer=None, prof=None, registry=None, metrics_every=100,
+                 steps_total=None, mfu_meter=None, mem_tel=None):
             syncs["n"] = 0
             s = state
             t0 = time.perf_counter()
@@ -1388,10 +1395,21 @@ def _observability_child(out_path, events_dir, env):
                     s, _ = step(s, batch, key)
                 if prof is not None:
                     prof.on_step_end(i)
+                if steps_total is not None:
+                    steps_total.inc()
                 if registry is not None and i % metrics_every == 0:
                     registry.export(step=i)
             jax.block_until_ready(s.params)  # the one boundary drain
-            return (time.perf_counter() - t0) / ITERS, syncs["n"]
+            dt = (time.perf_counter() - t0) / ITERS
+            # The PR 5 attribution work runs exactly where dpp.py runs
+            # it: AT the boundary where the loop already drained.  Kept
+            # inside the counted region so syncs_on would expose any
+            # device round-trip the meters sneaked in.
+            if mfu_meter is not None:
+                mfu_meter.on_reading({"steps_per_s": 1.0 / dt}, step=ITERS)
+            if mem_tel is not None:
+                mem_tel.sample(ITERS)
+            return dt, syncs["n"]
 
         step_s_off, syncs_off = loop()
 
@@ -1402,10 +1420,32 @@ def _observability_child(out_path, events_dir, env):
         registry.bind("faults", lambda: {"nonfinite_steps": 0})
         tracer = Tracer(events, registry)
         prof = ProfilerOrchestrator(None, events=events)  # disabled dir
-        step_s_on, syncs_on = loop(tracer, prof, registry)
+        steps_total = registry.counter("steps_total")
+        # Same cost model dpp.py --mfu builds: the fixture IS gpt2_124m
+        # at per-chip batch 2, seq 64 (loss applies tokens[:, :-1]).
+        from distributeddataparallel_tpu.models import gpt2_124m
+
+        cfg = gpt2_124m(max_seq_len=64)
+        fwd = transformer_fwd_flops(
+            cfg, batch=2 * len(jax.devices()), seq_len=63
+        )
+        mfu_meter = MFUMeter(
+            train_step_flops(fwd, remat=getattr(cfg, "remat", False)),
+            n_chips=len(jax.devices()),
+            peak_flops_per_chip=None,  # virtual CPU mesh: FLOP/s only
+            registry=registry,
+            events=events,
+        )
+        mem_tel = MemoryTelemetry(registry, events, jax.local_devices())
+        step_s_on, syncs_on = loop(
+            tracer, prof, registry,
+            steps_total=steps_total, mfu_meter=mfu_meter, mem_tel=mem_tel,
+        )
         events.emit("run_end", status="ok")
 
-        # Micro: the per-step telemetry work alone, at default cadence.
+        # Micro: the per-step telemetry work alone, at default cadence —
+        # including the PR 5 boundary work (MFU arithmetic + live-array
+        # walk) at a window-ish cadence of 100.
         REPS = 2000
         t0 = time.perf_counter()
         for i in range(REPS):
@@ -1413,8 +1453,11 @@ def _observability_child(out_path, events_dir, env):
             with tracer.span("step", step=i):
                 pass
             prof.on_step_end(i)
+            steps_total.inc()
             if i % 100 == 0:
                 registry.export(step=i)
+                mfu_meter.on_reading({"steps_per_s": 1.0}, step=i)
+                mem_tel.sample(i)
         telemetry_us = (time.perf_counter() - t0) / REPS * 1e6
         events.close()
     finally:
@@ -1438,10 +1481,12 @@ def _observability_child(out_path, events_dir, env):
 
 
 def bench_observability() -> dict:
-    """Observability subsystem (PR 3) done bar: with --events-dir wired
-    at default cadence, step throughput on the 8-device CPU mesh (GPT-2
-    124M) stays within 2% of telemetry-off, with zero extra host syncs
-    and a schema-valid event file."""
+    """Observability done bar (PR 3 harness, extended with the PR 5
+    attribution layer): with --events-dir, the steps_total counter, the
+    MFU meter and memory sampling all wired at default cadence, step
+    throughput on the 8-device CPU mesh (GPT-2 124M) stays within 2% of
+    telemetry-off, with zero extra host syncs and a schema-valid event
+    file."""
     import json as _json
     import multiprocessing as mp
     import os

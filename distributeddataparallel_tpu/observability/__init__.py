@@ -14,7 +14,18 @@ injector, the launcher supervisor, and ``scripts/check_events.py`` all
 import from this package in contexts where jax must not load.
 """
 
+from .cost_model import (
+    MFUMeter,
+    mlp_fwd_flops,
+    peak_flops_for,
+    simple_cnn_fwd_flops,
+    train_step_flops,
+    transformer_fwd_flops,
+    xla_cost_analysis,
+)
 from .events import EventLog, events_path, merge_timeline, read_events
+from .goodput import GoodputLedger, goodput_from_timeline
+from .memory import MemoryTelemetry, live_array_bytes
 from .profiler import ProfilerOrchestrator, parse_profile_steps, profile_trace
 from .registry import (
     Counter,
@@ -32,6 +43,7 @@ from .schema import (
     validate_file,
     validate_record,
 )
+from .straggler import straggler_report
 from .trace import Tracer
 
 __all__ = [
@@ -41,18 +53,30 @@ __all__ = [
     "Counter",
     "EventLog",
     "Gauge",
+    "GoodputLedger",
     "Histogram",
     "JsonlExporter",
+    "MFUMeter",
+    "MemoryTelemetry",
     "MetricsRegistry",
     "ProfilerOrchestrator",
     "TextExporter",
     "Tracer",
     "events_path",
+    "goodput_from_timeline",
     "json_safe",
+    "live_array_bytes",
     "merge_timeline",
+    "mlp_fwd_flops",
     "parse_profile_steps",
+    "peak_flops_for",
     "profile_trace",
     "read_events",
+    "simple_cnn_fwd_flops",
+    "straggler_report",
+    "train_step_flops",
+    "transformer_fwd_flops",
     "validate_file",
     "validate_record",
+    "xla_cost_analysis",
 ]

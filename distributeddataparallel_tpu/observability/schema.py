@@ -48,6 +48,11 @@ EVENT_KINDS: dict[str, tuple[str, ...]] = {
     "profile_start": ("reason",),
     "profile_stop": (),
     "loader_starved": ("window",),
+    # Performance-attribution layer (cost_model / memory / goodput):
+    "mfu": ("step", "model_flops_per_s"),
+    "memory": ("step", "live_bytes"),
+    "exec_memory": ("label",),
+    "goodput": ("total_s", "goodput", "buckets"),
 }
 
 

@@ -427,7 +427,9 @@ def cycles_by_scope(
     the call sites in entry AND inside while/cond bodies), skipping
     fusion-body internals by only counting lines that carry
     ``estimated_cycles``.  A measured decomposition of where the
-    compiler thinks the time goes — the MFU-gap attribution tool.
+    compiler thinks the time goes — the per-op half of MFU-gap
+    attribution (``observability.cost_model`` supplies the other half:
+    the analytic FLOP numerator the gap is measured against).
     """
     compiled = {k: re.compile(v, re.IGNORECASE) for k, v in buckets.items()}
     out = {k: 0 for k in buckets}

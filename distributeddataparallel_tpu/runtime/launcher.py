@@ -221,7 +221,22 @@ def spawn(
                     merge_timeline,
                 )
 
-                merge_timeline(events_dir)
+                # Best-effort: the merge runs while a restart-exhausted
+                # RuntimeError may be propagating, and a merge failure
+                # (unwritable dir, disk full, a gang that died before
+                # any worker wrote its file) must not mask it.
+                try:
+                    if merge_timeline(events_dir) is None:
+                        get_logger().warning(
+                            "[supervisor] no event files to merge in %s "
+                            "(gang died before writing any?)",
+                            events_dir,
+                        )
+                except OSError as exc:
+                    get_logger().warning(
+                        "[supervisor] timeline merge failed in %s: %s",
+                        events_dir, exc,
+                    )
 
     if nprocs == 1:
         fn(0, *args)

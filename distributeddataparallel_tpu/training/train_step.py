@@ -285,6 +285,19 @@ def make_train_step(
         "nonfinite_guard": nonfinite_guard,
     }
 
+    # FLOP-accounting handoff for the MFU meter (observability.cost_model).
+    # The one fact only this factory knows: accumulation SPLITS the batch
+    # into accum_steps microbatches of B/accum_steps — it does not repeat
+    # it — so per-step FLOPs equal one full-batch pass regardless of the
+    # accumulation degree.  Recording it here means a meter wired to this
+    # step cannot double-count microbatches.
+    flop_signature = {
+        "train_flop_multiplier": 3,  # fwd + ~2x bwd (PaLM appendix B)
+        "accum_steps": accum_steps,
+        "microbatch_fraction": 1.0 / accum_steps,
+        "loss_evals_per_step": accum_steps,
+    }
+
     # Expected-collective manifest for the graph linter
     # (analysis.graph_lint): which gradient-sized collectives this
     # configuration is SUPPOSED to lower to, per mesh axis.  Kept next
@@ -631,6 +644,7 @@ def make_train_step(
         )
         jitted = jax.jit(sharded, **jit_kwargs)
         jitted.aot_signature = aot_signature
+        jitted.flop_signature = flop_signature
         jitted.collective_manifest = collective_manifest_
         return jitted
 
@@ -703,6 +717,7 @@ def make_train_step(
         state, batch, rng
     )
     step.aot_signature = aot_signature
+    step.flop_signature = flop_signature
     step.collective_manifest = collective_manifest_
 
     return step

@@ -27,6 +27,11 @@ from distributeddataparallel_tpu.observability.profiler import (  # noqa: F401
 from distributeddataparallel_tpu.observability.schema import json_safe
 
 
+# Readings no longer have a warmup state (the compile step is accounted
+# separately), but the key survives for JSONL schema compatibility.
+_WARMUP_COMPAT = False
+
+
 class StepTimer:
     """Windowed throughput meter: items/s and items/s/chip.
 
@@ -38,8 +43,13 @@ class StepTimer:
     wall time as ``compile_s``, and excludes that step from every
     throughput window instead of letting it poison the first reading.
     ``compile_s`` is emitted once, in the first reading after it is
-    known; readings keep a ``warmup`` key (now always False once the
-    compile step is split out) for backward compatibility.
+    known.
+
+    Historical note: readings used to flag their first window as
+    ``warmup`` and every consumer had to branch on it; splitting the
+    compile step out made the flag constant-False and the branches dead,
+    so they are gone.  The key itself stays (see ``_WARMUP_COMPAT``) so
+    existing JSONL consumers keyed on it don't break.
     """
 
     def __init__(self, window: int = 50, n_chips: int | None = None):
@@ -90,7 +100,7 @@ class StepTimer:
             "items_per_s_per_chip": self._items / dt / self.n_chips,
             "steps_per_s": self._steps / dt,
             "window_s": dt,
-            "warmup": False,
+            "warmup": _WARMUP_COMPAT,
         }
         if self.compile_s is not None and not self._compile_emitted:
             reading["compile_s"] = round(self.compile_s, 3)

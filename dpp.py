@@ -23,6 +23,7 @@ from __future__ import annotations
 import argparse
 import os
 import sys
+import time
 
 
 def _dataset_arg(v: str) -> str:
@@ -282,6 +283,18 @@ def parse_args(argv=None):
                         "steps into the event log (host-only work: no "
                         "device sync).  0 disables periodic export; "
                         "end-of-run export always happens")
+    p.add_argument("--mfu", action="store_true",
+                   help="report MFU/HFU per throughput window from the "
+                        "analytic cost model (observability.cost_model): "
+                        "model FLOPs/s over the chips' peak.  Computed at "
+                        "window boundaries only — zero per-step cost.  "
+                        "Supported for cnn/mlp and the LM models")
+    p.add_argument("--memory-telemetry", action="store_true",
+                   help="sample device/live-array memory at throughput-"
+                        "window boundaries (observability.memory) and "
+                        "record the train step's compiler memory budget "
+                        "once after the first step (costs one extra AOT "
+                        "compile of the step program)")
     p.add_argument("--profile-steps", default=None, metavar="A:B",
                    help="capture a jax.profiler trace covering global "
                         "steps [A, B) — a windowed alternative to "
@@ -317,6 +330,12 @@ def parse_args(argv=None):
     if args.dispatch_depth < 0:
         raise SystemExit(
             f"--dispatch-depth must be >= 0, got {args.dispatch_depth}"
+        )
+    if args.mfu and args.model in ("resnet18", "resnet50"):
+        raise SystemExit(
+            "--mfu: no analytic cost model for resnet yet (supported: "
+            "cnn, mlp, gpt2, llama) — a wrong FLOP count would report a "
+            "confidently wrong MFU"
         )
     if args.profile_steps is not None:
         from distributeddataparallel_tpu.observability import (
@@ -1497,6 +1516,82 @@ def train(args) -> float:
         items_per_step, unit = args.batch_size * n_replicas, "img"
     timer = StepTimer(window=max(20, args.log_every))
 
+    # Performance attribution (observability.{cost_model,memory,goodput}):
+    # MFU/HFU from the analytic FLOP model, memory sampling, and a
+    # wall-clock goodput ledger.  Everything below is constructed once
+    # here and consulted only at window boundaries / run edges — the hot
+    # path never sees it.
+    mfu_meter = mem_tel = goodput = None
+    if events is not None:
+        from distributeddataparallel_tpu.observability import GoodputLedger
+
+        goodput = GoodputLedger()
+    if args.mfu:
+        from distributeddataparallel_tpu.observability import (
+            MFUMeter,
+            mlp_fwd_flops,
+            peak_flops_for,
+            simple_cnn_fwd_flops,
+            train_step_flops,
+            transformer_fwd_flops,
+        )
+
+        gbatch = args.batch_size * n_replicas
+        remat = False
+        if lm:
+            # The LM step trains on the shifted sequence: seq_len-1
+            # positions do forward/backward work.
+            fwd = transformer_fwd_flops(
+                model.cfg, batch=gbatch, seq_len=args.seq_len - 1
+            )
+            remat = bool(getattr(model.cfg, "remat", False))
+        else:
+            shape = tuple(
+                getattr(dataset, "image_shape", None)
+                or dataset.images.shape[1:]
+            )
+            if args.model == "cnn":
+                fwd = simple_cnn_fwd_flops(
+                    batch=gbatch, image_shape=shape,
+                    num_classes=num_classes or 10,
+                )
+            else:  # mlp (resnet rejected in parse_args)
+                in_features = 1
+                for d in shape:
+                    in_features *= int(d)
+                fwd = mlp_fwd_flops(
+                    batch=gbatch, in_features=in_features,
+                    num_classes=num_classes or 10,
+                )
+        step_flops = train_step_flops(
+            fwd, remat=remat,
+            flop_signature=getattr(step_fn, "flop_signature", None),
+        )
+        peak = peak_flops_for(jax.devices()[0])
+        mfu_meter = MFUMeter(
+            step_flops,
+            n_chips=ddp.global_device_count(),
+            peak_flops_per_chip=peak,
+            registry=registry,
+            events=events,
+        )
+        log0(
+            "mfu: %.3e model FLOPs/step (%.3e hw) over %d chip(s), "
+            "peak %s FLOP/s/chip",
+            step_flops["model_flops"], step_flops["hardware_flops"],
+            ddp.global_device_count(),
+            f"{peak:.2e}" if peak else "unknown",
+        )
+    if args.memory_telemetry:
+        from distributeddataparallel_tpu.observability import MemoryTelemetry
+
+        mem_tel = MemoryTelemetry(
+            registry=registry, events=events, devices=jax.local_devices()
+        )
+    steps_total = (
+        registry.counter("steps_total") if registry is not None else None
+    )
+
     # Bounded async dispatch (training.warm_start.BoundedDispatch): the
     # loop no longer blocks the host every step — up to --dispatch-depth
     # steps stay in flight, and each step's guard handle (the nan flag
@@ -1660,6 +1755,8 @@ def train(args) -> float:
                         )
                         for h, w in dispatch.push(guard, (epoch, batch_idx)):
                             settle(h, w)
+                    if steps_total is not None:
+                        steps_total.inc()  # host int increment, no sync
                     if prof is not None:
                         prof.on_step_end(gstep)
                     if watchdog is not None:
@@ -1681,6 +1778,37 @@ def train(args) -> float:
                             first_step_s=timer.compile_s,
                             events=events,
                         )
+                        if goodput is not None:
+                            goodput.add("compile", timer.compile_s)
+                        if mem_tel is not None:
+                            # One-time compiler memory budget for the
+                            # step program.  lower().compile() is a
+                            # SECOND compile (the jit cache does not
+                            # serve AOT lowering), so it runs here —
+                            # after the first step was timed — and only
+                            # under --memory-telemetry.
+                            lower = getattr(step_fn, "lower", None)
+                            if lower is not None:
+                                t_aot = time.perf_counter()
+                                try:
+                                    mem_tel.note_executable(
+                                        lower(state, batch, sub).compile(),
+                                        label="train_step",
+                                    )
+                                # ddplint: allow[broad-except] — optional
+                                # telemetry; backends without AOT memory
+                                # analysis must degrade, not abort train
+                                except Exception:  # noqa: BLE001
+                                    warn0(
+                                        "memory-telemetry: step memory "
+                                        "analysis unavailable"
+                                    )
+                                if goodput is not None:
+                                    goodput.add(
+                                        "compile",
+                                        time.perf_counter() - t_aot,
+                                    )
+                                timer.reset()  # don't bill the window
                     if reading:
                         drain()  # window boundary: fully-synced state
                         if registry is not None:
@@ -1692,6 +1820,19 @@ def train(args) -> float:
                                 reading["items_per_s_per_chip"]
                             )
                             g("steps_per_s").set(reading["steps_per_s"])
+                        if mfu_meter is not None:
+                            att = mfu_meter.on_reading(reading, step=gstep)
+                            if att["mfu"] is not None:
+                                log0(
+                                    "mfu: %.2f%% (hfu %.2f%%, "
+                                    "%.3e model FLOP/s)",
+                                    100 * att["mfu"], 100 * att["hfu"],
+                                    att["model_flops_per_s"],
+                                )
+                        if mem_tel is not None:
+                            # Window boundary: drain() already ran, so
+                            # this never introduces a sync of its own.
+                            mem_tel.sample(gstep)
                         log0(
                             "throughput: %.0f %s/s (%.1f %s/s/chip)",
                             reading["items_per_s"], unit,
@@ -1713,9 +1854,14 @@ def train(args) -> float:
                              epoch, batch_idx, last_loss)
                     if ckpt is not None and preempt_agreed(batch_idx):
                         drain()  # checkpoint edge: fully-synced state
+                        t_ck = time.perf_counter()
                         with _span("ckpt_save", epoch=epoch):
                             ckpt.save(state, epoch, meta=ckpt_meta)
                             ckpt.wait()
+                        if goodput is not None:
+                            goodput.add(
+                                "checkpoint", time.perf_counter() - t_ck
+                            )
                         log0("preempted: checkpoint saved mid-epoch %d; "
                              "--resume continues from epoch %d",
                              epoch, epoch + 1)
@@ -1729,19 +1875,24 @@ def train(args) -> float:
                 # unique samples — sampler pad duplicates contribute nothing.
                 # FSDP streams over the sharded flats; everything else gets
                 # the (possibly gathered) model-layout tree.
-                eval_params = state.params if args.fsdp else full_params()
-                evals = []
-                for b in eval_loader:
-                    m, cnt = (
-                        eval_step(eval_params, state.model_state, b)
-                        if has_ms and not cp
-                        else eval_step(eval_params, b)
-                    )
-                    evals.append((m, float(cnt)))
-                # Free the gathered copy NOW — keeping a full replicated
-                # param tree alive through the next training epoch would
-                # undo exactly the memory FSDP shards away.
-                del eval_params
+                t_ev = time.perf_counter()
+                with _span("eval", epoch=epoch):
+                    eval_params = state.params if args.fsdp else full_params()
+                    evals = []
+                    for b in eval_loader:
+                        m, cnt = (
+                            eval_step(eval_params, state.model_state, b)
+                            if has_ms and not cp
+                            else eval_step(eval_params, b)
+                        )
+                        evals.append((m, float(cnt)))
+                    # Free the gathered copy NOW — keeping a full
+                    # replicated param tree alive through the next
+                    # training epoch would undo exactly the memory FSDP
+                    # shards away.
+                    del eval_params
+                if goodput is not None:
+                    goodput.add("eval", time.perf_counter() - t_ev)
                 if evals:
                     total = sum(n for _, n in evals)
                     mean = {
@@ -1750,8 +1901,11 @@ def train(args) -> float:
                     }
                     log0("Epoch %d eval: %s", epoch, mean)
             if ckpt is not None:
+                t_ck = time.perf_counter()
                 with _span("ckpt_save", epoch=epoch):
                     ckpt.save(state, epoch, meta=ckpt_meta)
+                if goodput is not None:
+                    goodput.add("checkpoint", time.perf_counter() - t_ck)
             if eval_step is not None or ckpt is not None:
                 # Don't let eval/checkpoint wall time pollute throughput.
                 timer.reset()
@@ -1783,6 +1937,11 @@ def train(args) -> float:
                 pass
         if events is not None:
             exc = sys.exc_info()[1]
+            if goodput is not None:
+                # The run's own wall-time attribution, just before
+                # run_end; the offline reconstruction adds what this
+                # incarnation cannot see (inter-incarnation restart gaps).
+                events.emit("goodput", **goodput.summary())
             events.emit(
                 "run_end",
                 status="ok" if exc is None else type(exc).__name__,

@@ -1,0 +1,35 @@
+#!/usr/bin/env bash
+# CI gate: tier-1 tests + static lint + schema sync, in fail-fast order.
+#
+#   scripts/ci.sh          # full gate (what the merge queue runs)
+#   scripts/ci.sh --fast   # skip the pytest tier, keep the static gates
+#
+# Order is cheapest-first so drift fails in seconds:
+#   1. ddplint --ast            AST rules (host-sync, broad-except,
+#                               unregistered emit kinds) — stdlib-only
+#   2. check_events --schema-sync
+#                               two-way emitter <-> EVENT_KINDS diff, so
+#                               a kind added on one side only is a hard
+#                               error in BOTH directions
+#   3. tier-1 pytest            the ROADMAP verify command (CPU, not slow)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== ddplint --ast =="
+python scripts/ddplint.py --ast
+
+echo "== check_events --schema-sync =="
+python scripts/check_events.py --schema-sync
+
+if [[ "${1:-}" == "--fast" ]]; then
+    echo "ci.sh --fast: static gates clean; skipping pytest tier"
+    exit 0
+fi
+
+echo "== tier-1 pytest =="
+timeout -k 10 870 env JAX_PLATFORMS=cpu \
+    python -m pytest tests/ -q -m 'not slow' \
+    --continue-on-collection-errors \
+    -p no:cacheprovider -p no:xdist -p no:randomly
+
+echo "ci.sh: all gates clean"

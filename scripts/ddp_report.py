@@ -1,0 +1,308 @@
+#!/usr/bin/env python
+"""Offline run report: one events dir in, one markdown (or JSON) out.
+
+Usage:
+    python scripts/ddp_report.py EVENTS_DIR            # markdown to stdout
+    python scripts/ddp_report.py EVENTS_DIR --json     # machine-readable
+    python scripts/ddp_report.py EVENTS_DIR -o report.md
+
+Consumes the merged ``timeline.jsonl`` a run leaves behind (merging the
+per-worker files itself when the run died before the exit-time merge)
+and renders the four performance-attribution views:
+
+- **Goodput** — wall time split into productive / compile / checkpoint /
+  eval / restart / stall, reconstructed across every incarnation of a
+  supervised run (``observability.goodput``);
+- **MFU trend** — the per-window ``mfu`` events as a table (cost model
+  vs hardware peak);
+- **Memory** — per-rank live-array / device high-water marks from the
+  ``memory`` and ``exec_memory`` events;
+- **Stragglers** — per-rank step stats and cross-rank skew attribution
+  (``observability.straggler``).
+
+Sections a run didn't record (no --mfu, single rank, gang dead before
+any worker wrote) degrade to an explanatory line, never a crash — the
+report is most needed for the runs that ended badly.
+
+Import-light on purpose: stdlib + the stdlib-only observability modules,
+never jax — it must run on a laptop holding only the events dir.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from distributeddataparallel_tpu.observability.events import (  # noqa: E402
+    TIMELINE_NAME,
+    merge_timeline,
+)
+from distributeddataparallel_tpu.observability.goodput import (  # noqa: E402
+    goodput_from_timeline,
+)
+from distributeddataparallel_tpu.observability.straggler import (  # noqa: E402
+    straggler_report,
+)
+
+
+def load_timeline(events_dir: str) -> list[dict]:
+    """The merged timeline's records, merging per-worker files first if
+    the run never got to (or died during) its exit-time merge."""
+    path = os.path.join(events_dir, TIMELINE_NAME)
+    if not os.path.exists(path):
+        if merge_timeline(events_dir) is None:
+            return []
+    records = []
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                records.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue  # torn tail of a killed writer
+    return records
+
+
+def _fmt_bytes(n) -> str:
+    if n is None:
+        return "-"
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024 or unit == "TiB":
+            return f"{n:.1f} {unit}" if unit != "B" else f"{n} B"
+        n /= 1024
+    return f"{n}"
+
+
+def _pct(x) -> str:
+    return "-" if x is None else f"{100 * x:.2f}%"
+
+
+def analyze(records: list[dict]) -> dict:
+    """Everything the renderers need, as plain data — the --json face."""
+    worker_procs = sorted(
+        {r["proc"] for r in records if isinstance(r.get("proc"), int)}
+    )
+    out = {
+        "n_records": len(records),
+        "worker_procs": worker_procs,
+        "goodput": None,
+        "mfu": [],
+        "memory": {},
+        "exec_memory": [],
+        "straggler": None,
+        "restarts": [],
+    }
+    if worker_procs:
+        out["goodput"] = goodput_from_timeline(records, proc=worker_procs[0])
+        out["straggler"] = straggler_report(records)
+
+    for r in records:
+        kind = r.get("kind")
+        if kind == "mfu":
+            out["mfu"].append({
+                "step": r.get("step"),
+                "mfu": r.get("mfu"),
+                "hfu": r.get("hfu"),
+                "model_flops_per_s": r.get("model_flops_per_s"),
+            })
+        elif kind == "memory":
+            proc = r.get("proc")
+            mem = out["memory"].setdefault(proc, {
+                "samples": 0,
+                "live_hwm_bytes": 0,
+                "device_peak_bytes": None,
+            })
+            mem["samples"] += 1
+            mem["live_hwm_bytes"] = max(
+                mem["live_hwm_bytes"], r.get("live_hwm_bytes") or 0
+            )
+            if r.get("device_peak_bytes") is not None:
+                mem["device_peak_bytes"] = max(
+                    mem["device_peak_bytes"] or 0, r["device_peak_bytes"]
+                )
+        elif kind == "exec_memory":
+            out["exec_memory"].append(
+                {k: v for k, v in r.items() if k not in ("v", "seq")}
+            )
+        elif kind in ("restart_attempt", "restart_exhausted"):
+            out["restarts"].append({
+                "kind": kind,
+                "attempt": r.get("attempt"),
+                "failed": r.get("failed"),
+            })
+    return out
+
+
+def render_markdown(a: dict, events_dir: str) -> str:
+    lines = [f"# Run report — `{events_dir}`", ""]
+    if not a["n_records"]:
+        lines.append("No event records found — nothing ever wrote to this "
+                     "directory.")
+        return "\n".join(lines) + "\n"
+    if not a["worker_procs"]:
+        lines += [
+            f"{a['n_records']} supervisor-only records — the gang died "
+            "before any worker wrote events.",
+            "",
+        ]
+
+    # -- Goodput ------------------------------------------------------
+    lines += ["## Goodput", ""]
+    g = a["goodput"]
+    if g is None:
+        lines.append("No worker run_start in the timeline — goodput "
+                     "cannot be attributed.")
+    else:
+        lines += [
+            f"**{_pct(g['goodput'])}** of {g['total_s']:.1f}s wall time "
+            f"was productive ({g['restarts']} restart(s)).",
+            "",
+            "| bucket | seconds | share |",
+            "|---|---:|---:|",
+            f"| productive | {g['productive_s']:.2f} | "
+            f"{_pct(g['goodput'])} |",
+        ]
+        for name, secs in g["buckets"].items():
+            share = secs / g["total_s"] if g["total_s"] else None
+            lines.append(f"| {name} | {secs:.2f} | {_pct(share)} |")
+        if g["restarts"]:
+            lines += ["", f"Incarnations ({len(g['incarnations'])}):", ""]
+            for i, inc in enumerate(g["incarnations"]):
+                lines.append(
+                    f"- attempt {i}: {inc['total_s']:.1f}s, "
+                    f"status `{inc['status']}`"
+                )
+    lines.append("")
+
+    # -- MFU ----------------------------------------------------------
+    lines += ["## MFU trend", ""]
+    if not a["mfu"]:
+        lines.append("No `mfu` events — run with `--mfu` to record the "
+                     "cost-model utilization per throughput window.")
+    else:
+        lines += ["| step | MFU | HFU | model FLOP/s |", "|---:|---:|---:|---:|"]
+        for m in a["mfu"]:
+            lines.append(
+                f"| {m['step']} | {_pct(m['mfu'])} | {_pct(m['hfu'])} | "
+                f"{m['model_flops_per_s']:.3e} |"
+            )
+        vals = [m["mfu"] for m in a["mfu"] if m["mfu"] is not None]
+        if vals:
+            lines += [
+                "",
+                f"Mean MFU {_pct(sum(vals) / len(vals))} over "
+                f"{len(vals)} window(s); last {_pct(vals[-1])}.",
+            ]
+    lines.append("")
+
+    # -- Memory -------------------------------------------------------
+    lines += ["## Memory high-water marks", ""]
+    if not a["memory"]:
+        lines.append("No `memory` events — run with `--memory-telemetry` "
+                     "to sample live-array/device memory at window "
+                     "boundaries.")
+    else:
+        lines += [
+            "| rank | samples | live-array HWM | device peak |",
+            "|---:|---:|---:|---:|",
+        ]
+        for proc, mem in sorted(a["memory"].items(), key=lambda kv: str(kv[0])):
+            lines.append(
+                f"| {proc} | {mem['samples']} | "
+                f"{_fmt_bytes(mem['live_hwm_bytes'])} | "
+                f"{_fmt_bytes(mem['device_peak_bytes'])} |"
+            )
+    for e in a["exec_memory"]:
+        parts = [
+            f"{k.replace('_bytes', '')} {_fmt_bytes(v)}"
+            for k, v in e.items()
+            if k.endswith("_bytes") and v is not None
+        ]
+        lines += [
+            "",
+            f"Compiler budget for `{e.get('label')}` (rank {e.get('proc')}): "
+            + ", ".join(parts),
+        ]
+    lines.append("")
+
+    # -- Stragglers ---------------------------------------------------
+    lines += ["## Stragglers", ""]
+    s = a["straggler"]
+    if s is None:
+        lines.append("No step spans in the timeline — nothing ran.")
+    else:
+        lines += [
+            "| rank | steps | mean step | max step |",
+            "|---:|---:|---:|---:|",
+        ]
+        for proc, st in sorted(s["ranks"].items(), key=lambda kv: str(kv[0])):
+            lines.append(
+                f"| {proc} | {st['steps']} | {st['mean_step_s'] * 1e3:.2f} ms"
+                f" | {st['max_step_s'] * 1e3:.2f} ms |"
+            )
+        if s["n_ranks"] < 2:
+            lines += ["", "Single-rank gang: cross-rank skew is undefined."]
+        elif s["steps_compared"]:
+            lines += [
+                "",
+                f"Across {s['steps_compared']} gang steps: mean skew "
+                f"{s['skew_mean_s'] * 1e3:.2f} ms, max "
+                f"{s['skew_max_s'] * 1e3:.2f} ms; slowest rank "
+                f"**{s['slowest_rank']}** (last to finish "
+                f"{s['slowest_counts'].get(s['slowest_rank'], 0)} times).",
+                "",
+                "| skew bucket | gang steps |",
+                "|---|---:|",
+            ]
+            for label, count in s["skew_histogram"].items():
+                lines.append(f"| {label} | {count} |")
+    lines.append("")
+
+    # -- Restarts -----------------------------------------------------
+    if a["restarts"]:
+        lines += ["## Restarts", ""]
+        for r in a["restarts"]:
+            lines.append(
+                f"- `{r['kind']}` attempt {r['attempt']} "
+                f"(failed: {r['failed']})"
+            )
+        lines.append("")
+    return "\n".join(lines) + "\n"
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("events_dir", help="directory holding events-*.jsonl / "
+                                       "timeline.jsonl")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the analysis as JSON instead of markdown")
+    ap.add_argument("-o", "--out", default=None,
+                    help="write the report here instead of stdout")
+    args = ap.parse_args(argv)
+
+    if not os.path.isdir(args.events_dir):
+        print(f"ddp_report: no such directory: {args.events_dir}",
+              file=sys.stderr)
+        return 1
+    records = load_timeline(args.events_dir)
+    analysis = analyze(records)
+    text = (
+        json.dumps(analysis, indent=2) + "\n" if args.json
+        else render_markdown(analysis, args.events_dir)
+    )
+    if args.out:
+        with open(args.out, "w") as fh:
+            fh.write(text)
+    else:
+        sys.stdout.write(text)
+    return 0 if records else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

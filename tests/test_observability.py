@@ -481,3 +481,57 @@ def test_acceptance_chaos_timeline_causal_order(devices, tmp_path):
     # The injected preemption is on the timeline before the restart.
     inj = [r for r in by_kind["chaos_inject"] if "preempt" in r["entry"]]
     assert inj and inj[0]["ts"] <= by_kind["restart_attempt"][0]["ts"]
+
+
+# ------------------------------------- satellite: dead-gang exit merge
+
+
+def test_supervisor_merge_tolerates_gang_dead_before_events(
+    devices, tmp_path,
+):
+    """A gang that dies before ANY worker writes events (here: argv that
+    fails validation in parse_args) must still surface the restart-
+    exhausted RuntimeError, and the exit-time merge must produce a
+    supervisor-only timeline instead of crashing."""
+    ev_dir = str(tmp_path / "events")
+    # --mfu has no resnet cost model: SystemExit in parse_args, before
+    # the worker ever opens its events file.
+    bad = ["--device", "cpu", "--fake-devices", "8",
+           "--model", "resnet18", "--mfu"]
+    with pytest.raises(RuntimeError, match="restart budget"):
+        spawn(
+            dpp._worker, args=(bad,), nprocs=1, max_restarts=1,
+            restart_backoff_s=0.05,
+            env={"_DDP_SUPERVISED": "1"}, events_dir=ev_dir,
+        )
+    assert not os.path.exists(events_path(ev_dir, 0))
+    timeline = os.path.join(ev_dir, "timeline.jsonl")
+    assert os.path.exists(timeline)
+    recs = read_events(timeline)
+    assert recs and all(r["proc"] == "supervisor" for r in recs)
+    assert {"restart_attempt", "restart_exhausted"} <= {
+        r["kind"] for r in recs
+    }
+
+
+def test_supervisor_merge_failure_does_not_mask_run_error(
+    devices, tmp_path, monkeypatch,
+):
+    """If the exit-time merge itself fails (unwritable dir, disk full),
+    the run's real exception must still be the one that propagates."""
+    from distributeddataparallel_tpu.runtime import launcher as launcher_mod
+    from distributeddataparallel_tpu.observability import events as ev_mod
+
+    def broken_merge(events_dir, out_name="timeline.jsonl"):
+        raise OSError("disk full")
+
+    monkeypatch.setattr(ev_mod, "merge_timeline", broken_merge)
+    ev_dir = str(tmp_path / "events")
+    bad = ["--device", "cpu", "--fake-devices", "8",
+           "--model", "resnet18", "--mfu"]
+    with pytest.raises(RuntimeError, match="restart budget"):
+        launcher_mod.spawn(
+            dpp._worker, args=(bad,), nprocs=1, max_restarts=1,
+            restart_backoff_s=0.05,
+            env={"_DDP_SUPERVISED": "1"}, events_dir=ev_dir,
+        )
