@@ -1321,8 +1321,9 @@ def _observability_child(out_path, events_dir, env):
     - step_s_off / step_s_on: the SAME compiled GPT-2 124M step timed
       with observability disabled, then wired exactly as dpp.py wires it
       (per-step span, profiler hooks, steps_total counter,
-      --metrics-every export cadence, and the PR 5 attribution layer:
-      MFU meter + memory sampling at the window boundary);
+      --metrics-every export cadence, the PR 5 attribution layer: MFU
+      meter + memory sampling at the window boundary, and the alert
+      engine evaluated at that same boundary);
     - syncs_off / syncs_on: jax.block_until_ready call counts in each
       loop — the telemetry-on loop must add ZERO;
     - telemetry_us_per_step: the per-step telemetry work microbenchmarked
@@ -1340,6 +1341,7 @@ def _observability_child(out_path, events_dir, env):
 
     import bench as _bench
     from distributeddataparallel_tpu.observability import (
+        AlertEngine,
         EventLog,
         JsonlExporter,
         MemoryTelemetry,
@@ -1381,7 +1383,8 @@ def _observability_child(out_path, events_dir, env):
         ITERS = 2
 
         def loop(tracer=None, prof=None, registry=None, metrics_every=100,
-                 steps_total=None, mfu_meter=None, mem_tel=None):
+                 steps_total=None, mfu_meter=None, mem_tel=None,
+                 alert_engine=None):
             syncs["n"] = 0
             s = state
             t0 = time.perf_counter()
@@ -1405,10 +1408,27 @@ def _observability_child(out_path, events_dir, env):
             # it: AT the boundary where the loop already drained.  Kept
             # inside the counted region so syncs_on would expose any
             # device round-trip the meters sneaked in.
+            att = sample = None
             if mfu_meter is not None:
-                mfu_meter.on_reading({"steps_per_s": 1.0 / dt}, step=ITERS)
+                att = mfu_meter.on_reading(
+                    {"steps_per_s": 1.0 / dt}, step=ITERS
+                )
             if mem_tel is not None:
-                mem_tel.sample(ITERS)
+                sample = mem_tel.sample(ITERS)
+            if alert_engine is not None:
+                # Same contract as dpp.py: the engine sees only host
+                # floats this boundary already computed, inside the
+                # counted region so any device read it sneaked in would
+                # show up in syncs_on.
+                alert_engine.observe(
+                    step=ITERS,
+                    step_s=dt,
+                    mfu=att["mfu"] if att else None,
+                    live_hwm_bytes=(
+                        sample.get("live_hwm_bytes") if sample else None
+                    ),
+                    restarts=0,
+                )
             return dt, syncs["n"]
 
         step_s_off, syncs_off = loop()
@@ -1437,15 +1457,18 @@ def _observability_child(out_path, events_dir, env):
             events=events,
         )
         mem_tel = MemoryTelemetry(registry, events, jax.local_devices())
+        alert_engine = AlertEngine(events=events, registry=registry)
         step_s_on, syncs_on = loop(
             tracer, prof, registry,
             steps_total=steps_total, mfu_meter=mfu_meter, mem_tel=mem_tel,
+            alert_engine=alert_engine,
         )
         events.emit("run_end", status="ok")
 
         # Micro: the per-step telemetry work alone, at default cadence —
         # including the PR 5 boundary work (MFU arithmetic + live-array
-        # walk) at a window-ish cadence of 100.
+        # walk) and the alert-rule evaluation at a window-ish cadence
+        # of 100.
         REPS = 2000
         t0 = time.perf_counter()
         for i in range(REPS):
@@ -1456,8 +1479,15 @@ def _observability_child(out_path, events_dir, env):
             steps_total.inc()
             if i % 100 == 0:
                 registry.export(step=i)
-                mfu_meter.on_reading({"steps_per_s": 1.0}, step=i)
-                mem_tel.sample(i)
+                att = mfu_meter.on_reading({"steps_per_s": 1.0}, step=i)
+                sample = mem_tel.sample(i)
+                alert_engine.observe(
+                    step=i, step_s=1.0, mfu=att["mfu"],
+                    live_hwm_bytes=(
+                        sample.get("live_hwm_bytes") if sample else None
+                    ),
+                    restarts=0,
+                )
         telemetry_us = (time.perf_counter() - t0) / REPS * 1e6
         events.close()
     finally:

@@ -7,13 +7,27 @@ free-form text: ``EventLog`` writes schema-versioned JSONL per worker,
 holds the counters/gauges/histograms every subsystem registers into,
 and ``ProfilerOrchestrator`` captures XLA traces on a step window or on
 the first anomaly.  ``merge_timeline`` folds the per-worker files into
-one gang timeline.
+one gang timeline; ``AlertEngine`` watches window boundaries for SLO
+breaks, ``to_trace_events`` exports the timeline for Perfetto, and
+``baseline`` keeps the longitudinal run store the perf gate compares
+against.
 
 Everything here is import-light (no jax at module scope): the chaos
 injector, the launcher supervisor, and ``scripts/check_events.py`` all
 import from this package in contexts where jax must not load.
 """
 
+from .alerts import AlertEngine, default_rules, parse_alert_spec
+from .baseline import (
+    GATE_METRICS,
+    RunSummaryBuilder,
+    append_run,
+    compare_to_baseline,
+    load_baseline,
+    read_runs,
+    run_summary_from_timeline,
+    save_baseline,
+)
 from .cost_model import (
     MFUMeter,
     mlp_fwd_flops,
@@ -23,7 +37,13 @@ from .cost_model import (
     transformer_fwd_flops,
     xla_cost_analysis,
 )
-from .events import EventLog, events_path, merge_timeline, read_events
+from .events import (
+    EventLog,
+    events_path,
+    load_timeline,
+    merge_timeline,
+    read_events,
+)
 from .goodput import GoodputLedger, goodput_from_timeline
 from .memory import MemoryTelemetry, live_array_bytes
 from .profiler import ProfilerOrchestrator, parse_profile_steps, profile_trace
@@ -45,11 +65,14 @@ from .schema import (
 )
 from .straggler import straggler_report
 from .trace import Tracer
+from .trace_export import to_trace_events, validate_trace, write_trace
 
 __all__ = [
     "ENVELOPE",
     "EVENT_KINDS",
+    "GATE_METRICS",
     "SCHEMA_VERSION",
+    "AlertEngine",
     "Counter",
     "EventLog",
     "Gauge",
@@ -60,23 +83,36 @@ __all__ = [
     "MemoryTelemetry",
     "MetricsRegistry",
     "ProfilerOrchestrator",
+    "RunSummaryBuilder",
     "TextExporter",
     "Tracer",
+    "append_run",
+    "compare_to_baseline",
+    "default_rules",
     "events_path",
     "goodput_from_timeline",
     "json_safe",
     "live_array_bytes",
+    "load_baseline",
+    "load_timeline",
     "merge_timeline",
     "mlp_fwd_flops",
+    "parse_alert_spec",
     "parse_profile_steps",
     "peak_flops_for",
     "profile_trace",
     "read_events",
+    "read_runs",
+    "run_summary_from_timeline",
+    "save_baseline",
     "simple_cnn_fwd_flops",
     "straggler_report",
+    "to_trace_events",
     "train_step_flops",
     "transformer_fwd_flops",
     "validate_file",
     "validate_record",
+    "validate_trace",
+    "write_trace",
     "xla_cost_analysis",
 ]

@@ -19,6 +19,7 @@ Module-import rule: stdlib only (see schema.py).
 from __future__ import annotations
 
 import glob
+import heapq
 import json
 import os
 import time
@@ -99,35 +100,62 @@ def read_events(path: str) -> list[dict]:
     return out
 
 
+def _merge_key(rec: dict) -> tuple:
+    return (rec.get("ts", 0.0), rec.get("seq", 0), str(rec.get("proc", "")))
+
+
+def _iter_records(path: str):
+    """Yield decoded records from one per-writer file, dropping torn
+    lines (the tail of a SIGKILLed writer).  One writer per file means
+    records are already in ``(ts, seq)`` order within the file, which is
+    what lets the merge stream instead of sort."""
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                yield json.loads(line)
+            except json.JSONDecodeError:
+                continue  # torn tail of a killed writer
+
+
 def merge_timeline(events_dir: str, out_name: str = TIMELINE_NAME) -> str | None:
     """Merge every per-writer events file in ``events_dir`` into one
     timeline ordered by ``(ts, seq, proc)``; returns the timeline path,
     or None when there are no event files to merge.
 
-    Tolerates a torn final line in a worker file (a killed worker is
-    exactly when the timeline matters most) by dropping it.
+    Streaming k-way heap merge: each input file is one writer's
+    append-only log and therefore already (ts, seq)-ordered, so the
+    merge holds one record per file instead of the whole gang history —
+    supervisor exit-merge stays O(files) resident however long the run
+    ran.  ``heapq.merge`` tolerates a locally out-of-order input (a
+    clock step mid-run) by emitting it late rather than raising, which
+    matches the old sort-everything behaviour closely enough for a
+    telemetry timeline.  Tolerates a torn final line in a worker file (a
+    killed worker is exactly when the timeline matters most) by
+    dropping it.
     """
     paths = sorted(glob.glob(os.path.join(events_dir, EVENTS_GLOB)))
     if not paths:
         return None
-    records = []
-    for path in paths:
-        with open(path) as fh:
-            for line in fh:
-                line = line.strip()
-                if not line:
-                    continue
-                try:
-                    records.append(json.loads(line))
-                except json.JSONDecodeError:
-                    continue  # torn tail of a killed writer
-    records.sort(
-        key=lambda r: (r.get("ts", 0.0), r.get("seq", 0), str(r.get("proc", "")))
-    )
     out_path = os.path.join(events_dir, out_name)
     tmp = out_path + ".tmp"
+    streams = [_iter_records(p) for p in paths]
     with open(tmp, "w") as fh:
-        for rec in records:
+        for rec in heapq.merge(*streams, key=_merge_key):
             fh.write(json.dumps(rec) + "\n")
     os.replace(tmp, out_path)
     return out_path
+
+
+def load_timeline(events_dir: str) -> list[dict]:
+    """Load the merged gang timeline for ``events_dir``, producing it
+    first if the run died before its exit-merge ran.  Returns [] when
+    there are no events at all.  Shared by the offline consumers
+    (ddp_report / ddp_trace / baseline extraction)."""
+    timeline = os.path.join(events_dir, TIMELINE_NAME)
+    if not os.path.exists(timeline):
+        if merge_timeline(events_dir) is None:
+            return []
+    return list(_iter_records(timeline))

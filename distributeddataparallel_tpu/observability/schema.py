@@ -53,6 +53,9 @@ EVENT_KINDS: dict[str, tuple[str, ...]] = {
     "memory": ("step", "live_bytes"),
     "exec_memory": ("label",),
     "goodput": ("total_s", "goodput", "buckets"),
+    # Alerting + longitudinal layer (alerts / baseline):
+    "alert": ("rule", "step", "value", "threshold"),
+    "run_summary": ("windows", "restarts"),
 }
 
 

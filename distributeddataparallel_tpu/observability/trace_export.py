@@ -1,0 +1,235 @@
+"""Gang timeline -> Chrome/Perfetto ``trace_event`` JSON.
+
+The merged ``timeline.jsonl`` already totally orders every span and
+telemetry event across the gang; this module re-expresses it in the
+trace_event format so a whole run — every incarnation, every rank, the
+supervisor's restart decisions — is inspectable in ``ui.perfetto.dev``
+(or ``chrome://tracing``) next to XLA profiler captures.
+
+Mapping:
+
+* one *process* track per writer — supervisor is pid 0, rank ``r`` is
+  pid ``r + 1`` — with ``process_name``/``thread_name`` metadata events;
+* ``span`` events become ``"X"`` complete events.  The event log stamps
+  a span at *exit* with its duration, so the trace start is
+  ``ts - dur_s``; nesting is recovered by Perfetto from containment,
+  which holds because spans on one writer are properly nested;
+* counter tracks (``"C"``): ``step_s`` sampled from step spans, ``mfu``
+  from mfu events, ``memory_bytes`` from memory events;
+* discrete incidents — nan_skip, chaos_inject, watchdog_fire,
+  restart_attempt / restart_exhausted, loader_starved, alert — become
+  ``"i"`` instant events, so a restart is a visible mark on the
+  supervisor track at the moment it happened.
+
+Timestamps are microseconds relative to the earliest instant in the
+run (trace viewers want small numbers, not epoch µs).
+
+Module-import rule: stdlib only (see schema.py) — ``scripts/
+ddp_trace.py`` runs this in a jax-free interpreter.
+"""
+
+from __future__ import annotations
+
+import json
+
+#: kind -> counter-track name, value field
+_COUNTER_KINDS = {
+    "mfu": ("mfu", "mfu"),
+    "memory": ("memory_bytes", "live_bytes"),
+}
+
+#: kinds rendered as instant events (fields worth carrying into args)
+_INSTANT_KINDS = {
+    "nan_skip": ("step",),
+    "chaos_inject": ("entry", "step"),
+    "watchdog_fire": ("seconds_since_heartbeat",),
+    "restart_attempt": ("attempt", "exit_code"),
+    "restart_exhausted": ("attempt",),
+    "loader_starved": ("window", "step"),
+    "alert": ("rule", "step", "value", "threshold"),
+}
+
+SUPERVISOR_PID = 0
+
+
+def _pid(proc) -> int:
+    """supervisor -> 0, rank r -> r + 1, unknown writers -> hash-free
+    stable fallback pid 999 (keeps the trace loadable rather than
+    raising on a foreign record)."""
+    if proc == "supervisor":
+        return SUPERVISOR_PID
+    try:
+        return int(proc) + 1
+    except (TypeError, ValueError):
+        return 999
+
+
+def _track_name(proc) -> str:
+    if proc == "supervisor":
+        return "supervisor"
+    try:
+        return f"rank {int(proc)}"
+    except (TypeError, ValueError):
+        return str(proc)
+
+
+def _span_start_s(rec: dict) -> float:
+    return float(rec.get("ts", 0.0)) - float(rec.get("dur_s", 0.0) or 0.0)
+
+
+def _args(rec: dict, fields) -> dict:
+    out = {}
+    for f in fields:
+        if f in rec and rec[f] is not None:
+            out[f] = rec[f]
+    return out
+
+
+def to_trace_events(records: list[dict]) -> dict:
+    """Convert merged timeline records to a trace_event JSON object:
+    ``{"traceEvents": [...], "displayTimeUnit": "ms"}``.  Pure host
+    work over already-decoded records; ignores kinds it has no mapping
+    for rather than failing on future schema additions."""
+    # Epoch of the trace: the earliest instant anywhere, including span
+    # starts (a span's exit ts may not be the first thing that happened).
+    t0 = None
+    for rec in records:
+        ts = rec.get("ts")
+        if not isinstance(ts, (int, float)):
+            continue
+        start = _span_start_s(rec) if rec.get("kind") == "span" else float(ts)
+        t0 = start if t0 is None else min(t0, start)
+    if t0 is None:
+        return {"traceEvents": [], "displayTimeUnit": "ms"}
+
+    def us(ts_s: float) -> float:
+        return max(0.0, (ts_s - t0) * 1e6)
+
+    events: list[dict] = []
+    seen_pids: dict[int, str] = {}
+    for rec in records:
+        proc = rec.get("proc")
+        kind = rec.get("kind")
+        ts = rec.get("ts")
+        if kind is None or not isinstance(ts, (int, float)):
+            continue
+        pid = _pid(proc)
+        seen_pids.setdefault(pid, _track_name(proc))
+
+        if kind == "span":
+            dur_s = rec.get("dur_s")
+            if not isinstance(dur_s, (int, float)):
+                continue
+            events.append({
+                "ph": "X",
+                "name": str(rec.get("name", "span")),
+                "cat": "span",
+                "pid": pid,
+                "tid": 0,
+                "ts": us(_span_start_s(rec)),
+                "dur": float(dur_s) * 1e6,
+                "args": _args(rec, ("step", "epoch", "depth", "parent")),
+            })
+            # step spans double as the step_s counter samples
+            if rec.get("name") == "step":
+                events.append({
+                    "ph": "C",
+                    "name": "step_s",
+                    "pid": pid,
+                    "tid": 0,
+                    "ts": us(float(ts)),
+                    "args": {"step_s": float(dur_s)},
+                })
+        elif kind in _COUNTER_KINDS:
+            track, field = _COUNTER_KINDS[kind]
+            value = rec.get(field)
+            if isinstance(value, (int, float)):
+                events.append({
+                    "ph": "C",
+                    "name": track,
+                    "pid": pid,
+                    "tid": 0,
+                    "ts": us(float(ts)),
+                    "args": {track: float(value)},
+                })
+        elif kind in _INSTANT_KINDS:
+            events.append({
+                "ph": "i",
+                "name": kind,
+                "cat": "incident",
+                "pid": pid,
+                "tid": 0,
+                "ts": us(float(ts)),
+                # supervisor incidents concern the whole gang
+                "s": "g" if pid == SUPERVISOR_PID else "p",
+                "args": _args(rec, _INSTANT_KINDS[kind]),
+            })
+
+    # Per-track monotonic order (viewers require ts-sorted streams per
+    # track; a global ts sort gives that and keeps the file diffable).
+    events.sort(key=lambda e: (e["ts"], e["pid"]))
+
+    meta: list[dict] = []
+    for pid in sorted(seen_pids):
+        meta.append({
+            "ph": "M", "name": "process_name", "pid": pid, "tid": 0,
+            "args": {"name": seen_pids[pid]},
+        })
+        meta.append({
+            "ph": "M", "name": "process_sort_index", "pid": pid, "tid": 0,
+            "args": {"sort_index": pid},
+        })
+        meta.append({
+            "ph": "M", "name": "thread_name", "pid": pid, "tid": 0,
+            "args": {"name": "main"},
+        })
+    return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
+
+
+def validate_trace(trace: dict) -> list[str]:
+    """Structural check of a trace_event object (empty list = valid):
+    required top-level shape, required per-event fields by phase, and
+    per-(pid, tid) monotonic timestamps.  Used by tests and by
+    ``ddp_trace.py --check`` before handing the file to a viewer."""
+    problems = []
+    if not isinstance(trace, dict) or "traceEvents" not in trace:
+        return ["trace is not an object with a traceEvents array"]
+    events = trace["traceEvents"]
+    if not isinstance(events, list):
+        return ["traceEvents is not an array"]
+    last_ts: dict[tuple, float] = {}
+    for i, ev in enumerate(events):
+        if not isinstance(ev, dict):
+            problems.append(f"event {i}: not an object")
+            continue
+        ph = ev.get("ph")
+        if ph not in ("X", "C", "i", "M"):
+            problems.append(f"event {i}: unsupported phase {ph!r}")
+            continue
+        for field in ("name", "pid", "tid"):
+            if field not in ev:
+                problems.append(f"event {i}: missing {field!r}")
+        if ph == "M":
+            continue
+        ts = ev.get("ts")
+        if not isinstance(ts, (int, float)) or ts < 0:
+            problems.append(f"event {i}: bad ts {ts!r}")
+            continue
+        if ph == "X" and not isinstance(ev.get("dur"), (int, float)):
+            problems.append(f"event {i}: complete event without dur")
+        if ph == "i" and ev.get("s") not in ("g", "p", "t"):
+            problems.append(f"event {i}: instant event bad scope {ev.get('s')!r}")
+        key = (ev.get("pid"), ev.get("tid"))
+        if ts < last_ts.get(key, float("-inf")):
+            problems.append(
+                f"event {i}: ts {ts} regresses on track {key}"
+            )
+        last_ts[key] = float(ts)
+    return problems
+
+
+def write_trace(trace: dict, out_path: str) -> str:
+    with open(out_path, "w") as fh:
+        json.dump(trace, fh)
+        fh.write("\n")
+    return out_path

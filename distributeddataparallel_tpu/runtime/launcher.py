@@ -128,6 +128,7 @@ def spawn(
     max_restarts: int = 0,
     restart_backoff_s: float = 1.0,
     events_dir: str | None = None,
+    runs_dir: str | None = None,
 ):
     """Run ``fn(i, *args)`` for i in range(nprocs).
 
@@ -152,6 +153,12 @@ def spawn(
     respawn), workers inherit the directory via ``DDP_EVENTS_DIR``, and
     on exit every per-writer file is merged into one gang
     ``timeline.jsonl`` ordered by (ts, seq).
+
+    ``runs_dir`` (with ``events_dir``) additionally appends a
+    run_summary extracted from the merged timeline to the longitudinal
+    runs store (``observability.baseline``) — the supervisor writes it
+    because only its view spans every incarnation plus the restart gaps
+    between them.  Workers inherit the directory via ``DDP_RUNS_DIR``.
     """
     if nprocs < 1:
         raise ValueError(f"nprocs must be >= 1, got {nprocs}")
@@ -184,6 +191,8 @@ def spawn(
                 gang_env["DDP_RESTART_ATTEMPT"] = str(attempt)
                 if events_dir:
                     gang_env.setdefault("DDP_EVENTS_DIR", events_dir)
+                if runs_dir:
+                    gang_env.setdefault("DDP_RUNS_DIR", runs_dir)
                 procs = _run_gang(fn, args, nprocs, gang_env)
                 failed = _join_gang(procs)
                 if not failed:
@@ -226,11 +235,32 @@ def spawn(
                 # (unwritable dir, disk full, a gang that died before
                 # any worker wrote its file) must not mask it.
                 try:
-                    if merge_timeline(events_dir) is None:
+                    merged = merge_timeline(events_dir)
+                    if merged is None:
                         get_logger().warning(
                             "[supervisor] no event files to merge in %s "
                             "(gang died before writing any?)",
                             events_dir,
+                        )
+                    elif runs_dir:
+                        # Longitudinal store: the supervisor's summary is
+                        # THE record for a supervised run — rebuilt from
+                        # the merged timeline, it spans every incarnation
+                        # and the restart gaps no worker could see.
+                        # Best-effort for the same reason as the merge.
+                        from distributeddataparallel_tpu.observability import (
+                            baseline as _baseline,
+                        )
+                        from distributeddataparallel_tpu.observability.events import (  # noqa: E501
+                            load_timeline,
+                        )
+
+                        _baseline.append_run(
+                            runs_dir,
+                            _baseline.run_summary_from_timeline(
+                                load_timeline(events_dir)
+                            ),
+                            source="supervisor",
                         )
                 except OSError as exc:
                     get_logger().warning(

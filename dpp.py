@@ -295,6 +295,23 @@ def parse_args(argv=None):
                         "record the train step's compiler memory budget "
                         "once after the first step (costs one extra AOT "
                         "compile of the step program)")
+    p.add_argument("--alerts", nargs="?", const="", default=None,
+                   metavar="SPEC",
+                   help="observability: evaluate SLO alert rules at "
+                        "throughput-window boundaries (zero extra host "
+                        "syncs) and emit `alert` events + registry "
+                        "counters.  Bare --alerts enables every rule at "
+                        "defaults; SPEC overrides thresholds, e.g. "
+                        "--alerts mfu_floor=0.3,step_spike=2.5 "
+                        "(rules: step_spike, mfu_floor, goodput_floor, "
+                        "restart_storm, loader_starved, mem_growth).  "
+                        "Watch live with scripts/ddp_monitor.py")
+    p.add_argument("--runs-dir", default=None, metavar="DIR",
+                   help="longitudinal run store: append this run's "
+                        "run_summary (MFU, step-time percentiles, memory "
+                        "HWM, goodput, restarts, alerts) to "
+                        "DIR/index.jsonl at run end (env: DDP_RUNS_DIR); "
+                        "gate later runs with scripts/perf_gate.py")
     p.add_argument("--profile-steps", default=None, metavar="A:B",
                    help="capture a jax.profiler trace covering global "
                         "steps [A, B) — a windowed alternative to "
@@ -327,6 +344,19 @@ def parse_args(argv=None):
         args.compile_cache = os.environ.get("DDP_COMPILE_CACHE") or None
     if args.events_dir is None:
         args.events_dir = os.environ.get("DDP_EVENTS_DIR") or None
+    if args.runs_dir is None:
+        args.runs_dir = os.environ.get("DDP_RUNS_DIR") or None
+    if args.alerts is None and os.environ.get("DDP_ALERTS") is not None:
+        args.alerts = os.environ.get("DDP_ALERTS")
+    if args.alerts is not None:
+        from distributeddataparallel_tpu.observability.alerts import (
+            parse_alert_spec,
+        )
+
+        try:
+            parse_alert_spec(args.alerts)
+        except ValueError as e:
+            raise SystemExit(f"--alerts: {e}") from None
     if args.dispatch_depth < 0:
         raise SystemExit(
             f"--dispatch-depth must be >= 0, got {args.dispatch_depth}"
@@ -1591,6 +1621,32 @@ def train(args) -> float:
     steps_total = (
         registry.counter("steps_total") if registry is not None else None
     )
+    # Alerting + run summary: both consume ONLY numbers the window
+    # boundary below already computed (same zero-extra-syncs discipline
+    # as the meters above — bench.py pins it).
+    alert_engine = None
+    if args.alerts is not None:
+        from distributeddataparallel_tpu.observability import (
+            AlertEngine,
+            parse_alert_spec,
+        )
+
+        alert_engine = AlertEngine(
+            parse_alert_spec(args.alerts),
+            events=events,
+            registry=registry,
+            on_fire=lambda a: warn0(
+                "alert [%s] at step %s: value %s vs threshold %s",
+                a["rule"], a["step"], a.get("value"), a.get("threshold"),
+            ),
+        )
+    summary_builder = None
+    if events is not None or args.runs_dir:
+        from distributeddataparallel_tpu.observability import (
+            RunSummaryBuilder,
+        )
+
+        summary_builder = RunSummaryBuilder()
 
     # Bounded async dispatch (training.warm_start.BoundedDispatch): the
     # loop no longer blocks the host every step — up to --dispatch-depth
@@ -1829,10 +1885,51 @@ def train(args) -> float:
                                     100 * att["mfu"], 100 * att["hfu"],
                                     att["model_flops_per_s"],
                                 )
+                        mem_sample = None
                         if mem_tel is not None:
                             # Window boundary: drain() already ran, so
                             # this never introduces a sync of its own.
-                            mem_tel.sample(gstep)
+                            mem_sample = mem_tel.sample(gstep)
+                        window_step_s = (
+                            1.0 / reading["steps_per_s"]
+                            if reading["steps_per_s"] else None
+                        )
+                        window_mfu = (
+                            att["mfu"] if mfu_meter is not None else None
+                        )
+                        window_hwm = (
+                            mem_sample.get("live_hwm_bytes")
+                            if mem_sample else None
+                        )
+                        if summary_builder is not None:
+                            summary_builder.sample(
+                                step_s=window_step_s,
+                                mfu=window_mfu,
+                                live_hwm_bytes=window_hwm,
+                                steps_total=gstep + 1,
+                            )
+                        if alert_engine is not None:
+                            # Same boundary discipline as the meters
+                            # above: every signal is a host float this
+                            # block already computed — evaluating the
+                            # rules can never force a device sync.
+                            gsum = (
+                                goodput.summary()
+                                if goodput is not None else {}
+                            )
+                            alert_engine.observe(
+                                step=gstep,
+                                step_s=window_step_s,
+                                mfu=window_mfu,
+                                live_hwm_bytes=window_hwm,
+                                goodput=gsum.get("goodput"),
+                                elapsed_s=gsum.get("total_s"),
+                                prefetch_depth=(
+                                    loader.prefetch_depth
+                                    if args.workers > 0 else None
+                                ),
+                                restarts=counters.restarts,
+                            )
                         log0(
                             "throughput: %.0f %s/s (%.1f %s/s/chip)",
                             reading["items_per_s"], unit,
@@ -1935,6 +2032,22 @@ def train(args) -> float:
             # ddplint: allow[broad-except] — telemetry must not mask exit
             except Exception:  # noqa: BLE001 — telemetry must not mask
                 pass
+        run_summary = None
+        if summary_builder is not None:
+            exc = sys.exc_info()[1]
+            try:
+                run_summary = summary_builder.build(
+                    goodput=goodput.summary() if goodput is not None else None,
+                    restarts=counters.restarts,
+                    alerts_total=(
+                        len(alert_engine.fired)
+                        if alert_engine is not None else 0
+                    ),
+                    status="ok" if exc is None else type(exc).__name__,
+                )
+            # ddplint: allow[broad-except] — telemetry must not mask exit
+            except Exception:  # noqa: BLE001
+                run_summary = None
         if events is not None:
             exc = sys.exc_info()[1]
             if goodput is not None:
@@ -1942,6 +2055,10 @@ def train(args) -> float:
                 # run_end; the offline reconstruction adds what this
                 # incarnation cannot see (inter-incarnation restart gaps).
                 events.emit("goodput", **goodput.summary())
+            if run_summary is not None:
+                # The ~10 numbers this incarnation boils down to — what
+                # the runs store and perf gate consume.
+                events.emit("run_summary", **run_summary)
             events.emit(
                 "run_end",
                 status="ok" if exc is None else type(exc).__name__,
@@ -1959,6 +2076,22 @@ def train(args) -> float:
                 )
 
                 merge_timeline(args.events_dir)
+        if (
+            run_summary is not None
+            and args.runs_dir
+            and jax.process_index() == 0
+            and not os.environ.get("_DDP_SUPERVISED")
+        ):
+            # Longitudinal store: one line per run.  Supervised runs are
+            # appended by the launcher instead, whose summary spans every
+            # incarnation (this one would only cover the last).
+            from distributeddataparallel_tpu.observability import append_run
+
+            try:
+                append_run(args.runs_dir, run_summary, source="trainer")
+            # ddplint: allow[broad-except] — telemetry must not mask exit
+            except Exception:  # noqa: BLE001
+                warn0("runs-dir: could not append run summary")
     if counters.total:
         log0("fault summary: %s", counters.summary())
 
@@ -2050,6 +2183,9 @@ def main(argv=None):
             # events-supervisor.jsonl and the per-worker logs merge into
             # one gang timeline.jsonl when supervision ends.
             events_dir=args.events_dir,
+            # The supervisor writes the runs-store summary for supervised
+            # runs — its view spans every incarnation + restart gaps.
+            runs_dir=args.runs_dir,
         )
         return
     select_device(args)

@@ -12,6 +12,16 @@
 #                               a kind added on one side only is a hard
 #                               error in BOTH directions
 #   3. tier-1 pytest            the ROADMAP verify command (CPU, not slow)
+#
+# Opt-in perf regression gate (off by default so tier-1 stays
+# deterministic — perf numbers need a quiet, consistent host):
+#   DDP_PERF_GATE=1            compare DDP_PERF_GATE_RUN (an events dir,
+#                              run_summary JSON, or BENCH_*.json) against
+#                              baseline DDP_PERF_GATE_BASELINE (default
+#                              "main") in store DDP_PERF_GATE_STORE
+#                              (default runs/); non-zero exit on
+#                              regression.  Seed a baseline first with
+#                              scripts/perf_gate.py ... --update-baseline
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -20,6 +30,14 @@ python scripts/ddplint.py --ast
 
 echo "== check_events --schema-sync =="
 python scripts/check_events.py --schema-sync
+
+if [[ "${DDP_PERF_GATE:-0}" == "1" ]]; then
+    echo "== perf_gate =="
+    : "${DDP_PERF_GATE_RUN:?DDP_PERF_GATE=1 needs DDP_PERF_GATE_RUN}"
+    python scripts/perf_gate.py "${DDP_PERF_GATE_RUN}" \
+        --store "${DDP_PERF_GATE_STORE:-runs}" \
+        --baseline "${DDP_PERF_GATE_BASELINE:-main}"
+fi
 
 if [[ "${1:-}" == "--fast" ]]; then
     echo "ci.sh --fast: static gates clean; skipping pytest tier"

@@ -1,0 +1,219 @@
+#!/usr/bin/env python
+"""Live gang monitor: tail a run's per-worker event files.
+
+Usage:
+    python scripts/ddp_monitor.py EVENTS_DIR            # one-shot status
+    python scripts/ddp_monitor.py EVENTS_DIR --follow   # live tail
+    python scripts/ddp_monitor.py EVENTS_DIR --follow --interval 0.5
+
+One-shot mode prints a per-rank table (last step, last step time, last
+MFU, seconds since the rank last wrote, nan-skips, status) plus every
+fired alert, then exits **2 if any alert fired**, 0 when healthy, 1
+when there is nothing to read — so a supervisor script can `ddp_monitor
+$DIR || page_someone`.  Follow mode re-reads only the bytes appended
+since the last poll (byte offsets per file, torn trailing lines left
+unconsumed for the next poll) and streams alerts as they land.
+
+Reads ``events-p*.jsonl`` and ``events-supervisor.jsonl`` directly —
+no merge needed, files still being written are fine.
+
+Import-light on purpose: pure stdlib, never jax — this runs on the
+machine (or laptop) watching the run, not in the gang.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+import time
+
+ALERT_EXIT = 2
+
+
+class _Tail:
+    """Incremental reader for one append-only JSONL file.  Keeps a byte
+    offset; a trailing line without a newline is left for the next poll
+    (the writer is mid-append), so records are never torn by the
+    reader."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.offset = 0
+
+    def poll(self) -> list[dict]:
+        try:
+            with open(self.path, "rb") as fh:
+                fh.seek(self.offset)
+                chunk = fh.read()
+        except OSError:
+            return []
+        if not chunk:
+            return []
+        nl = chunk.rfind(b"\n")
+        if nl < 0:
+            return []  # only a partial line so far
+        self.offset += nl + 1
+        out = []
+        for line in chunk[:nl].split(b"\n"):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue  # torn line from a killed writer incarnation
+        return out
+
+
+class GangState:
+    """Per-rank rollup of everything the status table shows."""
+
+    def __init__(self):
+        self.ranks: dict[int, dict] = {}
+        self.alerts: list[dict] = []
+        self.supervisor: list[dict] = []
+
+    def _rank(self, proc: int) -> dict:
+        return self.ranks.setdefault(proc, {
+            "last_ts": None, "last_step": None, "last_step_s": None,
+            "last_mfu": None, "status": "running", "nan_skips": 0,
+            "alerts": 0, "incarnations": 0,
+        })
+
+    def ingest(self, rec: dict) -> None:
+        proc = rec.get("proc")
+        kind = rec.get("kind")
+        if proc == "supervisor":
+            if kind in ("restart_attempt", "restart_exhausted"):
+                self.supervisor.append(rec)
+            return
+        if not isinstance(proc, int):
+            return
+        r = self._rank(proc)
+        ts = rec.get("ts")
+        if isinstance(ts, (int, float)):
+            r["last_ts"] = max(r["last_ts"] or 0.0, float(ts))
+        if kind == "run_start":
+            r["incarnations"] += 1
+            r["status"] = "running"
+        elif kind == "run_end":
+            r["status"] = str(rec.get("status", "ended"))
+        elif kind == "span" and rec.get("name") == "step":
+            if isinstance(rec.get("step"), int):
+                r["last_step"] = rec["step"]
+            if isinstance(rec.get("dur_s"), (int, float)):
+                r["last_step_s"] = float(rec["dur_s"])
+        elif kind == "mfu":
+            if isinstance(rec.get("mfu"), (int, float)):
+                r["last_mfu"] = float(rec["mfu"])
+            if isinstance(rec.get("step"), int):
+                r["last_step"] = max(r["last_step"] or 0, rec["step"])
+        elif kind == "nan_skip":
+            r["nan_skips"] += 1
+        elif kind == "alert":
+            r["alerts"] += 1
+            self.alerts.append(rec)
+
+    def table(self, now: float | None = None) -> str:
+        now = time.time() if now is None else now
+        lines = [
+            f"{'rank':>4}  {'step':>8}  {'step_s':>9}  {'mfu':>6}  "
+            f"{'idle_s':>7}  {'nan':>4}  {'alerts':>6}  status",
+        ]
+        def fmt(value, spec: str) -> str:
+            return "-" if value is None else format(value, spec)
+
+        for proc in sorted(self.ranks):
+            r = self.ranks[proc]
+            idle = now - r["last_ts"] if r["last_ts"] else None
+            lines.append(
+                f"{proc:>4}  "
+                f"{fmt(r['last_step'], 'd'):>8}  "
+                f"{fmt(r['last_step_s'], '.4f'):>9}  "
+                f"{fmt(r['last_mfu'], '.3f'):>6}  "
+                f"{fmt(idle, '.1f'):>7}  "
+                f"{r['nan_skips']:>4}  {r['alerts']:>6}  {r['status']}"
+            )
+        for rec in self.supervisor[-3:]:
+            lines.append(
+                f"  supervisor: {rec.get('kind')} attempt "
+                f"{rec.get('attempt')}"
+            )
+        return "\n".join(lines)
+
+
+def _fmt_alert(rec: dict) -> str:
+    return (f"ALERT [{rec.get('rule')}] rank {rec.get('proc')} "
+            f"step {rec.get('step')}: value {rec.get('value')} vs "
+            f"threshold {rec.get('threshold')}")
+
+
+def _tails(events_dir: str, known: dict[str, _Tail]) -> list[_Tail]:
+    for path in sorted(glob.glob(os.path.join(events_dir, "events-*.jsonl"))):
+        if path not in known:
+            known[path] = _Tail(path)
+    return list(known.values())
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("events_dir", help="directory holding events-*.jsonl")
+    ap.add_argument("--follow", action="store_true",
+                    help="keep tailing (one-shot status is the default)")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="poll interval in follow mode (default 2s)")
+    ap.add_argument("--max-seconds", type=float, default=None,
+                    help="stop following after this long (for scripting "
+                         "and tests; default: until interrupted)")
+    args = ap.parse_args(argv)
+
+    if not os.path.isdir(args.events_dir):
+        print(f"ddp_monitor: no such directory: {args.events_dir}",
+              file=sys.stderr)
+        return 1
+
+    state = GangState()
+    tails: dict[str, _Tail] = {}
+
+    def drain() -> list[dict]:
+        fresh_alerts = []
+        for tail in _tails(args.events_dir, tails):
+            for rec in tail.poll():
+                n_before = len(state.alerts)
+                state.ingest(rec)
+                fresh_alerts.extend(state.alerts[n_before:])
+        return fresh_alerts
+
+    if not args.follow:
+        drain()
+        if not state.ranks and not state.supervisor:
+            print(f"ddp_monitor: no event records under {args.events_dir}",
+                  file=sys.stderr)
+            return 1
+        print(state.table())
+        for rec in state.alerts:
+            print(_fmt_alert(rec))
+        return ALERT_EXIT if state.alerts else 0
+
+    t_end = (time.time() + args.max_seconds
+             if args.max_seconds is not None else None)
+    try:
+        while True:
+            for rec in drain():
+                print(_fmt_alert(rec))
+            if state.ranks:
+                print(state.table())
+                print("---")
+            if t_end is not None and time.time() >= t_end:
+                break
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        pass
+    return ALERT_EXIT if state.alerts else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -38,8 +38,7 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from distributeddataparallel_tpu.observability.events import (  # noqa: E402
-    TIMELINE_NAME,
-    merge_timeline,
+    load_timeline,
 )
 from distributeddataparallel_tpu.observability.goodput import (  # noqa: E402
     goodput_from_timeline,
@@ -47,26 +46,6 @@ from distributeddataparallel_tpu.observability.goodput import (  # noqa: E402
 from distributeddataparallel_tpu.observability.straggler import (  # noqa: E402
     straggler_report,
 )
-
-
-def load_timeline(events_dir: str) -> list[dict]:
-    """The merged timeline's records, merging per-worker files first if
-    the run never got to (or died during) its exit-time merge."""
-    path = os.path.join(events_dir, TIMELINE_NAME)
-    if not os.path.exists(path):
-        if merge_timeline(events_dir) is None:
-            return []
-    records = []
-    with open(path) as fh:
-        for line in fh:
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                records.append(json.loads(line))
-            except json.JSONDecodeError:
-                continue  # torn tail of a killed writer
-    return records
 
 
 def _fmt_bytes(n) -> str:
@@ -97,6 +76,8 @@ def analyze(records: list[dict]) -> dict:
         "exec_memory": [],
         "straggler": None,
         "restarts": [],
+        "alerts": [],
+        "run_summary": None,
     }
     if worker_procs:
         out["goodput"] = goodput_from_timeline(records, proc=worker_procs[0])
@@ -136,6 +117,21 @@ def analyze(records: list[dict]) -> dict:
                 "attempt": r.get("attempt"),
                 "failed": r.get("failed"),
             })
+        elif kind == "alert":
+            out["alerts"].append({
+                "rule": r.get("rule"),
+                "proc": r.get("proc"),
+                "step": r.get("step"),
+                "ts": r.get("ts"),
+                "value": r.get("value"),
+                "threshold": r.get("threshold"),
+            })
+        elif kind == "run_summary":
+            # Last one wins: the final incarnation's summary is the one
+            # that reflects the whole (resumed) run.
+            out["run_summary"] = {
+                k: v for k, v in r.items() if k not in ("v", "seq", "kind")
+            }
     return out
 
 
@@ -273,6 +269,56 @@ def render_markdown(a: dict, events_dir: str) -> str:
                 f"(failed: {r['failed']})"
             )
         lines.append("")
+
+    # -- Alerts -------------------------------------------------------
+    lines += ["## Alerts", ""]
+    if not a["alerts"]:
+        if a["run_summary"] is not None:
+            # run_summary proves the run is new enough to have alerting;
+            # silence genuinely means nothing fired.
+            lines.append("No alerts fired.")
+        else:
+            lines.append("No `alert` events — this run predates alerting "
+                         "or ran without `--alerts`.")
+    else:
+        by_rule: dict[str, list[dict]] = {}
+        for al in a["alerts"]:
+            by_rule.setdefault(str(al["rule"]), []).append(al)
+        lines += [
+            f"**{len(a['alerts'])} alert(s)** across "
+            f"{len(by_rule)} rule(s):",
+            "",
+            "| rule | count | first (step) | last (step) |",
+            "|---|---:|---:|---:|",
+        ]
+        for rule, als in sorted(by_rule.items()):
+            lines.append(
+                f"| {rule} | {len(als)} | {als[0].get('step')} | "
+                f"{als[-1].get('step')} |"
+            )
+    lines.append("")
+
+    # -- Run summary + trace ------------------------------------------
+    rs = a["run_summary"]
+    if rs:
+        lines += ["## Run summary", ""]
+        shown = ("windows", "steps_total", "mfu_mean", "step_s_p50",
+                 "step_s_p99", "live_hwm_bytes", "goodput", "restarts",
+                 "alerts_total", "status")
+        parts = [f"{k} `{rs[k]}`" for k in shown if rs.get(k) is not None]
+        lines += [", ".join(parts) + ".", "",
+                  "Gate this run against a baseline with "
+                  f"`python scripts/perf_gate.py {events_dir} "
+                  "--store RUNS_DIR --baseline NAME`.", ""]
+    lines += [
+        "## Trace",
+        "",
+        "Export this timeline for https://ui.perfetto.dev with "
+        f"`python scripts/ddp_trace.py {events_dir}` "
+        "(per-rank tracks, mfu/step_s/memory counters, "
+        "restart/nan/alert marks).",
+        "",
+    ]
     return "\n".join(lines) + "\n"
 
 

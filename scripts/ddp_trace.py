@@ -1,0 +1,85 @@
+#!/usr/bin/env python
+"""Export a run's gang timeline as Chrome/Perfetto trace JSON.
+
+Usage:
+    python scripts/ddp_trace.py EVENTS_DIR                 # -> EVENTS_DIR/trace.json
+    python scripts/ddp_trace.py EVENTS_DIR -o run.trace.json
+    python scripts/ddp_trace.py EVENTS_DIR --check         # validate only
+
+Merges the per-worker event files into ``timeline.jsonl`` first when
+the run died before its exit-time merge, then converts it with
+``observability.trace_export``: one track per rank plus the supervisor,
+spans as complete events, mfu/step_s/memory counter tracks, and
+nan_skip/restart/alert incidents as instant marks.  Open the output at
+https://ui.perfetto.dev (or chrome://tracing).
+
+Import-light on purpose: stdlib + the stdlib-only observability
+modules, never jax.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from distributeddataparallel_tpu.observability.events import (  # noqa: E402
+    load_timeline,
+)
+from distributeddataparallel_tpu.observability.trace_export import (  # noqa: E402
+    to_trace_events,
+    validate_trace,
+    write_trace,
+)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("events_dir", help="directory holding events-*.jsonl / "
+                                       "timeline.jsonl")
+    ap.add_argument("-o", "--out", default=None,
+                    help="output path (default EVENTS_DIR/trace.json)")
+    ap.add_argument("--check", action="store_true",
+                    help="validate the converted trace and exit without "
+                         "writing a file")
+    args = ap.parse_args(argv)
+
+    if not os.path.isdir(args.events_dir):
+        print(f"ddp_trace: no such directory: {args.events_dir}",
+              file=sys.stderr)
+        return 1
+    records = load_timeline(args.events_dir)
+    if not records:
+        print(f"ddp_trace: no event records under {args.events_dir}",
+              file=sys.stderr)
+        return 1
+
+    trace = to_trace_events(records)
+    problems = validate_trace(trace)
+    for p in problems:
+        print(f"ddp_trace: {p}", file=sys.stderr)
+    if problems:
+        return 1
+    n = len(trace["traceEvents"])
+    if args.check:
+        print(f"ddp_trace: OK — {n} trace events from {len(records)} records")
+        return 0
+
+    out = args.out or os.path.join(args.events_dir, "trace.json")
+    write_trace(trace, out)
+    counters = sorted({e["name"] for e in trace["traceEvents"]
+                       if e.get("ph") == "C"})
+    instants = sorted({e["name"] for e in trace["traceEvents"]
+                       if e.get("ph") == "i"})
+    print(f"ddp_trace: wrote {out} ({n} events; "
+          f"counters: {', '.join(counters) or 'none'}; "
+          f"incidents: {', '.join(instants) or 'none'})")
+    print("ddp_trace: open it at https://ui.perfetto.dev "
+          "(Trace -> Open trace file)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,178 @@
+#!/usr/bin/env python
+"""Cross-run perf regression gate: compare a run against a baseline.
+
+Usage:
+    # Gate an events dir (run_summary extracted from its timeline):
+    python scripts/perf_gate.py EVENTS_DIR --store runs/ --baseline main
+
+    # Gate a bench headline file (BENCH_*.json `parsed.headline`):
+    python scripts/perf_gate.py BENCH_r05.json --store runs/ --baseline bench
+
+    # Promote the current run to be the named baseline:
+    python scripts/perf_gate.py EVENTS_DIR --store runs/ --baseline main \
+        --update-baseline
+
+Exit codes: 0 = pass (or baseline updated), 1 = usage/IO error,
+3 = regression.  A metric missing on either side is reported and
+skipped ("missing"), never failed — a run that didn't enable --mfu
+must not fail the MFU gate silently; it must say so.
+
+RUN may be: an events directory (summary rebuilt from its merged
+timeline), a run_summary JSON file, or a BENCH_*.json whose
+``parsed.headline`` flat metrics are gated pairwise (direction inferred
+from the metric name: bubble/step_s/bytes/overhead/us/restart metrics
+are lower-better, everything else higher-better).
+
+Every gated run is also appended to the store's ``index.jsonl``, so the
+store accretes history whether or not the gate passes.
+
+Import-light on purpose: stdlib + the stdlib-only observability
+modules, never jax.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from distributeddataparallel_tpu.observability import baseline as bl  # noqa: E402
+from distributeddataparallel_tpu.observability.events import (  # noqa: E402
+    load_timeline,
+)
+
+REGRESS_EXIT = 3
+
+#: metric-name patterns that mean "lower is better" in bench headlines
+_LOWER_BETTER = re.compile(
+    r"(bubble|step_s|_s$|bytes|overhead|_us$|_ms$|restart|latency|skew)"
+)
+
+
+def _bench_direction(name: str) -> str:
+    return "lower" if _LOWER_BETTER.search(name) else "higher"
+
+
+def load_run(path: str) -> tuple[dict, str]:
+    """RUN argument -> (flat metric dict, source label)."""
+    if os.path.isdir(path):
+        records = load_timeline(path)
+        if not records:
+            raise ValueError(f"no event records under {path}")
+        return bl.run_summary_from_timeline(records), "events"
+    with open(path) as fh:
+        data = json.load(fh)
+    headline = data.get("parsed", {}).get("headline") or data.get("headline")
+    if isinstance(headline, dict):
+        flat = {k: v for k, v in headline.items()
+                if isinstance(v, (int, float)) and not isinstance(v, bool)}
+        if not flat:
+            raise ValueError(f"{path}: headline has no numeric metrics")
+        return flat, "bench"
+    if not isinstance(data, dict):
+        raise ValueError(f"{path}: not a JSON object")
+    return data, "summary"
+
+
+def gate_metrics_for(summary: dict, source: str,
+                     default_tol: float) -> dict[str, tuple[str, float]]:
+    """The metric set to gate: the fixed GATE_METRICS for trainer
+    summaries, or every shared numeric headline for bench files (with
+    name-inferred direction)."""
+    if source != "bench":
+        return bl.GATE_METRICS
+    return {
+        name: (_bench_direction(name), default_tol)
+        for name in sorted(summary)
+    }
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("run", help="events dir, run_summary JSON, or "
+                                "BENCH_*.json")
+    ap.add_argument("--store", required=True,
+                    help="runs store directory (index.jsonl + baselines/)")
+    ap.add_argument("--baseline", required=True,
+                    help="baseline name to gate against / update")
+    ap.add_argument("--threshold", action="append", default=[],
+                    metavar="METRIC=FRAC",
+                    help="per-metric relative tolerance override "
+                         "(repeatable), e.g. --threshold mfu_mean=0.02")
+    ap.add_argument("--default-threshold", type=float, default=0.05,
+                    help="tolerance for bench-headline metrics "
+                         "(default 0.05)")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="record this run as the named baseline instead "
+                         "of gating")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the comparison as JSON")
+    args = ap.parse_args(argv)
+
+    thresholds = {}
+    for spec in args.threshold:
+        name, sep, frac = spec.partition("=")
+        if not sep:
+            print(f"perf_gate: bad --threshold {spec!r} (want METRIC=FRAC)",
+                  file=sys.stderr)
+            return 1
+        try:
+            thresholds[name] = float(frac)
+        except ValueError:
+            print(f"perf_gate: bad --threshold value {frac!r}",
+                  file=sys.stderr)
+            return 1
+
+    try:
+        summary, source = load_run(args.run)
+    except (OSError, ValueError, json.JSONDecodeError) as exc:
+        print(f"perf_gate: cannot load run {args.run!r}: {exc}",
+              file=sys.stderr)
+        return 1
+
+    bl.append_run(args.store, summary, name=args.baseline, source=source)
+
+    if args.update_baseline:
+        path = bl.save_baseline(args.store, args.baseline, summary)
+        print(f"perf_gate: baseline {args.baseline!r} updated -> {path}")
+        return 0
+
+    base = bl.load_baseline(args.store, args.baseline)
+    if base is None:
+        print(f"perf_gate: no baseline {args.baseline!r} in {args.store}; "
+              f"record one with --update-baseline", file=sys.stderr)
+        return 1
+
+    result = bl.compare_to_baseline(
+        summary, base, thresholds=thresholds,
+        metrics=gate_metrics_for(summary, source, args.default_threshold),
+    )
+    if args.json:
+        print(json.dumps(result, indent=2))
+    else:
+        for c in result["checks"]:
+            mark = {"pass": "ok", "regress": "REGRESS",
+                    "missing": "missing"}[c["status"]]
+            if c["status"] == "missing":
+                print(f"  {c['metric']:<18} {mark:>8}  "
+                      f"(run={c['value']!r} baseline={c['baseline']!r})")
+            else:
+                print(f"  {c['metric']:<18} {mark:>8}  "
+                      f"run={c['value']:.6g} baseline={c['baseline']:.6g} "
+                      f"bound={c['bound']:.6g} ({c['direction']})")
+    if not result["ok"]:
+        print(f"perf_gate: REGRESSION vs {args.baseline!r}: "
+              + ", ".join(result["regressed"]), file=sys.stderr)
+        return REGRESS_EXIT
+    note = (f" ({len(result['missing'])} metric(s) missing, skipped)"
+            if result["missing"] else "")
+    print(f"perf_gate: pass vs {args.baseline!r}{note}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,329 @@
+"""In-loop alerting: rule arithmetic (spike vs rolling median, floors,
+storms, starvation, memory growth), the engine's rising-edge /
+hysteresis / no-refire discipline, --alerts spec parsing, the live
+monitor's exit-code contract, and the end-to-end dpp wiring (alert
+events + registry counters + run_summary + runs-store append)."""
+
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, "/root/repo")
+
+import dpp  # noqa: E402
+from distributeddataparallel_tpu.observability import (  # noqa: E402
+    EventLog,
+    MetricsRegistry,
+    events_path,
+    read_events,
+    read_runs,
+    validate_file,
+)
+from distributeddataparallel_tpu.observability.alerts import (  # noqa: E402
+    AlertEngine,
+    GoodputFloor,
+    LoaderStarvation,
+    MemoryGrowth,
+    MfuFloor,
+    RestartStorm,
+    StepTimeSpike,
+    parse_alert_spec,
+)
+
+sys.path.insert(0, os.path.join("/root/repo", "scripts"))
+import ddp_monitor  # noqa: E402
+
+
+def _engine(*rules, **kw):
+    return AlertEngine(list(rules), **kw)
+
+
+# ------------------------------------------------------ rule arithmetic
+
+
+def test_step_spike_fires_on_spike_not_on_steady_state():
+    eng = _engine(StepTimeSpike(factor=2.0, min_history=3))
+    for _ in range(5):
+        assert eng.observe(step=0, step_s=0.1) == []
+    fired = eng.observe(step=6, step_s=0.25)  # 2.5x the 0.1 median
+    assert [a["rule"] for a in fired] == ["step_spike"]
+    assert fired[0]["value"] == pytest.approx(0.25)
+    assert fired[0]["threshold"] == pytest.approx(0.2)
+
+
+def test_step_spike_needs_history():
+    eng = _engine(StepTimeSpike(factor=2.0, min_history=3))
+    # Fewer than min_history windows: even a huge value cannot fire —
+    # there is no median to compare against yet.
+    assert eng.observe(step=0, step_s=0.1) == []
+    assert eng.observe(step=1, step_s=99.0) == []
+
+
+def test_step_spike_hysteresis_no_refire_then_rearm():
+    eng = _engine(StepTimeSpike(factor=2.0, clear_factor=1.5))
+    for s in range(4):
+        eng.observe(step=s, step_s=0.1)
+    assert len(eng.observe(step=4, step_s=0.3)) == 1
+    # Still elevated (above the 1.5x clear bound): active, no re-fire.
+    assert eng.observe(step=5, step_s=0.25) == []
+    assert eng.firing == ["step_spike"]
+    # Back under the clear bound: clears silently...
+    assert eng.observe(step=6, step_s=0.1) == []
+    assert eng.firing == []
+    # ...and a NEW spike is a new rising edge.
+    assert len(eng.observe(step=7, step_s=0.5)) == 1
+    assert len(eng.fired) == 2
+
+
+def test_step_spike_adapts_to_regime_change():
+    # A sustained slowdown becomes the new normal: the spike window
+    # itself enters the history, so the median catches up and the rule
+    # clears instead of alerting forever.
+    eng = _engine(StepTimeSpike(factor=2.0, history=4))
+    for s in range(4):
+        eng.observe(step=s, step_s=0.1)
+    assert len(eng.observe(step=4, step_s=0.3)) == 1
+    for s in range(5, 10):
+        eng.observe(step=s, step_s=0.3)
+    assert eng.firing == []  # median is now 0.3: condition cleared
+
+
+def test_mfu_floor_skips_first_window_then_fires():
+    eng = _engine(MfuFloor(floor=0.3))
+    assert eng.observe(step=0, mfu=0.01) == []  # warm-up window
+    fired = eng.observe(step=1, mfu=0.01)
+    assert [a["rule"] for a in fired] == ["mfu_floor"]
+    # Recovery above floor*1.1 clears; a later dip re-fires.
+    eng.observe(step=2, mfu=0.5)
+    assert len(eng.observe(step=3, mfu=0.1)) == 1
+
+
+def test_mfu_floor_absent_signal_is_inert():
+    eng = _engine(MfuFloor(floor=0.3))
+    # No mfu key at all (run without --mfu): the rule must stay silent
+    # AND not consume its warm-up budget.
+    assert eng.observe(step=0) == []
+    assert eng.observe(step=1, mfu=0.9) == []  # this is window 1: skip
+    assert len(eng.observe(step=2, mfu=0.01)) == 1
+
+
+def test_goodput_floor_waits_for_min_elapsed():
+    eng = _engine(GoodputFloor(floor=0.5, min_elapsed_s=60.0))
+    assert eng.observe(step=0, goodput=0.1, elapsed_s=10.0) == []
+    fired = eng.observe(step=1, goodput=0.1, elapsed_s=61.0)
+    assert [a["rule"] for a in fired] == ["goodput_floor"]
+
+
+def test_restart_storm_fires_once_only():
+    eng = _engine(RestartStorm(max_restarts=2))
+    assert eng.observe(step=0, restarts=1) == []
+    assert len(eng.observe(step=1, restarts=2)) == 1
+    # Monotone: stays active forever, never re-fires.
+    assert eng.observe(step=2, restarts=3) == []
+    assert len(eng.fired) == 1
+
+
+def test_loader_starvation_needs_consecutive_empty_windows():
+    eng = _engine(LoaderStarvation(windows=3))
+    assert eng.observe(step=0, prefetch_depth=0) == []
+    assert eng.observe(step=1, prefetch_depth=2) == []  # streak reset
+    assert eng.observe(step=2, prefetch_depth=0) == []
+    assert eng.observe(step=3, prefetch_depth=0) == []
+    assert len(eng.observe(step=4, prefetch_depth=0)) == 1
+
+
+def test_memory_growth_fires_on_hwm_above_settled_baseline():
+    eng = _engine(MemoryGrowth(frac=0.10, settle_windows=2))
+    assert eng.observe(step=0, live_hwm_bytes=1000) == []  # settling
+    assert eng.observe(step=1, live_hwm_bytes=1000) == []  # baseline set
+    assert eng.observe(step=2, live_hwm_bytes=1050) == []  # +5%: under
+    fired = eng.observe(step=3, live_hwm_bytes=1200)       # +20%
+    assert [a["rule"] for a in fired] == ["mem_growth"]
+    assert fired[0]["baseline_bytes"] == 1000
+    # HWM is monotone: never clears, never re-fires.
+    assert eng.observe(step=4, live_hwm_bytes=5000) == []
+
+
+# --------------------------------------------------------- spec parsing
+
+
+def test_parse_alert_spec_defaults_and_overrides():
+    rules = {r.name: r for r in parse_alert_spec("")}
+    assert set(rules) == {"step_spike", "mfu_floor", "goodput_floor",
+                          "restart_storm", "loader_starved", "mem_growth"}
+    rules = {r.name: r for r in parse_alert_spec(
+        "mfu_floor=0.3, step_spike=2.5, restart_storm=5"
+    )}
+    assert rules["mfu_floor"].floor == pytest.approx(0.3)
+    assert rules["step_spike"].factor == pytest.approx(2.5)
+    assert rules["restart_storm"].max_restarts == 5
+    assert rules["goodput_floor"].floor == pytest.approx(0.5)  # default
+
+
+def test_parse_alert_spec_rejects_unknown_and_malformed():
+    with pytest.raises(ValueError, match="unknown alert rule"):
+        parse_alert_spec("mfu=0.3")
+    with pytest.raises(ValueError, match="needs a threshold"):
+        parse_alert_spec("mfu_floor")
+    with pytest.raises(ValueError, match="not a number"):
+        parse_alert_spec("mfu_floor=lots")
+    with pytest.raises(SystemExit):
+        dpp.parse_args(["--alerts", "bogus=1"])
+
+
+# ----------------------------------------------- engine event/registry
+
+
+def test_engine_emits_events_and_counters(tmp_path):
+    ev_dir = str(tmp_path)
+    reg = MetricsRegistry()
+    with EventLog(events_path(ev_dir, 0), 0) as events:
+        eng = AlertEngine(
+            [MfuFloor(floor=0.3), RestartStorm(max_restarts=1)],
+            events=events, registry=reg,
+        )
+        eng.observe(step=0, mfu=0.9, restarts=0)
+        eng.observe(step=1, mfu=0.01, restarts=1)  # both fire
+    recs = [r for r in read_events(events_path(ev_dir, 0))
+            if r["kind"] == "alert"]
+    assert {r["rule"] for r in recs} == {"mfu_floor", "restart_storm"}
+    assert validate_file(events_path(ev_dir, 0)) == []
+    assert reg.counter("alerts_total").value == 2
+    assert reg.counter("alerts_mfu_floor").value == 1
+    assert eng.summary() == {
+        "total": 2, "by_rule": {"mfu_floor": 1, "restart_storm": 1},
+    }
+
+
+# -------------------------------------------------------- live monitor
+
+
+def _write_events(path, proc, recs):
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as fh:
+        for i, r in enumerate(recs):
+            fh.write(json.dumps({
+                "v": 1, "ts": 1000.0 + i, "seq": i, "proc": proc, **r,
+            }) + "\n")
+
+
+def test_monitor_one_shot_healthy_exits_zero(tmp_path, capsys):
+    ev_dir = str(tmp_path)
+    _write_events(events_path(ev_dir, 0), 0, [
+        {"kind": "run_start", "argv": []},
+        {"kind": "span", "name": "step", "dur_s": 0.1, "step": 7},
+        {"kind": "mfu", "step": 7, "model_flops_per_s": 1e9, "mfu": 0.41},
+    ])
+    assert ddp_monitor.main([ev_dir]) == 0
+    out = capsys.readouterr().out
+    assert "0.41" in out and "running" in out
+
+
+def test_monitor_one_shot_alert_exits_two(tmp_path, capsys):
+    ev_dir = str(tmp_path)
+    _write_events(events_path(ev_dir, 0), 0, [
+        {"kind": "run_start", "argv": []},
+        {"kind": "alert", "rule": "mfu_floor", "step": 20,
+         "value": 0.01, "threshold": 0.3},
+        {"kind": "nan_skip", "step": 21},
+    ])
+    _write_events(
+        os.path.join(ev_dir, "events-supervisor.jsonl"), "supervisor",
+        [{"kind": "restart_attempt", "attempt": 1}],
+    )
+    assert ddp_monitor.main([ev_dir]) == ddp_monitor.ALERT_EXIT
+    out = capsys.readouterr().out
+    assert "ALERT [mfu_floor]" in out
+    assert "restart_attempt" in out
+
+
+def test_monitor_empty_dir_exits_one(tmp_path):
+    assert ddp_monitor.main([str(tmp_path)]) == 1
+
+
+def test_monitor_tail_ignores_torn_partial_line(tmp_path):
+    ev_dir = str(tmp_path)
+    path = events_path(ev_dir, 0)
+    _write_events(path, 0, [{"kind": "run_start", "argv": []}])
+    with open(path, "a") as fh:
+        fh.write('{"v": 1, "ts": 1002.0, "seq": 9, "proc": 0, "kin')
+    tail = ddp_monitor._Tail(path)
+    recs = tail.poll()
+    assert [r["kind"] for r in recs] == ["run_start"]
+    offset = tail.offset
+    # The torn line is NOT consumed; completing it makes it readable.
+    with open(path, "a") as fh:
+        fh.write('d": "nan_skip", "step": 3}\n')
+    assert tail.offset == offset
+    assert [r["kind"] for r in tail.poll()] == ["nan_skip"]
+
+
+def test_monitor_follow_mode_terminates_on_budget(tmp_path, capsys):
+    ev_dir = str(tmp_path)
+    _write_events(events_path(ev_dir, 0), 0, [
+        {"kind": "run_start", "argv": []},
+        {"kind": "alert", "rule": "step_spike", "step": 40,
+         "value": 0.5, "threshold": 0.2},
+    ])
+    rc = ddp_monitor.main(
+        [ev_dir, "--follow", "--interval", "0.05", "--max-seconds", "0.2"]
+    )
+    assert rc == ddp_monitor.ALERT_EXIT
+    assert "ALERT [step_spike]" in capsys.readouterr().out
+
+
+# ------------------------------------------- end-to-end: dpp wiring
+
+
+def test_train_alerts_run_summary_and_runs_store(
+    devices, tmp_path, monkeypatch,
+):
+    """In-process train with --alerts + --runs-dir: a restart_storm rule
+    armed at threshold 1 fires off the env restart counter at the first
+    window boundary, the run_summary event carries window stats, and the
+    runs store gains one trainer-source line."""
+    ev_dir = str(tmp_path / "events")
+    runs_dir = str(tmp_path / "runs")
+    # Pretend this incarnation is a respawn: restart_storm=1 must fire
+    # at the first throughput-window boundary.
+    monkeypatch.setenv("DDP_RESTART_ATTEMPT", "1")
+    args = dpp.parse_args([
+        "--device", "cpu", "--fake-devices", "8",
+        "--model", "mlp", "--dataset", "synthetic",
+        "--num-examples", "768", "--batch-size", "4",
+        "--epochs", "1", "--log-every", "10",
+        "--events-dir", ev_dir, "--metrics-every", "0",
+        "--alerts", "restart_storm=1",
+        "--runs-dir", runs_dir,
+    ])
+    dpp.train(args)
+
+    recs = read_events(events_path(ev_dir, 0))
+    assert validate_file(events_path(ev_dir, 0)) == []
+    alerts = [r for r in recs if r["kind"] == "alert"]
+    assert [a["rule"] for a in alerts] == ["restart_storm"]
+    assert alerts[0]["value"] == 1
+
+    summaries = [r for r in recs if r["kind"] == "run_summary"]
+    assert len(summaries) == 1
+    rs = summaries[0]
+    # StepTimer window floor is 20: 24 steps - 1 compile step = 23
+    # post-compile steps -> exactly one window reading.
+    assert rs["windows"] == 1
+    assert rs["step_s_p50"] is not None and rs["step_s_p50"] > 0
+    assert rs["restarts"] == 1
+    assert rs["alerts_total"] == 1
+    assert rs["status"] == "ok"
+    # run_summary precedes run_end in the same log.
+    kinds = [r["kind"] for r in recs]
+    assert kinds.index("run_summary") < kinds.index("run_end")
+
+    runs = read_runs(runs_dir)
+    assert len(runs) == 1
+    assert runs[0]["source"] == "trainer"
+    assert runs[0]["windows"] == 1 and runs[0]["alerts_total"] == 1
+
+    # The live monitor sees the firing alert: non-zero for scripting.
+    assert ddp_monitor.main([ev_dir]) == ddp_monitor.ALERT_EXIT

@@ -1,0 +1,388 @@
+"""Trace export + longitudinal baseline store: trace_event JSON
+round-trip (per-track monotonic timestamps, rank->pid mapping, counter
+samples, instant incidents), the streaming heap-merge, run-summary
+extraction, perf-gate threshold logic (pass / regress / missing-metric
+degrade / --update-baseline), and the acceptance path — a supervised
+chaos run whose timeline exports to a schema-valid Perfetto trace with
+the restart visible as an instant event."""
+
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, "/root/repo")
+
+import dpp  # noqa: E402
+from distributeddataparallel_tpu.observability import (  # noqa: E402
+    events_path,
+    load_timeline,
+    merge_timeline,
+    read_events,
+    read_runs,
+)
+from distributeddataparallel_tpu.observability.baseline import (  # noqa: E402
+    RunSummaryBuilder,
+    compare_to_baseline,
+    run_summary_from_timeline,
+)
+from distributeddataparallel_tpu.observability.trace_export import (  # noqa: E402
+    to_trace_events,
+    validate_trace,
+)
+from distributeddataparallel_tpu.runtime.launcher import spawn  # noqa: E402
+
+sys.path.insert(0, os.path.join("/root/repo", "scripts"))
+import ddp_report  # noqa: E402
+import ddp_trace  # noqa: E402
+import perf_gate  # noqa: E402
+
+
+def _rec(kind, ts, proc=0, seq=0, **fields):
+    return {"v": 1, "ts": ts, "seq": seq, "proc": proc, "kind": kind,
+            **fields}
+
+
+def _synthetic_timeline():
+    """Two ranks + supervisor: spans, mfu/memory gauges, a nan skip, a
+    restart, and an alert — every mapping the exporter implements."""
+    return [
+        _rec("run_start", 100.0, proc=0, argv=[]),
+        _rec("run_start", 100.0, proc=1, argv=[]),
+        _rec("span", 101.0, proc=0, seq=1, name="step", dur_s=0.5, step=0),
+        _rec("span", 101.1, proc=1, seq=1, name="step", dur_s=0.6, step=0),
+        _rec("mfu", 101.2, proc=0, seq=2, step=0,
+             model_flops_per_s=1e9, mfu=0.41, hfu=0.45),
+        _rec("memory", 101.3, proc=0, seq=3, step=0,
+             live_bytes=1_000_000, live_hwm_bytes=1_200_000),
+        _rec("nan_skip", 101.4, proc=1, seq=2, step=1),
+        _rec("alert", 101.5, proc=0, seq=4, rule="mfu_floor", step=1,
+             value=0.01, threshold=0.3),
+        _rec("restart_attempt", 102.0, proc="supervisor", attempt=1),
+        _rec("span", 103.0, proc=0, seq=5, name="step", dur_s=0.4, step=1),
+        _rec("run_end", 104.0, proc=0, seq=6, status="ok"),
+    ]
+
+
+# -------------------------------------------------------- trace export
+
+
+def test_trace_export_round_trip_valid():
+    trace = to_trace_events(_synthetic_timeline())
+    assert validate_trace(trace) == []
+    # Round-trips through JSON (what ddp_trace.py writes).
+    assert validate_trace(json.loads(json.dumps(trace))) == []
+    assert trace["displayTimeUnit"] == "ms"
+
+
+def test_trace_export_rank_to_pid_mapping_and_metadata():
+    trace = to_trace_events(_synthetic_timeline())
+    names = {
+        e["pid"]: e["args"]["name"]
+        for e in trace["traceEvents"]
+        if e["ph"] == "M" and e["name"] == "process_name"
+    }
+    assert names == {0: "supervisor", 1: "rank 0", 2: "rank 1"}
+
+
+def test_trace_export_spans_counters_instants():
+    trace = to_trace_events(_synthetic_timeline())
+    evs = trace["traceEvents"]
+    spans = [e for e in evs if e["ph"] == "X"]
+    assert {e["name"] for e in spans} == {"step"}
+    # Span start is ts - dur_s, converted to relative microseconds.
+    first = min(spans, key=lambda e: e["ts"])
+    assert first["ts"] == pytest.approx((101.0 - 0.5 - 100.0) * 1e6)
+    assert first["dur"] == pytest.approx(0.5 * 1e6)
+
+    counters = {e["name"] for e in evs if e["ph"] == "C"}
+    assert {"step_s", "mfu", "memory_bytes"} <= counters
+    mfu_samples = [e for e in evs if e["ph"] == "C" and e["name"] == "mfu"]
+    assert mfu_samples[0]["args"]["mfu"] == pytest.approx(0.41)
+
+    instants = {e["name"]: e for e in evs if e["ph"] == "i"}
+    assert {"nan_skip", "alert", "restart_attempt"} <= set(instants)
+    # The restart lands on the supervisor track with gang-wide scope.
+    assert instants["restart_attempt"]["pid"] == 0
+    assert instants["restart_attempt"]["s"] == "g"
+    assert instants["alert"]["args"]["rule"] == "mfu_floor"
+
+
+def test_trace_export_per_track_monotonic_timestamps():
+    trace = to_trace_events(_synthetic_timeline())
+    last = {}
+    for e in trace["traceEvents"]:
+        if e["ph"] == "M":
+            continue
+        key = (e["pid"], e["tid"])
+        assert e["ts"] >= last.get(key, -1.0)
+        last[key] = e["ts"]
+
+
+def test_trace_export_empty_and_foreign_kinds():
+    assert to_trace_events([]) == {"traceEvents": [],
+                                   "displayTimeUnit": "ms"}
+    # Unmapped kinds are skipped, not fatal.
+    trace = to_trace_events([_rec("metrics", 100.0, snapshot={})])
+    assert validate_trace(trace) == []
+
+
+def test_validate_trace_catches_breakage():
+    assert validate_trace({"nope": 1})
+    bad = {"traceEvents": [
+        {"ph": "X", "name": "a", "pid": 1, "tid": 0, "ts": 5.0, "dur": 1.0},
+        {"ph": "X", "name": "b", "pid": 1, "tid": 0, "ts": 2.0, "dur": 1.0},
+    ]}
+    assert any("regresses" in p for p in validate_trace(bad))
+    assert any("without dur" in p for p in validate_trace(
+        {"traceEvents": [{"ph": "X", "name": "a", "pid": 1, "tid": 0,
+                          "ts": 0.0}]}
+    ))
+
+
+# ------------------------------------------------- streaming heap-merge
+
+
+def test_merge_timeline_streams_sorted_with_torn_tail(tmp_path):
+    ev_dir = str(tmp_path)
+    with open(events_path(ev_dir, 0), "w") as fh:
+        for seq, ts in enumerate((100.0, 101.0, 103.0)):
+            fh.write(json.dumps(_rec("span", ts, proc=0, seq=seq,
+                                     name="step", dur_s=0.1)) + "\n")
+    with open(events_path(ev_dir, 1), "w") as fh:
+        for seq, ts in enumerate((100.5, 102.0)):
+            fh.write(json.dumps(_rec("span", ts, proc=1, seq=seq,
+                                     name="step", dur_s=0.1)) + "\n")
+        fh.write('{"v": 1, "ts": 104.0, "seq": 2, "proc"')  # torn tail
+    out = merge_timeline(ev_dir)
+    recs = read_events(out)
+    assert [r["ts"] for r in recs] == [100.0, 100.5, 101.0, 102.0, 103.0]
+    # Ties on ts order by (seq, proc) — same key as the old full sort.
+    assert merge_timeline(ev_dir) == out  # idempotent over its own output
+
+
+def test_load_timeline_merges_on_demand(tmp_path):
+    ev_dir = str(tmp_path)
+    assert load_timeline(ev_dir) == []
+    with open(events_path(ev_dir, 0), "w") as fh:
+        fh.write(json.dumps(_rec("run_start", 100.0, argv=[])) + "\n")
+    recs = load_timeline(ev_dir)
+    assert [r["kind"] for r in recs] == ["run_start"]
+    assert os.path.exists(os.path.join(ev_dir, "timeline.jsonl"))
+
+
+# ------------------------------------------------ run-summary extraction
+
+
+def test_run_summary_builder_percentiles():
+    b = RunSummaryBuilder()
+    for i in range(10):
+        b.sample(step_s=0.1 + 0.01 * i, mfu=0.4, live_hwm_bytes=1000 + i)
+    s = b.build(goodput={"goodput": 0.9, "buckets": {}}, restarts=2,
+                alerts_total=1)
+    assert s["windows"] == 10
+    assert s["step_s_p50"] == pytest.approx(0.15, abs=0.01)
+    assert s["step_s_p99"] == pytest.approx(0.19, abs=0.01)
+    assert s["mfu_mean"] == pytest.approx(0.4)
+    assert s["live_hwm_bytes"] == 1009
+    assert s["goodput"] == 0.9 and s["restarts"] == 2
+
+
+def test_run_summary_from_timeline_synthetic():
+    s = run_summary_from_timeline(_synthetic_timeline())
+    assert s["windows"] == 2  # two rank-0 step spans
+    assert s["steps_total"] == 2
+    assert s["mfu_mean"] == pytest.approx(0.41)
+    assert s["live_hwm_bytes"] == 1_200_000
+    assert s["alerts_total"] == 1
+    assert s["status"] == "ok"
+
+
+# ----------------------------------------------------------- perf gate
+
+
+def _summary(**over):
+    base = {"windows": 5, "steps_total": 100, "mfu_mean": 0.40,
+            "step_s_p50": 0.10, "step_s_p99": 0.14,
+            "live_hwm_bytes": 1_000_000, "goodput": 0.92, "restarts": 0}
+    base.update(over)
+    return base
+
+
+def test_perf_gate_update_then_pass_then_regress(tmp_path, capsys):
+    store = str(tmp_path / "runs")
+    good = tmp_path / "good.json"
+    good.write_text(json.dumps(_summary()))
+
+    assert perf_gate.main([str(good), "--store", store,
+                           "--baseline", "main",
+                           "--update-baseline"]) == 0
+    assert perf_gate.main([str(good), "--store", store,
+                           "--baseline", "main"]) == 0
+
+    # Synthetic 10% MFU regression against the stored baseline: the
+    # gate must fail with its distinct non-zero exit.
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps(_summary(mfu_mean=0.36)))
+    capsys.readouterr()
+    assert perf_gate.main([str(bad), "--store", store,
+                           "--baseline", "main"]) == perf_gate.REGRESS_EXIT
+    err = capsys.readouterr().err
+    assert "REGRESSION" in err and "mfu_mean" in err
+
+    # ...and passes after the baseline is deliberately moved.
+    assert perf_gate.main([str(bad), "--store", store,
+                           "--baseline", "main",
+                           "--update-baseline"]) == 0
+    assert perf_gate.main([str(bad), "--store", store,
+                           "--baseline", "main"]) == 0
+
+    # Every gating attempt accreted into the history store.
+    assert len(read_runs(store)) == 5
+
+
+def test_perf_gate_missing_metric_degrades_not_fails(tmp_path, capsys):
+    store = str(tmp_path / "runs")
+    base = tmp_path / "base.json"
+    base.write_text(json.dumps(_summary()))
+    assert perf_gate.main([str(base), "--store", store,
+                           "--baseline", "m", "--update-baseline"]) == 0
+    # A run without --mfu: mfu_mean absent -> reported missing, exit 0.
+    nomfu = tmp_path / "nomfu.json"
+    s = _summary()
+    del s["mfu_mean"]
+    nomfu.write_text(json.dumps(s))
+    capsys.readouterr()
+    assert perf_gate.main([str(nomfu), "--store", store,
+                           "--baseline", "m"]) == 0
+    out = capsys.readouterr().out
+    assert "missing" in out
+
+
+def test_perf_gate_threshold_override_and_counts(tmp_path):
+    store = str(tmp_path / "runs")
+    base = tmp_path / "base.json"
+    base.write_text(json.dumps(_summary()))
+    assert perf_gate.main([str(base), "--store", store,
+                           "--baseline", "m", "--update-baseline"]) == 0
+    drop = tmp_path / "drop.json"
+    drop.write_text(json.dumps(_summary(mfu_mean=0.37)))
+    # 7.5% drop: fails the default 5% tolerance...
+    assert perf_gate.main([str(drop), "--store", store,
+                           "--baseline", "m"]) == perf_gate.REGRESS_EXIT
+    # ...passes with the tolerance widened for that metric.
+    assert perf_gate.main([str(drop), "--store", store,
+                           "--baseline", "m",
+                           "--threshold", "mfu_mean=0.10"]) == 0
+    # New restarts are a regression at the default absolute 0.
+    crashy = tmp_path / "crashy.json"
+    crashy.write_text(json.dumps(_summary(restarts=2)))
+    assert perf_gate.main([str(crashy), "--store", store,
+                           "--baseline", "m"]) == perf_gate.REGRESS_EXIT
+
+
+def test_perf_gate_bench_headline_mode(tmp_path):
+    store = str(tmp_path / "runs")
+    bench = tmp_path / "BENCH_x.json"
+    bench.write_text(json.dumps({"parsed": {"headline": {
+        "gpt2_mfu": 0.40, "pipeline_1f1b_bubble": 0.25,
+    }}}))
+    assert perf_gate.main([str(bench), "--store", store,
+                           "--baseline", "bench",
+                           "--update-baseline"]) == 0
+    # Direction inference: mfu higher-better, bubble lower-better.
+    worse = tmp_path / "BENCH_y.json"
+    worse.write_text(json.dumps({"parsed": {"headline": {
+        "gpt2_mfu": 0.40, "pipeline_1f1b_bubble": 0.30,
+    }}}))
+    assert perf_gate.main([str(worse), "--store", store,
+                           "--baseline", "bench"]) == perf_gate.REGRESS_EXIT
+    better = tmp_path / "BENCH_z.json"
+    better.write_text(json.dumps({"parsed": {"headline": {
+        "gpt2_mfu": 0.44, "pipeline_1f1b_bubble": 0.25,
+    }}}))
+    assert perf_gate.main([str(better), "--store", store,
+                           "--baseline", "bench"]) == 0
+
+
+def test_compare_to_baseline_direction_arithmetic():
+    summary = _summary(step_s_p50=0.104, live_hwm_bytes=1_200_000)
+    res = compare_to_baseline(summary, _summary())
+    # +4% p50 is inside the 5% lower-better tolerance; +20% memory not.
+    by = {c["metric"]: c["status"] for c in res["checks"]}
+    assert by["step_s_p50"] == "pass"
+    assert by["live_hwm_bytes"] == "regress"
+    assert res["ok"] is False and res["regressed"] == ["live_hwm_bytes"]
+
+
+# ------------------------------------------------- acceptance: chaos run
+
+
+def test_acceptance_chaos_run_trace_and_store(devices, tmp_path):
+    """ISSUE acceptance: a supervised chaos run (nan injection + a
+    preemption-driven restart) exports a schema-valid Perfetto trace
+    with per-rank tracks, a counter track, and the restart as an
+    instant event; the supervisor appends a cross-incarnation
+    run_summary to the runs store; ddp_report grows an Alerts section
+    and the trace invocation hint."""
+    ev_dir = str(tmp_path / "events")
+    runs_dir = str(tmp_path / "runs")
+    ck = str(tmp_path / "ck")
+    base = [
+        "--device", "cpu", "--fake-devices", "8",
+        "--model", "mlp", "--dataset", "synthetic",
+        "--num-examples", "128", "--batch-size", "4",
+        "--epochs", "3", "--steps-per-epoch", "4", "--log-every", "1",
+        "--nan-guard",
+        "--checkpoint-dir", ck, "--resume",
+    ]
+    spawn(
+        dpp._worker,
+        args=(base,),
+        nprocs=1,
+        max_restarts=1,
+        env={
+            "_DDP_SUPERVISED": "1",
+            # nan-grad@2: epoch 0 -> nan_skip.  preempt@6: dies after
+            # epoch 0's checkpoint -> supervisor restart_attempt.
+            "DDP_CHAOS": "nan-grad@2,preempt@6",
+            "DDP_CHAOS_STATE": os.path.join(ck, ".chaos"),
+        },
+        events_dir=ev_dir,
+        runs_dir=runs_dir,
+    )
+
+    # -- trace export ------------------------------------------------
+    out = str(tmp_path / "trace.json")
+    assert ddp_trace.main([ev_dir, "-o", out]) == 0
+    with open(out) as fh:
+        trace = json.load(fh)
+    assert validate_trace(trace) == []
+    evs = trace["traceEvents"]
+    pids = {e["pid"] for e in evs}
+    assert 0 in pids and 1 in pids  # supervisor track + rank-0 track
+    assert any(e["ph"] == "C" and e["name"] == "step_s" for e in evs)
+    restart_marks = [e for e in evs if e["ph"] == "i"
+                     and e["name"] == "restart_attempt"]
+    assert restart_marks and restart_marks[0]["pid"] == 0
+    assert any(e["ph"] == "i" and e["name"] == "nan_skip" for e in evs)
+
+    # -- runs store (supervisor summary spans both incarnations) ------
+    runs = read_runs(runs_dir)
+    sup = [r for r in runs if r.get("source") == "supervisor"]
+    assert len(sup) == 1
+    assert sup[0]["restarts"] == 1
+    assert sup[0]["windows"] > 0  # step spans from both incarnations
+
+    # -- report degrade/alert surfacing -------------------------------
+    md = ddp_report.render_markdown(
+        ddp_report.analyze(load_timeline(ev_dir)), ev_dir
+    )
+    assert "## Alerts" in md
+    # Run had --runs-dir (so a run_summary) but no --alerts: the section
+    # degrades to the explicit no-alerts line, not the predates-alerting
+    # one.
+    assert "No alerts fired." in md
+    assert "## Run summary" in md
+    assert "ddp_trace.py" in md  # the trace invocation hint
