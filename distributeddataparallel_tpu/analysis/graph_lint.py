@@ -13,7 +13,11 @@ What is checked (rule ids in ``analysis.rules``):
 - GL001: the gradient-reduction collectives per mesh axis match the
   factory's manifest (exactly one leaf-wise psum family for plain DP,
   reduce_scatter+all_gather for ZeRO/FSDP, ppermute on the pipe axis,
-  ...) — a dropped psum or a doubled sync is a count mismatch;
+  ...) — a dropped psum or a doubled sync is a count mismatch.  The
+  walk descends into scan/while/cond bodies and custom_vjp call
+  jaxprs, and a loop-carried collective counts once per scan trip
+  (a psum inside a scanned microbatch loop is accum_steps syncs, not
+  one);
 - GL002: the collective *sequence* fingerprint is stable across two
   independent traces — the determinism every gang relies on (all ranks
   must issue collectives in the same order), and the artifact to
@@ -69,19 +73,57 @@ _DONATION_RE = re.compile(r"tf\.aliasing_output|jax\.buffer_donor")
 
 @dataclasses.dataclass(frozen=True)
 class Collective:
-    """One collective eqn seen in the jaxpr walk (deterministic order)."""
+    """One collective eqn seen in the jaxpr walk (deterministic order).
+
+    ``trip`` is the product of statically-known enclosing loop trip
+    counts (scan lengths): the number of times this eqn EXECUTES per
+    step.  ``None`` means an enclosing ``while`` has no static trip
+    count.  ``loop_depth`` counts enclosing scan/while bodies — 0 for
+    straight-line collectives.  Neither enters ``key()``: the GL002
+    fingerprint hashes the program text order, not the runtime
+    multiplicity (a scan-length change is a shape change and already
+    perturbs ``shapes``).
+    """
 
     prim: str
     axes: tuple[str, ...]
     shapes: tuple[tuple[int, ...], ...]
     dtypes: tuple[str, ...]
+    trip: int | None = 1
+    loop_depth: int = 0
 
     @property
     def nonscalar(self) -> bool:
         return any(len(s) > 0 for s in self.shapes)
 
+    @property
+    def effective_count(self) -> int:
+        """How many times this collective runs per step — 1 for an
+        unknown (while) trip, which keeps GL001 a lower bound there."""
+        return self.trip if self.trip else 1
+
     def key(self) -> tuple:
         return (self.prim, self.axes, self.shapes, self.dtypes)
+
+
+#: eqn params that hold a LOOP body jaxpr, with the params key carrying
+#: the static trip count (None = data-dependent, e.g. while_loop)
+_LOOP_BODY_PARAMS = {
+    "scan": (("jaxpr",), "length"),
+    "while": (("body_jaxpr",), None),
+}
+#: while params that are walked but NOT loop-carried (run once per trip
+#: decision, and a collective there is as wrong as one in the body — but
+#: trip accounting treats it the same as the body: unknown)
+_WHILE_COND_PARAMS = ("cond_jaxpr",)
+
+
+def _as_jaxpr(it):
+    if hasattr(it, "eqns"):              # raw Jaxpr
+        return it
+    if hasattr(it, "jaxpr"):             # ClosedJaxpr
+        return it.jaxpr
+    return None
 
 
 def _subjaxprs(params: dict):
@@ -90,10 +132,46 @@ def _subjaxprs(params: dict):
     for v in params.values():
         items = v if isinstance(v, (list, tuple)) else (v,)
         for it in items:
-            if hasattr(it, "eqns"):           # raw Jaxpr
-                yield it
-            elif hasattr(it, "jaxpr"):        # ClosedJaxpr
-                yield it.jaxpr
+            jx = _as_jaxpr(it)
+            if jx is not None:
+                yield jx
+
+
+def _subjaxprs_ctx(eqn):
+    """Yield ``(jaxpr, trip, entering_loop)`` for every jaxpr nested in
+    one eqn — the loop-aware twin of ``_subjaxprs``.  ``trip`` is the
+    eqn's static trip count for loop bodies (scan ``length``; ``None``
+    for ``while``) and 1 for non-loop nesting (pjit/shard_map/cond
+    branches/custom_vjp call jaxprs, which run once per enclosing
+    execution)."""
+    prim = eqn.primitive.name
+    loop_spec = _LOOP_BODY_PARAMS.get(prim)
+    if loop_spec is None:
+        for jx in _subjaxprs(eqn.params):
+            yield jx, 1, False
+        return
+    body_keys, length_key = loop_spec
+    trip = eqn.params.get(length_key) if length_key else None
+    trip = int(trip) if isinstance(trip, int) else None
+    seen_keys = set(body_keys) | set(_WHILE_COND_PARAMS)
+    for k in body_keys:
+        jx = _as_jaxpr(eqn.params.get(k))
+        if jx is not None:
+            yield jx, trip, True
+    for k in _WHILE_COND_PARAMS:
+        jx = _as_jaxpr(eqn.params.get(k))
+        if jx is not None:
+            yield jx, trip, True
+    # anything else nested in a loop eqn's params (none today, but a
+    # future primitive must not silently escape the walk)
+    for k, v in eqn.params.items():
+        if k in seen_keys:
+            continue
+        items = v if isinstance(v, (list, tuple)) else (v,)
+        for it in items:
+            jx = _as_jaxpr(it)
+            if jx is not None:
+                yield jx, 1, False
 
 
 def _axes_of(params: dict) -> tuple[str, ...]:
@@ -107,19 +185,34 @@ def _axes_of(params: dict) -> tuple[str, ...]:
     return (str(axes),)
 
 
+def walk_jaxpr_loops(jaxpr):
+    """Depth-first deterministic walk yielding ``(eqn, trip, depth)``:
+    every eqn (nested included — scan/while/cond bodies and custom_vjp
+    call jaxprs), the product of statically-known enclosing loop trip
+    counts (``None`` once any enclosing loop is a while), and the
+    number of enclosing loop bodies."""
+    stack = [(jaxpr, 1, 0)]
+    while stack:
+        jx, trip, depth = stack.pop()
+        for eqn in jx.eqns:
+            yield eqn, trip, depth
+            for sub, sub_trip, is_loop in _subjaxprs_ctx(eqn):
+                if sub_trip is None or trip is None:
+                    new_trip = None
+                else:
+                    new_trip = trip * sub_trip
+                stack.append((sub, new_trip, depth + int(is_loop)))
+
+
 def walk_jaxpr(jaxpr):
     """Depth-first deterministic walk over every eqn, nested included."""
-    stack = [jaxpr]
-    while stack:
-        jx = stack.pop()
-        for eqn in jx.eqns:
-            yield eqn
-            stack.extend(_subjaxprs(eqn.params))
+    for eqn, _trip, _depth in walk_jaxpr_loops(jaxpr):
+        yield eqn
 
 
 def collect_collectives(closed_jaxpr) -> list[Collective]:
     out = []
-    for eqn in walk_jaxpr(closed_jaxpr.jaxpr):
+    for eqn, trip, depth in walk_jaxpr_loops(closed_jaxpr.jaxpr):
         name = eqn.primitive.name
         if name in COLLECTIVE_PRIMS:
             out.append(Collective(
@@ -131,6 +224,8 @@ def collect_collectives(closed_jaxpr) -> list[Collective]:
                 dtypes=tuple(
                     str(getattr(v.aval, "dtype", "?")) for v in eqn.invars
                 ),
+                trip=trip,
+                loop_depth=depth,
             ))
     return out
 
@@ -196,6 +291,9 @@ class GraphReport:
     collective_counts: dict
     donated_args: int | None = None
     donation_expected: int | None = None
+    #: traced Collective records (for downstream passes — e.g. the
+    #: schedule lint counts hop collectives without retracing)
+    collectives: list | None = None
 
     @property
     def ok(self) -> bool:
@@ -208,8 +306,14 @@ def _check_counts(colls, manifest, n_param_leaves, where) -> list[Finding]:
     for c in colls:
         if not c.nonscalar:
             continue
+        # Loop-carried collectives count once per EXECUTION (scan trip
+        # count), not once per program-text occurrence — a psum inside a
+        # scanned microbatch loop is accum_steps syncs, the classic
+        # per-microbatch-sync bug GL001 exists to catch.
         for ax in c.axes:
-            counts[(ax, c.prim)] = counts.get((ax, c.prim), 0) + 1
+            counts[(ax, c.prim)] = (
+                counts.get((ax, c.prim), 0) + c.effective_count
+            )
 
     grad_reduce = manifest["grad_reduce"]
     for axis, prims in grad_reduce.items():
@@ -366,7 +470,7 @@ def lint_train_step(
         if c.nonscalar:
             for ax in c.axes:
                 k = f"{ax}:{c.prim}"
-                counts[k] = counts.get(k, 0) + 1
+                counts[k] = counts.get(k, 0) + c.effective_count
     return GraphReport(
         mode=mode or manifest["mode"],
         findings=findings,
@@ -374,6 +478,7 @@ def lint_train_step(
         collective_counts=counts,
         donated_args=donated,
         donation_expected=expected,
+        collectives=colls,
     )
 
 

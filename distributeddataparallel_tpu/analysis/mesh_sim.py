@@ -1,0 +1,324 @@
+"""Compile-only mesh simulation: lint and size a config at scales the
+dev box doesn't have.
+
+``XLA_FLAGS=--xla_force_host_platform_device_count=N`` gives jax N fake
+CPU devices; everything the static-analysis layer needs — tracing,
+AOT lowering, shard-flow lint, schedule lint, and the compiler's
+``memory_analysis()`` — works on abstract ``ShapeDtypeStruct`` state
+with zero parameter memory materialized and zero steps executed.  So
+"does gpt2-small fit per chip at dp=64, and is its collective graph
+clean?" becomes a question answered in seconds on a laptop, before any
+TPU time is spent.
+
+The entry point is ``simulate()``, which must run in a process whose
+device count was forced BEFORE jax imported — ``scripts/ddp_meshsim.py``
+handles the subprocess-per-device-count orchestration and this module
+never touches ``XLA_FLAGS`` itself.
+
+The returned record is baseline-store compatible: flat numeric byte
+metrics live under a top-level ``"headline"`` dict, which
+``scripts/perf_gate.py`` gates pairwise with lower-is-better direction
+(the ``bytes`` suffix), so a config change that regresses the predicted
+per-chip footprint at scale fails the gate the same way a slow step
+does.  Memory fit follows the ``exec_memory`` convention
+(``observability.memory.executable_memory_analysis``): required =
+argument + output − alias + temp + generated code, all per-device.
+"""
+
+from __future__ import annotations
+
+#: model registry: name -> builder kind (kept declarative so the CLI
+#: and the docs list the same names)
+MODELS = ("cnn", "mlp", "tiny-lm", "gpt2-small")
+
+#: modes the simulator can lower (subset of the live factories that
+#: support AOT lowering on abstract state)
+MODES = ("dp", "zero", "fsdp", "pp")
+
+
+def _build_case(model: str, mode: str, mesh, batch_per_chip: int,
+                seq: int):
+    """(step, abstract state, abstract batch, abstract rng, loss kind).
+
+    All state is built with ``jax.eval_shape`` — nothing allocates.
+    """
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    import distributeddataparallel_tpu as ddp
+
+    n_data = mesh.shape["data"]
+    rows = batch_per_chip * n_data
+    rng = jax.ShapeDtypeStruct((2,), jnp.uint32)
+
+    if model in ("cnn", "mlp"):
+        from distributeddataparallel_tpu.models import SimpleCNN, TinyMLP
+
+        net = SimpleCNN() if model == "cnn" else TinyMLP()
+        x_init = jnp.zeros((1, 8, 8, 1), jnp.float32) if model == "cnn" \
+            else jnp.zeros((1, 64), jnp.float32)
+        batch = {
+            "image": jax.ShapeDtypeStruct(
+                (rows, 8, 8, 1) if model == "cnn" else (rows, 64),
+                jnp.float32,
+            ),
+            "label": jax.ShapeDtypeStruct((rows,), jnp.int32),
+        }
+
+        def loss_fn(params, b, _rng):
+            from distributeddataparallel_tpu.ops.losses import (
+                cross_entropy_loss,
+            )
+
+            logits = net.apply({"params": params}, b["image"])
+            return cross_entropy_loss(logits, b["label"]), {}
+
+        params_shape = jax.eval_shape(
+            lambda k: net.init(k, x_init)["params"], jax.random.PRNGKey(0)
+        )
+    else:
+        from distributeddataparallel_tpu.models import TransformerLM
+        from distributeddataparallel_tpu.models.transformer import (
+            gpt2_124m,
+            tiny_lm,
+        )
+        from distributeddataparallel_tpu.ops.losses import lm_cross_entropy
+
+        cfg = gpt2_124m(scan_layers=True) if model == "gpt2-small" \
+            else tiny_lm(scan_layers=True, num_layers=4)
+        seq = min(seq, cfg.max_seq_len)
+        net = TransformerLM(cfg)
+        batch = {
+            "tokens": jax.ShapeDtypeStruct((rows, seq + 1), jnp.int32),
+        }
+
+        def loss_fn(params, b, _rng):
+            toks = b["tokens"]
+            logits = net.apply(
+                {"params": params}, toks[:, :-1], deterministic=True
+            )
+            return lm_cross_entropy(logits, toks[:, 1:]), {}
+
+        params_shape = jax.eval_shape(
+            lambda k: net.init(k, jnp.zeros((1, 8), jnp.int32))["params"],
+            jax.random.PRNGKey(0),
+        )
+
+    tx = optax.adam(1e-3)
+
+    if mode in ("dp", "zero"):
+        from distributeddataparallel_tpu.training.train_step import (
+            make_train_step,
+        )
+
+        step = make_train_step(loss_fn, mesh=mesh, zero=(mode == "zero"))
+        if mode == "zero":
+            from distributeddataparallel_tpu.parallel.zero import zero_state
+
+            state = jax.eval_shape(
+                lambda p: zero_state(
+                    apply_fn=None, params=p, tx=tx, mesh=mesh
+                ),
+                params_shape,
+            )
+        else:
+            state = jax.eval_shape(
+                lambda p: ddp.TrainState.create(
+                    apply_fn=None, params=p, tx=tx
+                ),
+                params_shape,
+            )
+        return step, state, batch, rng
+
+    if mode == "fsdp":
+        if model in ("cnn", "mlp"):
+            raise ValueError("fsdp simulation requires a transformer model")
+        from distributeddataparallel_tpu.parallel.fsdp import (
+            fsdp_state,
+            make_fsdp_train_step,
+        )
+
+        # fsdp_state computes concrete flat offsets (numpy), so the
+        # state cannot stay abstract — materialize params once on host.
+        # The per-device residency is still 1/N; this is the one mode
+        # that pays real param memory during simulation.
+        params = jax.tree.map(
+            lambda s: jax.numpy.zeros(s.shape, s.dtype), params_shape
+        )
+        step = make_fsdp_train_step(cfg, mesh=mesh)
+        state = fsdp_state(cfg, params, tx, mesh)
+        return step, state, batch, rng
+
+    if mode == "pp":
+        if model in ("cnn", "mlp"):
+            raise ValueError("pp simulation requires a transformer model")
+        from distributeddataparallel_tpu.parallel.pipeline_parallel import (
+            make_pp_train_step,
+        )
+
+        step = make_pp_train_step(cfg, mesh=mesh, microbatches=2)
+        # abstract state only: the step's shard_map specs come from the
+        # factory, so placement (shard_state_pp) is irrelevant to
+        # lowering and the simulation never materializes the state
+        state = jax.eval_shape(
+            lambda p: ddp.TrainState.create(
+                apply_fn=None, params=p, tx=tx
+            ),
+            params_shape,
+        )
+        return step, state, batch, rng
+
+    raise ValueError(f"unknown simulation mode {mode!r} (have {MODES})")
+
+
+def _lowered(step, state, batch, rng):
+    """AOT-lower on abstract args.  ``make_train_step`` steps expose
+    ``.lower``; wrapper factories (fsdp/pp) populate ``.jitted`` when
+    traced, and ``make_jaxpr`` on abstract shapes is enough to do it."""
+    import jax
+
+    if getattr(step, "lower", None) is not None:
+        return jax.make_jaxpr(step)(state, batch, rng), \
+            step.lower(state, batch, rng)
+    jaxpr = jax.make_jaxpr(step)(state, batch, rng)
+    jitted = getattr(step, "jitted", None)
+    if jitted is None:
+        raise RuntimeError(
+            "step exposes neither .lower nor a .jitted populated by "
+            "tracing — cannot AOT-lower for simulation"
+        )
+    return jaxpr, jitted.lower(state, batch, rng)
+
+
+def simulate(
+    model: str = "gpt2-small",
+    mode: str = "dp",
+    *,
+    batch_per_chip: int = 2,
+    seq: int = 128,
+    pp_stages: int = 4,
+    do_compile: bool = True,
+    hbm_budget_bytes: int | None = None,
+) -> dict:
+    """Lower ``model`` x ``mode`` on the CURRENT device set (the fake
+    mesh the launcher forced), lint the lowered program, and predict
+    per-chip memory fit.  Returns the ``mesh_sim`` record."""
+    import jax
+
+    import distributeddataparallel_tpu as ddp
+    from distributeddataparallel_tpu.analysis import (
+        graph_lint,
+        schedule_lint,
+        shard_flow,
+    )
+    from distributeddataparallel_tpu.observability.memory import (
+        executable_memory_analysis,
+        hbm_budget_bytes as default_budget,
+    )
+
+    n = len(jax.devices())
+    budget = hbm_budget_bytes or default_budget()
+    if mode == "pp":
+        stages = min(pp_stages, n)
+        mesh = ddp.make_mesh(("data", "pipe"), shape=(n // stages, stages))
+    else:
+        mesh = ddp.make_mesh(("data",))
+
+    step, state, batch, rng = _build_case(
+        model, mode, mesh, batch_per_chip, seq
+    )
+    manifest = getattr(step, "collective_manifest", None) \
+        or graph_lint.default_manifest()
+    jaxpr, lowered = _lowered(step, state, batch, rng)
+    text = lowered.as_text()
+
+    # shard-flow lint over the lowered module (+ SF204 over the jaxpr)
+    leaves = jax.tree.leaves(state.params)
+    floor = max(
+        (int(l.size) * l.dtype.itemsize for l in leaves), default=None
+    )
+    findings = shard_flow.lint_custom_vjp(
+        jaxpr, manifest=manifest, where=f"sim:{model}:{mode}"
+    )
+    flow = shard_flow.lint_flow(
+        text, manifest=manifest, where=f"sim:{model}:{mode}",
+        hbm_budget_bytes=budget, grad_bytes_floor=floor,
+    )
+    findings += flow.findings
+
+    # schedule lint when the factory attached an IR (pp) or a bucket
+    # builder (bucketed dp)
+    ir = getattr(step, "schedule_ir", None)
+    if ir is None and getattr(step, "comm_schedule", None) is not None:
+        ir = step.comm_schedule(state.params)
+    if ir is not None:
+        hops = sum(
+            c.effective_count
+            for c in graph_lint.collect_collectives(jaxpr)
+            if c.prim == ir.hop_prim and ir.hop_axis in c.axes
+            and c.nonscalar
+        )
+        findings += schedule_lint.lint_schedule(
+            ir, manifest=manifest, traced_hops=hops,
+            bubble=getattr(step, "bubble_accounting", None),
+            where=f"sim:{model}:{mode}:{ir.kind}",
+        )
+
+    record = {
+        "record": "mesh_sim",
+        "model": model,
+        "mode": mode,
+        "devices": n,
+        "mesh": {ax: int(sz) for ax, sz in mesh.shape.items()},
+        "batch_per_chip": batch_per_chip,
+        "seq": seq,
+        "params_m": round(
+            sum(int(l.size) for l in leaves) / 1e6, 3
+        ),
+        "findings": [str(f) for f in findings],
+        "finding_rules": sorted({f.rule for f in findings}),
+        "collectives": _collective_census(flow.collectives),
+        "headline": {},
+    }
+
+    if do_compile:
+        compiled = lowered.compile()
+        mem = executable_memory_analysis(compiled)
+        if mem:
+            required = (
+                mem.get("argument_bytes", 0)
+                + mem.get("output_bytes", 0)
+                - mem.get("alias_bytes", 0)
+                + mem.get("temp_bytes", 0)
+                + mem.get("generated_code_bytes", 0)
+            )
+            record["memory"] = mem
+            record["fit"] = {
+                "required_bytes": int(required),
+                "budget_bytes": int(budget),
+                "fits": bool(required <= budget),
+            }
+            # gated metrics: lower is better for every *_bytes
+            record["headline"] = {
+                "sim_required_bytes": int(required),
+                "sim_temp_bytes": int(mem.get("temp_bytes", 0)),
+                "sim_argument_bytes": int(mem.get("argument_bytes", 0)),
+            }
+    return record
+
+
+def _collective_census(collectives) -> dict:
+    out: dict[str, int] = {}
+    for c in collectives:
+        out[c.op] = out.get(c.op, 0) + 1
+    return out
+
+
+def fingerprint(record: dict) -> str:
+    """Stable short id of a sim record's identity axes (what it
+    simulated, not what it measured) — the baseline-store join key."""
+    return (
+        f"{record['model']}:{record['mode']}:{record['devices']}"
+        f":b{record['batch_per_chip']}:s{record['seq']}"
+    )

@@ -1,6 +1,6 @@
 """ddplint rule registry: ids, descriptions, waivers, and manifests.
 
-The static-analysis subsystem checks the repo's SPMD invariants in two
+The static-analysis subsystem checks the repo's SPMD invariants in four
 layers (following the pjit-at-scale practice of validating the *lowered
 program* rather than trusting the Python source):
 
@@ -8,17 +8,51 @@ program* rather than trusting the Python source):
   train step (``analysis.graph_lint``) — they see what XLA will see, so
   a dropped ``psum`` or a lost ``donate_argnums`` cannot hide behind a
   refactor;
+- **sharding-flow rules (SF*)** run over the lowered StableHLO text of
+  a step (``analysis.shard_flow``) — they recover per-value shardings
+  and collective payloads to catch mis-shardings the jaxpr-level count
+  checks cannot see (a full-size all-reduce under ZeRO, a re-gather
+  inside a loop, a gather that cannot fit per-chip HBM);
+- **schedule rules (SL*)** run over the declarative schedule IR a
+  factory attaches as data (``analysis.schedule_lint``) — the pipeline
+  tick table and the grad-sync bucket order become lintable artifacts
+  instead of opaque code;
 - **AST rules (AL*)** run over the package source
   (``analysis.ast_rules``) — they catch host-side hot-path hazards
   (accidental device syncs, wall-clock/RNG inside traced code,
   swallowed exceptions, unregistered telemetry kinds) that never show
   up in a jaxpr because they happen *around* it.
 
+Rule-ID index (full descriptions in ``RULES``):
+
+======  =====  ==================================================
+id      layer  name
+======  =====  ==================================================
+GL001   graph  grad-reduce-count
+GL002   graph  collective-order
+GL003   graph  donation-coverage
+GL004   graph  dtype-promotion
+GL005   graph  host-callback
+SF201   flow   replicated-grad
+SF202   flow   reshard-in-loop
+SF203   flow   gather-exceeds-hbm
+SF204   flow   custom-vjp-opaque
+SL301   sched  schedule-malformed
+SL302   sched  schedule-collectives
+SL303   sched  cross-stage-donation
+SL304   sched  bubble-mismatch
+AL101   ast    host-sync
+AL102   ast    time-in-jit
+AL103   ast    broad-except
+AL104   ast    event-kind
+======  =====  ==================================================
+
 Waivers: AST findings can be waived per line with a pragma comment
 ``# ddplint: allow[<tag>]`` on the offending line (or the line directly
-above, for wrapped statements).  Graph rules have no pragma — they are
-driven by the step factory's collective manifest, so the factory itself
-declares what the lowered program is supposed to contain.
+above, for wrapped statements).  Graph/flow/schedule rules have no
+pragma — they are driven by the step factory's collective manifest and
+attached schedule IR, so the factory itself declares what the lowered
+program is supposed to contain.
 
 Module-import rule: stdlib only.  Both the AST layer and
 ``scripts/check_events.py`` import this file in jax-free interpreters.
@@ -64,6 +98,63 @@ RULES: dict[str, tuple[str, str, str, str]] = {
         "the jitted step (host round-trip serializes every step)",
         "none",
     ),
+    "SF201": (
+        "flow", "replicated-grad",
+        "gradient-sized all-reduce under a manifest that declares "
+        "sharded reduction (reduce_scatter) — the gradient is reduced "
+        "fully replicated, silently defeating the ZeRO/FSDP memory win",
+        "factory manifest (no reduce_scatter declared)",
+    ),
+    "SF202": (
+        "flow", "reshard-in-loop",
+        "reshard collective (all_gather/all_to_all) inside a loop body "
+        "re-gathering a loop-invariant value — the same bytes cross the "
+        "interconnect every iteration for an identical result",
+        "factory manifest (prim declared in grad_reduce)",
+    ),
+    "SF203": (
+        "flow", "gather-exceeds-hbm",
+        "all-gather whose gathered output is larger than the per-chip "
+        "HBM budget (observability.memory convention) — the program "
+        "cannot fit at this scale regardless of schedule",
+        "budget override (hbm_budget_bytes)",
+    ),
+    "SF204": (
+        "flow", "custom-vjp-opaque",
+        "collective or sharding-constraint hidden behind a custom_vjp "
+        "boundary whose backward rule is opaque to the flow pass — the "
+        "hand-written transpose can silently drop the sharding",
+        "factory manifest (custom_vjp_collectives_ok)",
+    ),
+    "SL301": (
+        "sched", "schedule-malformed",
+        "pipeline schedule table is not a valid pipeline: a (stage, "
+        "chunk, microbatch, phase) unit missing/duplicated, or a "
+        "microbatch reaching stage s+1 no later than stage s",
+        "none",
+    ),
+    "SL302": (
+        "sched", "schedule-collectives",
+        "per-stage collectives disagree with the schedule: the traced "
+        "boundary-hop count != ticks x hops-per-tick declared by the "
+        "IR, or the manifest does not declare the hop primitive",
+        "none",
+    ),
+    "SL303": (
+        "sched", "cross-stage-donation",
+        "schedule donates/overwrites a buffer another in-flight unit "
+        "still reads (saved-activation ring slot collision, or a "
+        "donated carry with live cross-stage consumers)",
+        "none",
+    ),
+    "SL304": (
+        "sched", "bubble-mismatch",
+        "analytic bubble fraction derived from the schedule table "
+        "disagrees with the compiled-schedule accounting "
+        "(pp_bubble_fraction) — the schedule-as-data drifted from the "
+        "code that runs",
+        "none",
+    ),
     "AL101": (
         "ast", "host-sync",
         "block_until_ready / .item() / float(<call>) / np.asarray in "
@@ -105,7 +196,8 @@ class Finding:
 
     @property
     def name(self) -> str:
-        return RULES[self.rule][1]
+        entry = RULES.get(self.rule)
+        return entry[1] if entry else "UNREGISTERED"
 
     def __str__(self) -> str:  # the CLI's one-line format
         return f"{self.where}: {self.rule} [{self.name}] {self.message}"
@@ -113,6 +205,13 @@ class Finding:
 
 def format_findings(findings) -> str:
     return "\n".join(str(f) for f in findings)
+
+
+def unregistered_rule_ids(findings) -> list[str]:
+    """Rule ids carried by ``findings`` that are not in ``RULES`` — a
+    checker emitting an id the registry doesn't know is an operational
+    error (the CI ddplint stage hard-fails on it), not a lint finding."""
+    return sorted({f.rule for f in findings} - set(RULES))
 
 
 def rule_table() -> str:
@@ -133,6 +232,7 @@ def collective_manifest(
     donate: bool = True,
     allow_f32_reduce: bool = False,
     per_leaf_axes: tuple = (),
+    custom_vjp_collectives_ok: bool = False,
 ) -> dict:
     """The expected-collective manifest a step factory attaches to its
     returned step (``step.collective_manifest``) — the contract the
@@ -152,6 +252,10 @@ def collective_manifest(
     ``allow_f32_reduce``: waives the GL004 wire check for modes whose
     reduction legitimately runs f32 (legacy coalesced buckets, ZeRO/
     FSDP f32 master flats).
+
+    ``custom_vjp_collectives_ok``: waives SF204 for factories that
+    intentionally hide collectives behind custom-AD boundaries (the
+    psum-fwd/identity-bwd reduce used by TP/PP loss completion).
     """
     return {
         "mode": mode,
@@ -162,4 +266,5 @@ def collective_manifest(
         "donate": bool(donate),
         "allow_f32_reduce": bool(allow_f32_reduce),
         "per_leaf_axes": tuple(str(a) for a in per_leaf_axes),
+        "custom_vjp_collectives_ok": bool(custom_vjp_collectives_ok),
     }

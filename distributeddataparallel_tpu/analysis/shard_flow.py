@@ -1,0 +1,619 @@
+"""Sharding-flow pass over the lowered StableHLO of a train step.
+
+The graph layer (``graph_lint``) counts collectives in the jaxpr — what
+the *program text* asks for.  This pass reads the **lowered StableHLO
+module** — what XLA will actually partition — and recovers the flow of
+shardings through it: per-value sharding annotations (entry-arg
+``mhlo.sharding`` attributes and ``custom_call @Sharding`` ops), every
+collective with its payload bytes and replica-group size, and whether
+it executes inside a loop (``stablehlo.while`` region, directly or via
+an outlined function called from one).  That view catches mis-shardings
+the count checks cannot see:
+
+- **SF201 replicated-grad** — the manifest declares sharded reduction
+  (``reduce_scatter``, i.e. ZeRO/FSDP) but a gradient-sized all-reduce
+  appears on the axis: the gradient is reduced fully replicated and the
+  sharded-update memory win is silently lost.
+- **SF202 reshard-in-loop** — a reshard collective (all_gather /
+  all_to_all) inside a loop body whose operand is loop-INVARIANT (a
+  while carry returned unchanged, or a value defined outside the loop):
+  the same bytes cross the interconnect every iteration for an
+  identical result.  FSDP's per-layer weight gather streams a slice
+  that changes per iteration, so it does not trip this; nor do declared
+  gathers of loop-varying data.
+- **SF203 gather-exceeds-hbm** — an all-gather whose gathered output is
+  larger than the per-chip HBM budget
+  (``observability.memory.hbm_budget_bytes``): the program cannot fit
+  at this scale, known before any compile.
+- **SF204 custom-vjp-opaque** — jaxpr-level: a ``custom_vjp`` boundary
+  whose primal jaxpr contains collectives or sharding constraints.  The
+  backward rule is an opaque callable in the trace, so the flow pass
+  cannot verify the hand-written transpose preserves the sharding;
+  factories that do this on purpose (psum-fwd/identity-bwd loss
+  completion) declare ``custom_vjp_collectives_ok`` in their manifest.
+  (After ``value_and_grad`` the boundary is consumed by AD, so train
+  steps are typically clean here; eval/decode paths are where it bites.)
+
+Loop membership is textual, not semantic: brace balance from each
+``stablehlo.while`` head tracks its ``cond { } do { }`` regions, and a
+call-graph fixpoint propagates loop context into outlined private
+functions (StableHLO outlines loop bodies as ``func.call @fn`` — a
+collective whose call path runs through a loop body IS in a loop).
+Loop-invariance follows the same two routes: a value defined outside
+every enclosing while, or a while carry whose ``do``-region return
+passes it through unchanged in its own position; at call sites both
+propagate into the callee's argument positions.  The propagation is a
+may-analysis over call sites (a helper shared between an invariant and
+a varying call site keeps the invariant flag), which is the right bias
+for a linter fed by XLA's per-loop outlining.
+
+Everything is host-side text/trace analysis: lowering only, no compile,
+same contract as the rest of ``ddplint --graph``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+
+from distributeddataparallel_tpu.analysis.rules import Finding
+
+#: StableHLO ops treated as collectives by the flow pass
+_FLOW_COLLECTIVES = (
+    "all_reduce", "all_gather", "reduce_scatter", "collective_permute",
+    "all_to_all",
+)
+
+#: reshard-type collectives: they re-materialize data that already
+#: exists elsewhere in the mesh (vs reductions, which combine new data)
+RESHARD_OPS = frozenset({"all_gather", "all_to_all"})
+
+_DTYPE_BYTES = {
+    "f64": 8, "f32": 4, "f16": 2, "bf16": 2,
+    "f8E4M3FN": 1, "f8E5M2": 1,
+    "i64": 8, "ui64": 8, "i32": 4, "ui32": 4,
+    "i16": 2, "ui16": 2, "i8": 1, "ui8": 1, "i1": 1,
+    "c64": 8, "c128": 16,
+}
+
+_TENSOR_RE = re.compile(r"tensor<([0-9x]*?)x?([a-zA-Z][\w]*)>")
+_OP_RE = re.compile(
+    r"%(\S+?)\s*=\s*\"stablehlo\.(" + "|".join(_FLOW_COLLECTIVES)
+    + r")\"\((%[^)]*)\)"
+)
+_GROUPS_RE = re.compile(
+    r"replica_groups = dense<[^>]*> : tensor<(\d+)x(\d+)xi64>"
+)
+_PAIRS_RE = re.compile(
+    r"source_target_pairs = dense<[^>]*> : tensor<(\d+)x2xi64>"
+)
+_SHARDING_CC_RE = re.compile(
+    r"%(\S+?)\s*=\s*stablehlo\.custom_call @Sharding\((%[^)]+)\)"
+    r".*?mhlo\.sharding = \"([^\"]*)\""
+)
+_ARG_SHARDING_RE = re.compile(
+    r"(%arg\d+): tensor<[^>]*>\s*\{[^}]*mhlo\.sharding = \"([^\"]*)\""
+)
+_DEF_RE = re.compile(r"^\s*(%\S+?)(?::\d+)?\s*=")
+_FUNC_RE = re.compile(r"^\s*func\.func\s+\S+\s+@(\S+?)\(")
+_CALL_RE = re.compile(r"=\s*(?:func\.)?call\s+@(\S+?)\((%[^)]*)\)")
+_ITERARG_RE = re.compile(r"(%iterArg\S*?)\s*=\s*(%\S+?)\s*[,)]")
+_RETURN_RE = re.compile(r"^\s*stablehlo\.return\s+(.*?)\s*:")
+_TYPESIG_RE = re.compile(r":\s*\(([^)]*)\)\s*->\s*(.+?)\s*$")
+_ARG_RE = re.compile(r"^%arg(\d+)$")
+
+#: call-graph fixpoint iteration cap (HLO call graphs are shallow DAGs;
+#: the cap only guards against pathological/recursive input text)
+_FIXPOINT_CAP = 32
+
+
+def tensor_bytes(type_str: str) -> int:
+    """Total bytes of one MLIR tensor type string (0 if unparseable)."""
+    m = _TENSOR_RE.search(type_str)
+    if not m:
+        return 0
+    dims, dtype = m.groups()
+    n = 1
+    for d in dims.split("x"):
+        if d:
+            n *= int(d)
+    return n * _DTYPE_BYTES.get(dtype, 4)
+
+
+def _sig_bytes(sig_match) -> tuple[int, int]:
+    in_b = sum(
+        tensor_bytes(f"tensor<{t}")
+        for t in sig_match.group(1).split("tensor<") if t
+    )
+    out_b = sum(
+        tensor_bytes(f"tensor<{t}")
+        for t in sig_match.group(2).split("tensor<") if t
+    )
+    return in_b, out_b
+
+
+@dataclasses.dataclass(frozen=True)
+class FlowCollective:
+    """One collective in the lowered module, with its flow context."""
+
+    op: str                       # all_reduce / all_gather / ...
+    func: str                     # enclosing func.func name
+    line: int                     # 1-based line in the module text
+    result: str                   # SSA id of the result
+    operands: tuple[str, ...]     # SSA ids of the operands
+    operand_bytes: int            # total payload in (per-chip view)
+    result_bytes: int             # total payload out (per-chip view)
+    group_size: int               # replica group size (axis extent)
+    loop_depth: int               # effective enclosing-loop count
+                                  # (local whiles + loops on the call path)
+    loop_invariant_operands: tuple[str, ...]  # operands whose value is
+                                              # identical every iteration
+
+    @property
+    def in_loop(self) -> bool:
+        return self.loop_depth > 0
+
+
+class _Loop:
+    """One open ``stablehlo.while`` during the line scan."""
+
+    __slots__ = ("balance", "opened", "iter_args", "last_return")
+
+    def __init__(self, head_line: str):
+        self.balance = 0
+        self.opened = False
+        self.iter_args = [n for n, _ in _ITERARG_RE.findall(head_line)]
+        self.last_return: list[str] | None = None
+
+    def invariant_carries(self) -> set[str]:
+        """Carries the ``do`` region returns unchanged in their own
+        position — their value is identical every iteration."""
+        if not self.last_return:
+            return set()
+        return {
+            name for name, ret in zip(self.iter_args, self.last_return)
+            if name == ret
+        }
+
+
+class _Func:
+    """Per-``func.func`` scan state + summary."""
+
+    __slots__ = ("name", "defs", "n_args", "collectives", "calls")
+
+    def __init__(self, name: str, header: str):
+        self.name = name
+        self.defs: dict[str, int] = {}       # SSA id -> def loop depth
+        for arg in re.findall(r"(%arg\d+):", header):
+            self.defs[arg] = 0
+        self.n_args = len(self.defs)
+        # [op, line, result, operands, in_b, out_b, group, depth,
+        #  invariant_flags, open_loops]
+        self.collectives: list = []
+        # [callee, depth, actuals, invariant_flags, open_loops]
+        self.calls: list = []
+
+
+def _base(ssa: str) -> str:
+    return ssa.split("#")[0]
+
+
+def parse_module(text: str) -> tuple[dict, list[FlowCollective]]:
+    """Parse StableHLO text -> (value shardings, collectives).
+
+    Two phases: a single line scan builds per-function summaries
+    (collectives and call sites with their *local* loop depth and
+    operand invariance), then a call-graph fixpoint adds the loop
+    context of every call path, so collectives in outlined loop-body
+    functions report the loop they actually execute in.  SSA names are
+    function-scoped, so defs reset at each ``func.func``.
+    """
+    values: dict[str, str] = {}
+    funcs: dict[str, _Func] = {}
+    order: list[tuple[str, list]] = []   # (func name, record) in text order
+    cur: _Func | None = None
+    loops: list[_Loop] = []
+
+    def invariant(ssa: str, at_depth: int) -> bool:
+        """Provisionally: is ``ssa`` the same value on every iteration
+        of its innermost enclosing loop?  Carries are assumed invariant
+        here and re-checked against the loop's final ``do`` return once
+        the loop closes (``_confirm_invariance``)."""
+        if at_depth <= 0 or cur is None:
+            return False
+        base = _base(ssa)
+        if base.startswith("%iterArg"):
+            return any(base in lp.iter_args for lp in loops if lp.opened)
+        return cur.defs.get(base, at_depth) < at_depth
+
+    lines = text.splitlines()
+    for i, raw in enumerate(lines, start=1):
+        line = raw.rstrip()
+        fm = _FUNC_RE.match(line)
+        if fm:
+            cur = _Func(fm.group(1), line)
+            funcs[cur.name] = cur
+            loops = []
+            for arg, shard in _ARG_SHARDING_RE.findall(line):
+                values[f"{cur.name}:{arg}"] = shard
+            continue
+        if cur is None:
+            continue
+
+        d = sum(1 for lp in loops if lp.opened)
+
+        if "stablehlo.while" in line:
+            dm = _DEF_RE.match(line)
+            if dm:
+                cur.defs[dm.group(1)] = d
+            lp = _Loop(line)
+            for name in lp.iter_args:
+                cur.defs[name] = d + 1
+            loops.append(lp)
+        else:
+            dm = _DEF_RE.match(line)
+            if dm:
+                cur.defs[dm.group(1)] = d
+            rm = _RETURN_RE.match(line)
+            if rm and loops:
+                innermost = next(
+                    (lp for lp in reversed(loops) if lp.opened), None
+                )
+                if innermost is not None:
+                    innermost.last_return = [
+                        o.strip() for o in rm.group(1).split(",")
+                    ]
+            for cc, _operand, shard in _SHARDING_CC_RE.findall(line):
+                values[f"{cur.name}:%{cc}"] = shard
+
+            cm = _CALL_RE.search(line)
+            if cm:
+                callee, ops_raw = cm.groups()
+                actuals = tuple(
+                    o.strip() for o in ops_raw.split(",") if o.strip()
+                )
+                cur.calls.append([
+                    callee, d, actuals,
+                    [invariant(a, d) for a in actuals],
+                    [lp for lp in loops if lp.opened],
+                ])
+
+            om = _OP_RE.search(line)
+            if om:
+                result, op, ops_raw = om.groups()
+                operands = tuple(
+                    o.strip() for o in ops_raw.split(",") if o.strip()
+                )
+                gm = _GROUPS_RE.search(line)
+                group_size = int(gm.group(2)) if gm else 0
+                if op == "collective_permute":
+                    pm = _PAIRS_RE.search(line)
+                    group_size = int(pm.group(1)) if pm else 0
+                sig = _TYPESIG_RE.search(line)
+                if sig is None:
+                    # region op (all_reduce/reduce_scatter): the type
+                    # signature sits on the region's closing `}) : ...`
+                    bal = line.count("{") - line.count("}")
+                    j = i
+                    while j < len(lines) and bal > 0:
+                        bal += lines[j].count("{") - lines[j].count("}")
+                        j += 1
+                    sig = _TYPESIG_RE.search(lines[j - 1]) if j > i else None
+                in_b, out_b = _sig_bytes(sig) if sig else (0, 0)
+                rec = [op, i, f"%{result}", operands, in_b, out_b,
+                       group_size, d,
+                       [invariant(o, d) for o in operands],
+                       [lp for lp in loops if lp.opened]]
+                cur.collectives.append(rec)
+                order.append((cur.name, rec))
+
+        # update loop balances AFTER classifying the line (the while
+        # head itself is outside its own body)
+        nb = line.count("{") - line.count("}")
+        nxt = []
+        for lp in loops:
+            lp.balance += nb
+            if lp.balance > 0:
+                lp.opened = True
+                nxt.append(lp)
+            elif not lp.opened:
+                nxt.append(lp)
+        loops = nxt
+
+    # confirm provisional carry-invariance against each loop's final
+    # do-region return (only known once the loop closed)
+    for fn in funcs.values():
+        for rec in fn.collectives:
+            rec[8] = _confirm_invariance(rec[3], rec[8], rec[9])
+        for rec in fn.calls:
+            rec[3] = _confirm_invariance(rec[2], rec[3], rec[4])
+
+    # call-graph fixpoint: loop context + per-arg invariance
+    ctx_depth = {name: 0 for name in funcs}
+    arg_inv = {name: [False] * fn.n_args for name, fn in funcs.items()}
+    for _ in range(_FIXPOINT_CAP):
+        changed = False
+        for name, fn in funcs.items():
+            for callee, d, actuals, inv_flags, _lps in fn.calls:
+                tgt = funcs.get(callee)
+                if tgt is None:
+                    continue
+                eff = ctx_depth[name] + d
+                if eff > ctx_depth[callee]:
+                    ctx_depth[callee] = eff
+                    changed = True
+                for j, a in enumerate(actuals):
+                    if j >= tgt.n_args or arg_inv[callee][j]:
+                        continue
+                    inv = j < len(inv_flags) and inv_flags[j]
+                    am = _ARG_RE.match(_base(a))
+                    if am and d == 0:
+                        # pass-through of our own arg outside any local
+                        # loop: invariance flows from OUR caller
+                        k = int(am.group(1))
+                        inv = k < fn.n_args and arg_inv[name][k]
+                    if inv:
+                        arg_inv[callee][j] = True
+                        changed = True
+        if not changed:
+            break
+
+    out: list[FlowCollective] = []
+    for fname, rec in order:
+        op, line_no, result, operands, in_b, out_b, group, d, inv, _ = rec
+        fn = funcs[fname]
+        eff_depth = d + ctx_depth[fname]
+        invariants = []
+        for j, o in enumerate(operands):
+            is_inv = inv[j]
+            am = _ARG_RE.match(_base(o))
+            if not is_inv and am and d == 0 and eff_depth > 0:
+                k = int(am.group(1))
+                is_inv = k < fn.n_args and arg_inv[fname][k]
+            if is_inv:
+                invariants.append(o)
+        out.append(FlowCollective(
+            op=op, func=fname, line=line_no, result=result,
+            operands=operands, operand_bytes=in_b, result_bytes=out_b,
+            group_size=group, loop_depth=eff_depth,
+            loop_invariant_operands=tuple(invariants),
+        ))
+    return values, out
+
+
+def _confirm_invariance(operands, flags, open_loops) -> list[bool]:
+    """Downgrade provisional carry-invariance for carries the loop's
+    final return did NOT pass through unchanged."""
+    confirmed = set()
+    for lp in open_loops or []:
+        confirmed |= lp.invariant_carries()
+    out = []
+    for o, f in zip(operands, flags):
+        base = _base(o)
+        if f and base.startswith("%iterArg"):
+            f = base in confirmed
+        out.append(bool(f))
+    return out
+
+
+@dataclasses.dataclass
+class ShardFlowReport:
+    """Flow-pass outcome: per-value shardings + collectives + findings."""
+
+    mode: str
+    findings: list
+    values: dict                  # "func:%ssa" -> sharding annotation
+    collectives: list             # [FlowCollective ...]
+    hbm_budget_bytes: int | None = None
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings
+
+    def sharding_counts(self) -> dict:
+        """Annotation string -> value count: the recovered sharding
+        census ('how much of this program is actually sharded')."""
+        out: dict[str, int] = {}
+        for s in self.values.values():
+            out[s] = out.get(s, 0) + 1
+        return out
+
+
+def _declared_prims(manifest: dict) -> set[str]:
+    out: set[str] = set()
+    for prims in manifest.get("grad_reduce", {}).values():
+        for p, (_mn, mx) in prims.items():
+            if mx is None or mx > 0:
+                out.add(p)
+    return out
+
+
+#: jaxpr-side manifest prim names -> StableHLO op names
+_PRIM_TO_HLO = {
+    "psum": "all_reduce", "psum2": "all_reduce",
+    "psum_invariant": "all_reduce",
+    "reduce_scatter": "reduce_scatter", "psum_scatter": "reduce_scatter",
+    "all_gather": "all_gather", "all_gather_invariant": "all_gather",
+    "ppermute": "collective_permute", "pgather": "all_gather",
+    "all_to_all": "all_to_all",
+}
+
+
+def lint_flow(
+    text: str,
+    *,
+    manifest: dict,
+    where: str = "flow",
+    hbm_budget_bytes: int | None = None,
+    grad_bytes_floor: int | None = None,
+) -> ShardFlowReport:
+    """Run SF201–SF203 over one lowered module's text.
+
+    ``grad_bytes_floor``: the smallest payload considered
+    "gradient-sized" for SF201 — callers pass the largest parameter
+    leaf's bytes; without it SF201 falls back to the largest
+    reduce-scatter payload seen in the module.
+    """
+    if hbm_budget_bytes is None:
+        from distributeddataparallel_tpu.observability.memory import (
+            hbm_budget_bytes as default_budget,
+        )
+
+        hbm_budget_bytes = default_budget()
+    values, collectives = parse_module(text)
+    findings: list[Finding] = []
+    declared = {_PRIM_TO_HLO.get(p, p) for p in _declared_prims(manifest)}
+
+    # SF201: sharded-reduction mode, but a gradient-sized all_reduce.
+    wants_scatter = any(
+        p in ("reduce_scatter", "psum_scatter")
+        for prims in manifest.get("grad_reduce", {}).values()
+        for p, (mn, _mx) in prims.items() if mn >= 1
+    )
+    if wants_scatter:
+        floor = grad_bytes_floor
+        if floor is None:
+            scattered = [
+                c.operand_bytes for c in collectives
+                if c.op == "reduce_scatter"
+            ]
+            floor = max(scattered) if scattered else None
+        if floor:
+            for c in collectives:
+                if c.op == "all_reduce" and c.operand_bytes >= floor:
+                    findings.append(Finding(
+                        "SF201", where,
+                        f"{c.func}:{c.line}: gradient-sized all_reduce "
+                        f"({c.operand_bytes} B >= floor {floor} B) under "
+                        f"a manifest that declares reduce_scatter — the "
+                        "gradient is reduced fully replicated, defeating "
+                        "the sharded-update memory win",
+                    ))
+
+    # SF202: reshard collective in a loop, re-gathering loop-invariant
+    # data (or not declared by the factory at all).
+    for c in collectives:
+        if c.op not in RESHARD_OPS or not c.in_loop:
+            continue
+        if c.loop_invariant_operands:
+            findings.append(Finding(
+                "SF202", where,
+                f"{c.func}:{c.line}: {c.op} inside a loop body gathers "
+                f"loop-invariant value(s) "
+                f"{', '.join(c.loop_invariant_operands)} — the same "
+                f"{c.result_bytes} B cross the interconnect every "
+                "iteration for an identical result (hoist it out of "
+                "the loop)",
+            ))
+        elif c.op not in declared:
+            findings.append(Finding(
+                "SF202", where,
+                f"{c.func}:{c.line}: undeclared {c.op} inside a loop "
+                f"body ({c.result_bytes} B per iteration) — an implicit "
+                "reshard on the hot path the factory manifest does not "
+                "account for",
+            ))
+
+    # SF203: gathered output larger than the per-chip HBM budget.
+    if hbm_budget_bytes:
+        for c in collectives:
+            if c.op == "all_gather" and c.result_bytes > hbm_budget_bytes:
+                findings.append(Finding(
+                    "SF203", where,
+                    f"{c.func}:{c.line}: all_gather materializes "
+                    f"{c.result_bytes} B per chip "
+                    f"(> HBM budget {hbm_budget_bytes} B) — the gathered "
+                    "value cannot fit regardless of schedule",
+                ))
+
+    return ShardFlowReport(
+        mode=manifest.get("mode", "?"),
+        findings=findings,
+        values=values,
+        collectives=collectives,
+        hbm_budget_bytes=hbm_budget_bytes,
+    )
+
+
+def lint_custom_vjp(closed_jaxpr, *, manifest: dict, where: str) -> list:
+    """SF204 over a traced (UNdifferentiated) jaxpr: custom-AD
+    boundaries whose primal contains sharding-relevant ops.  AD consumes
+    ``custom_vjp_call`` eqns, so differentiated train steps are clean by
+    construction — this bites on eval/decode paths and raw loss fns."""
+    from distributeddataparallel_tpu.analysis import graph_lint as gl
+
+    if manifest.get("custom_vjp_collectives_ok"):
+        return []
+    findings = []
+    seen = set()
+    for eqn in gl.walk_jaxpr(closed_jaxpr.jaxpr):
+        name = eqn.primitive.name
+        if not name.startswith(("custom_vjp_call", "custom_jvp_call")):
+            continue
+        inner = [
+            sub_eqn.primitive.name
+            for sub in gl._subjaxprs(eqn.params)
+            for sub_eqn in gl.walk_jaxpr(sub)
+        ]
+        hidden = sorted({
+            p for p in inner
+            if p in gl.COLLECTIVE_PRIMS or p == "sharding_constraint"
+        })
+        if hidden and (name, tuple(hidden)) not in seen:
+            seen.add((name, tuple(hidden)))
+            findings.append(Finding(
+                "SF204", where,
+                f"{name} hides sharding-relevant op(s) "
+                f"{', '.join(hidden)} behind an opaque backward rule — "
+                "the flow pass cannot verify the hand-written transpose "
+                "preserves the sharding (declare "
+                "custom_vjp_collectives_ok in the manifest if "
+                "intentional)",
+            ))
+    return findings
+
+
+def analyze_step(
+    step,
+    state,
+    batch,
+    rng,
+    *,
+    manifest: dict | None = None,
+    mode: str | None = None,
+    hbm_budget_bytes: int | None = None,
+) -> ShardFlowReport:
+    """Trace + lower ``step(state, batch, rng)`` and run the full flow
+    pass (SF201–SF204).  Host work only: one ``make_jaxpr`` (which also
+    populates wrapper factories' ``.jitted``) and one lowering."""
+    import jax
+
+    from distributeddataparallel_tpu.analysis import graph_lint as gl
+
+    manifest = manifest or getattr(step, "collective_manifest", None) \
+        or gl.default_manifest()
+    where = f"flow:{mode or manifest['mode']}"
+
+    jaxpr = jax.make_jaxpr(step)(state, batch, rng)
+    findings = lint_custom_vjp(jaxpr, manifest=manifest, where=where)
+
+    lower = gl._lower_fn(step)
+    if lower is None:
+        return ShardFlowReport(
+            mode=mode or manifest["mode"], findings=findings,
+            values={}, collectives=[],
+            hbm_budget_bytes=hbm_budget_bytes,
+        )
+
+    leaves = jax.tree.leaves(state.params)
+    floor = max(
+        (int(l.size) * l.dtype.itemsize for l in leaves), default=None
+    )
+    text = lower(state, batch, rng).as_text()
+    report = lint_flow(
+        text, manifest=manifest, where=where,
+        hbm_budget_bytes=hbm_budget_bytes, grad_bytes_floor=floor,
+    )
+    report.mode = mode or manifest["mode"]
+    report.findings = findings + report.findings
+    return report

@@ -77,9 +77,10 @@ class RunSummaryBuilder:
         self._mfu: list[float] = []
         self._hwm_bytes: float | None = None
         self._steps_total = 0
+        self._collective_fp: str | None = None
 
     def sample(self, *, step_s=None, mfu=None, live_hwm_bytes=None,
-               steps_total=None) -> None:
+               steps_total=None, collective_fp=None) -> None:
         if step_s is not None:
             self._step_s.append(float(step_s))
         if mfu is not None:
@@ -88,6 +89,8 @@ class RunSummaryBuilder:
             self._hwm_bytes = float(live_hwm_bytes)
         if steps_total is not None:
             self._steps_total = int(steps_total)
+        if collective_fp is not None:
+            self._collective_fp = str(collective_fp)
 
     def build(self, *, goodput: dict | None = None, restarts: int = 0,
               alerts_total: int = 0, status: str = "ok") -> dict:
@@ -112,6 +115,11 @@ class RunSummaryBuilder:
             ),
             "goodput": goodput.get("goodput") if goodput else None,
             "goodput_buckets": goodput.get("buckets") if goodput else None,
+            # GL002 collective-sequence fingerprint of the traced step:
+            # lets the perf gate attribute a regression to a graph
+            # change (fp differs from baseline) vs environment drift
+            # (fp identical, only the numbers moved).
+            "collective_fp": self._collective_fp,
         }
         return summary
 
@@ -140,6 +148,9 @@ def run_summary_from_timeline(records: list[dict], proc=0) -> dict:
             hwm = rec.get("live_hwm_bytes", rec.get("live_bytes"))
             if isinstance(hwm, (int, float)):
                 builder.sample(live_hwm_bytes=float(hwm))
+        elif kind == "run_summary":
+            if rec.get("collective_fp"):
+                builder.sample(collective_fp=rec["collective_fp"])
         elif kind == "run_end":
             status = rec.get("status", status)
     goodput = goodput_from_timeline(records, proc=proc)

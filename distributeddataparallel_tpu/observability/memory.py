@@ -78,6 +78,23 @@ def executable_memory_analysis(compiled) -> dict | None:
     return out or None
 
 
+#: fallback per-chip HBM budget when the backend reports no
+#: ``bytes_limit`` (CPU, mesh simulation): one TPU v4 chip's 32 GiB.
+#: Mis-sharding checks (SF203) and mesh-sim fit prediction need SOME
+#: budget to compare against on backends that have none; v4 is the
+#: paper's reference part, and callers can always override.
+DEFAULT_HBM_BUDGET_BYTES = 32 * 1024**3
+
+
+def hbm_budget_bytes(devices=None) -> int:
+    """Per-chip HBM budget: the allocator's reported ``bytes_limit``
+    (minimum across devices — the tightest chip is the one that OOMs)
+    when the backend exposes it, else ``DEFAULT_HBM_BUDGET_BYTES``."""
+    stats = device_memory_stats(devices)
+    limits = [s["bytes_limit"] for s in stats or [] if s.get("bytes_limit")]
+    return min(limits) if limits else DEFAULT_HBM_BUDGET_BYTES
+
+
 def live_array_bytes() -> tuple[int, int]:
     """(total bytes, array count) across all live jax.Arrays in the
     process.  Host metadata only — never reads a device value."""

@@ -56,6 +56,8 @@ EVENT_KINDS: dict[str, tuple[str, ...]] = {
     # Alerting + longitudinal layer (alerts / baseline):
     "alert": ("rule", "step", "value", "threshold"),
     "run_summary": ("windows", "restarts"),
+    # Static-analysis layer (ddplint):
+    "lint_report": ("layer", "n_findings", "rules"),
 }
 
 

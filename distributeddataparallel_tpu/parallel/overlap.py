@@ -527,6 +527,42 @@ def tpu_topology_mesh(topology: str = "v5e:2x4", axis_names=("data",),
     return Mesh(devs.reshape(shape), axis_names)
 
 
+def comm_schedule_ir(
+    params,
+    *,
+    bucket_bytes: int | None = None,
+    axis: str = "data",
+    prim: str = "psum",
+):
+    """The bucketed grad-sync order as schedule IR (``ScheduleIR``,
+    kind="grad-sync"): one tick per bucket, buckets planned from the
+    param tree by the SAME planner the traced step uses
+    (``native.plan_buckets``), so the SL302 traced-count check catches
+    the step and the plan diverging (e.g. the all-reduce combiner
+    re-merging buckets, or a refactor dropping the coalescing).
+
+    ``bucket_bytes=None`` means leaf-sized buckets (one psum per leaf).
+    Attached by ``make_train_step`` as ``step.comm_schedule(params)`` —
+    a builder, not a constant, because the partition depends on the
+    param tree the step is eventually called with.
+    """
+    import jax
+
+    from distributeddataparallel_tpu import native
+    from distributeddataparallel_tpu.analysis.schedule_lint import (
+        grad_sync_schedule_ir,
+    )
+
+    leaves = jax.tree.leaves(params)
+    if bucket_bytes is None:
+        n_buckets = len(leaves)
+    else:
+        n_buckets = len(native.plan_buckets(
+            [l.size * l.dtype.itemsize for l in leaves], bucket_bytes
+        ))
+    return grad_sync_schedule_ir(n_buckets, axis=axis, prim=prim)
+
+
 def grad_sync_schedule_evidence(
     *,
     topology: str = "v5e:2x4",

@@ -1085,4 +1085,23 @@ def make_pp_train_step(
         donate=donate,
         allow_f32_reduce=True,
     )
+
+    # Schedule-as-data for the SL3xx linter: the tick table this step
+    # claims to run, rebuilt from the schedule definition (NOT from the
+    # tick arithmetic above — the lint cross-checks the two, and
+    # bubble_accounting is the factory-side number SL304 compares
+    # against the table's).
+    from distributeddataparallel_tpu.analysis.schedule_lint import (
+        gpipe_schedule_ir,
+        one_f_one_b_schedule_ir,
+    )
+
+    if schedule == "1f1b":
+        step.schedule_ir = one_f_one_b_schedule_ir(
+            n_stages, M, virtual, hop_axis=pp_axis
+        )
+        step.bubble_accounting = pp_bubble_fraction(n_stages, M, virtual)
+    else:
+        step.schedule_ir = gpipe_schedule_ir(n_stages, M, hop_axis=pp_axis)
+        step.bubble_accounting = None
     return step

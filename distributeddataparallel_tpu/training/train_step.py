@@ -631,6 +631,29 @@ def make_train_step(
         if opts:
             jit_kwargs["compiler_options"] = opts
 
+    def _attach_comm_schedule(fn):
+        # Schedule-as-data for the SL3xx linter: bucketed/overlap grad
+        # sync exposes its bucket order as a builder (the partition
+        # depends on the param tree, so it can't be a constant like the
+        # pipeline tick tables).  Compressed sync reduces factors, not
+        # buckets — no IR.
+        if (
+            grad_sync and not zero and grad_compress is None
+            and (bucket_bytes is not None or overlap)
+        ):
+            from distributeddataparallel_tpu.parallel.overlap import (
+                comm_schedule_ir,
+            )
+
+            _bb = (
+                bucket_bytes if bucket_bytes is not None
+                else OVERLAP_BUCKET_BYTES
+            )
+            fn.comm_schedule = lambda params: comm_schedule_ir(
+                params, bucket_bytes=_bb, axis=axis_name
+            )
+        return fn
+
     if (
         not zero and tp_axis is None and ep_axis is None
         and grad_compress != "powersgd"
@@ -646,7 +669,7 @@ def make_train_step(
         jitted.aot_signature = aot_signature
         jitted.flop_signature = flop_signature
         jitted.collective_manifest = collective_manifest_
-        return jitted
+        return _attach_comm_schedule(jitted)
 
     # ZeRO / TP / EP: the state's leaves carry per-leaf shardings (ZeRO:
     # flat opt chunks over the data axis; TP/EP: Megatron/expert layouts
@@ -719,8 +742,7 @@ def make_train_step(
     step.aot_signature = aot_signature
     step.flop_signature = flop_signature
     step.collective_manifest = collective_manifest_
-
-    return step
+    return _attach_comm_schedule(step)
 
 
 def make_eval_step(

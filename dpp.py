@@ -1203,6 +1203,9 @@ def train(args) -> float:
     # Graph lint wants the RAW factory step: the warm-start wrapper below
     # may swap in a deserialized AOT executable, which cannot be traced.
     lint_target = step_fn if args.lint_step else None
+    # Same constraint for the GL002 fingerprint the run_summary carries
+    # (perf_gate uses it to tell graph changes from environment drift).
+    fp_target = step_fn
 
     warm_report = {}
     if args.compile_cache:
@@ -1776,24 +1779,95 @@ def train(args) -> float:
                         # trace-only lint fails fast before the compile.
                         from distributeddataparallel_tpu.analysis import (
                             graph_lint,
+                            schedule_lint,
+                            shard_flow,
+                        )
+                        from distributeddataparallel_tpu.observability.memory import (
+                            hbm_budget_bytes,
                         )
 
                         rep = graph_lint.lint_train_step(
                             lint_target, state, batch, sub
                         )
+                        if summary_builder is not None:
+                            summary_builder.sample(
+                                collective_fp=rep.fingerprint
+                            )
+                        fp_target = None
+                        flow = shard_flow.analyze_step(
+                            lint_target, state, batch, sub,
+                            mode=rep.mode,
+                            hbm_budget_bytes=hbm_budget_bytes(),
+                        )
+                        all_findings = rep.findings + flow.findings
+                        ir = getattr(lint_target, "schedule_ir", None)
+                        if ir is None and getattr(
+                            lint_target, "comm_schedule", None
+                        ) is not None:
+                            ir = lint_target.comm_schedule(state.params)
+                        if ir is not None:
+                            hops = sum(
+                                c.effective_count
+                                for c in (rep.collectives or [])
+                                if c.prim == ir.hop_prim
+                                and ir.hop_axis in c.axes and c.nonscalar
+                            )
+                            all_findings += schedule_lint.lint_schedule(
+                                ir,
+                                manifest=getattr(
+                                    lint_target, "collective_manifest",
+                                    None,
+                                ),
+                                traced_hops=hops,
+                                bubble=getattr(
+                                    lint_target, "bubble_accounting",
+                                    None,
+                                ),
+                                where=f"sched:{rep.mode}:{ir.kind}",
+                            )
                         lint_target = None
-                        if rep.findings:
+                        if all_findings:
                             raise SystemExit(
                                 "--lint-step: train step violates its "
-                                "collective manifest:\n" + "\n".join(
-                                    str(f) for f in rep.findings
+                                "SPMD invariants:\n" + "\n".join(
+                                    str(f) for f in all_findings
                                 )
                             )
                         log0(
-                            "lint-step [%s] clean: collective fp=%s %s",
+                            "lint-step [%s] clean: collective fp=%s %s "
+                            "flow-collectives=%d%s",
                             rep.mode, rep.fingerprint,
                             rep.collective_counts,
+                            len(flow.collectives),
+                            f" schedule={ir.kind}" if ir is not None
+                            else "",
                         )
+                    if fp_target is not None:
+                        # One trace on the first batch to stamp the
+                        # run_summary with the GL002 collective
+                        # fingerprint (skipped if --lint-step already
+                        # computed it above).
+                        if summary_builder is not None:
+                            from distributeddataparallel_tpu.analysis import (
+                                graph_lint,
+                            )
+
+                            try:
+                                summary_builder.sample(
+                                    collective_fp=graph_lint.collective_fingerprint(
+                                        graph_lint.collect_collectives(
+                                            jax.make_jaxpr(fp_target)(
+                                                state, batch, sub
+                                            )
+                                        )
+                                    )
+                                )
+                            # ddplint: allow[broad-except] — fingerprint is
+                            # telemetry; an untraceable step must not kill
+                            # the run
+                            except Exception:  # noqa: BLE001
+                                pass
+                        fp_target = None
                     # The step span times host-side dispatch (plus any
                     # window-overflow settles) — the honest per-step
                     # number for an async loop; device wall time lands

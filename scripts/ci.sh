@@ -6,12 +6,20 @@
 #
 # Order is cheapest-first so drift fails in seconds:
 #   1. ddplint --ast            AST rules (host-sync, broad-except,
-#                               unregistered emit kinds) — stdlib-only
-#   2. check_events --schema-sync
+#                               unregistered emit kinds) — stdlib-only.
+#                               Exit 2 (a checker emitting a rule id the
+#                               registry doesn't know) is an operational
+#                               hard failure, distinct from findings
+#   2. ddp_meshsim --check      compile-only scale smoke: cnn + gpt2-small
+#                               lowered/linted/sized on fake 8- and
+#                               32-device CPU meshes — catches lowering
+#                               breaks and SF2xx/SL3xx regressions at
+#                               topologies the tests never build
+#   3. check_events --schema-sync
 #                               two-way emitter <-> EVENT_KINDS diff, so
 #                               a kind added on one side only is a hard
 #                               error in BOTH directions
-#   3. tier-1 pytest            the ROADMAP verify command (CPU, not slow)
+#   4. tier-1 pytest            the ROADMAP verify command (CPU, not slow)
 #
 # Opt-in perf regression gate (off by default so tier-1 stays
 # deterministic — perf numbers need a quiet, consistent host):
@@ -27,6 +35,9 @@ cd "$(dirname "$0")/.."
 
 echo "== ddplint --ast =="
 python scripts/ddplint.py --ast
+
+echo "== ddp_meshsim --check =="
+python scripts/ddp_meshsim.py --check
 
 echo "== check_events --schema-sync =="
 python scripts/check_events.py --schema-sync

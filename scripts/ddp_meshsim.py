@@ -1,0 +1,208 @@
+#!/usr/bin/env python
+"""Compile-only mesh simulation at scales this box doesn't have.
+
+Usage:
+    # Lower + lint + size gpt2-small dp on a fake 64-device mesh:
+    python scripts/ddp_meshsim.py --model gpt2-small --mode dp --devices 64
+
+    # Sweep device counts and store records for perf_gate to diff:
+    python scripts/ddp_meshsim.py --model gpt2-small --devices 8,64,256 \
+        --store runs/
+
+    # CI smoke (cnn + gpt2-small, dp, 8 and 32 devices):
+    python scripts/ddp_meshsim.py --check
+
+Each device count needs its own process: jax fixes the device set at
+import time, so the orchestrator (this script, which never imports jax)
+re-invokes itself per count with
+``XLA_FLAGS=--xla_force_host_platform_device_count=N`` already in the
+child's environment.  The child runs ``analysis.mesh_sim.simulate`` —
+AOT lowering, shard-flow lint (SF2xx), schedule lint (SL3xx), and the
+compiler's per-device ``memory_analysis()`` — and prints one JSON
+record on stdout.
+
+``--store`` appends each record to a baseline store index
+(``observability.baseline.append_run``) named by its simulation
+fingerprint; the record's flat ``headline`` byte metrics make
+``scripts/perf_gate.py`` treat it as a bench file, so predicted
+per-chip footprints are gated across commits like any measured metric.
+
+Exit codes: 0 = clean, 1 = usage/subprocess error, 2 = lint findings
+or a config predicted not to fit.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+FINDINGS_EXIT = 2
+
+#: --check preset: enough to catch a broken lowering or a lint
+#: regression on both a conv net and the transformer path, small
+#: enough to stay in CI budget
+CHECK_CASES = ("cnn:dp", "gpt2-small:dp")
+CHECK_DEVICES = (8, 32)
+
+
+def worker_main(args) -> int:
+    """Child-process entry: device count already forced via XLA_FLAGS
+    by the parent, so importing jax here sees the fake mesh."""
+    from distributeddataparallel_tpu.analysis.mesh_sim import simulate
+
+    record = simulate(
+        args.model,
+        args.mode,
+        batch_per_chip=args.batch_per_chip,
+        seq=args.seq,
+        pp_stages=args.pp_stages,
+        do_compile=not args.no_compile,
+        hbm_budget_bytes=args.hbm_budget_bytes or None,
+    )
+    json.dump(record, sys.stdout)
+    sys.stdout.write("\n")
+    return 0
+
+
+def spawn_case(devices: int, argv_tail: list[str]) -> dict:
+    """Run one (model, mode, devices) case in a fresh process and
+    parse its record.  Raises RuntimeError with the child's stderr on
+    failure."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={devices}"
+    ).strip()
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--worker", *argv_tail],
+        env=env, capture_output=True, text=True,
+    )
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"simulation subprocess failed (devices={devices}):\n"
+            + proc.stderr.strip()[-2000:]
+        )
+    # the record is the last stdout line; anything before it is jax noise
+    line = proc.stdout.strip().splitlines()[-1]
+    return json.loads(line)
+
+
+def summarize(record: dict) -> str:
+    fit = record.get("fit")
+    mem = "" if not fit else (
+        f"  required={fit['required_bytes'] / 2**30:.2f}GiB"
+        f" budget={fit['budget_bytes'] / 2**30:.0f}GiB"
+        f" {'FITS' if fit['fits'] else 'DOES NOT FIT'}"
+    )
+    n_f = len(record["findings"])
+    lint = "clean" if not n_f else f"{n_f} finding(s)"
+    return (
+        f"{record['model']}:{record['mode']} @ {record['devices']}dev"
+        f" params={record['params_m']}M  lint={lint}{mem}"
+    )
+
+
+def record_failed(record: dict) -> bool:
+    fit = record.get("fit")
+    return bool(record["findings"]) or bool(fit and not fit["fits"])
+
+
+def store_record(store: str, record: dict) -> None:
+    from distributeddataparallel_tpu.analysis.mesh_sim import fingerprint
+    from distributeddataparallel_tpu.observability import baseline as bl
+
+    bl.append_run(store, record, name=fingerprint(record), source="meshsim")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--model", default="gpt2-small",
+                   help="cnn | mlp | tiny-lm | gpt2-small")
+    p.add_argument("--mode", default="dp", help="dp | zero | fsdp | pp")
+    p.add_argument("--devices", default="8",
+                   help="comma-separated fake device counts (one "
+                        "subprocess each)")
+    p.add_argument("--batch-per-chip", type=int, default=2)
+    p.add_argument("--seq", type=int, default=128)
+    p.add_argument("--pp-stages", type=int, default=4)
+    p.add_argument("--hbm-budget-bytes", type=int, default=0,
+                   help="per-chip budget override (default: detected "
+                        "or 32GiB)")
+    p.add_argument("--no-compile", action="store_true",
+                   help="lower + lint only, skip compile and the "
+                        "memory-fit prediction")
+    p.add_argument("--store", metavar="DIR",
+                   help="append each record to this baseline store")
+    p.add_argument("--json", action="store_true",
+                   help="print full records as JSON lines instead of "
+                        "summaries")
+    p.add_argument("--check", action="store_true",
+                   help="CI smoke: cnn + gpt2-small, dp, 8 and 32 "
+                        "devices; nonzero on any finding")
+    p.add_argument("--worker", action="store_true", help=argparse.SUPPRESS)
+    return p
+
+
+def case_argv(args, model: str, mode: str) -> list[str]:
+    tail = [
+        "--model", model, "--mode", mode,
+        "--batch-per-chip", str(args.batch_per_chip),
+        "--seq", str(args.seq),
+        "--pp-stages", str(args.pp_stages),
+        "--hbm-budget-bytes", str(args.hbm_budget_bytes),
+    ]
+    if args.no_compile:
+        tail.append("--no-compile")
+    return tail
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.worker:
+        return worker_main(args)
+
+    if args.check:
+        cases = [tuple(c.split(":")) for c in CHECK_CASES]
+        devices = list(CHECK_DEVICES)
+    else:
+        cases = [(args.model, args.mode)]
+        try:
+            devices = [int(d) for d in args.devices.split(",") if d]
+        except ValueError:
+            print(f"ddp_meshsim: bad --devices {args.devices!r}",
+                  file=sys.stderr)
+            return 1
+        if not devices:
+            print("ddp_meshsim: no device counts given", file=sys.stderr)
+            return 1
+
+    failed = False
+    for model, mode in cases:
+        for n in devices:
+            try:
+                record = spawn_case(n, case_argv(args, model, mode))
+            except RuntimeError as exc:
+                print(f"ddp_meshsim: {exc}", file=sys.stderr)
+                return 1
+            if args.json:
+                print(json.dumps(record))
+            else:
+                print(summarize(record))
+                for f in record["findings"]:
+                    print(f"    {f}")
+            if args.store:
+                store_record(args.store, record)
+            failed = failed or record_failed(record)
+
+    return FINDINGS_EXIT if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -77,6 +77,7 @@ def analyze(records: list[dict]) -> dict:
         "straggler": None,
         "restarts": [],
         "alerts": [],
+        "lint": [],
         "run_summary": None,
     }
     if worker_procs:
@@ -116,6 +117,13 @@ def analyze(records: list[dict]) -> dict:
                 "kind": kind,
                 "attempt": r.get("attempt"),
                 "failed": r.get("failed"),
+            })
+        elif kind == "lint_report":
+            out["lint"].append({
+                "layer": r.get("layer"),
+                "n_findings": r.get("n_findings"),
+                "rules": r.get("rules"),
+                "findings": r.get("findings") or [],
             })
         elif kind == "alert":
             out["alerts"].append({
@@ -296,6 +304,33 @@ def render_markdown(a: dict, events_dir: str) -> str:
                 f"| {rule} | {len(als)} | {als[0].get('step')} | "
                 f"{als[-1].get('step')} |"
             )
+    lines.append("")
+
+    # -- Lint ---------------------------------------------------------
+    lines += ["## Lint", ""]
+    if not a["lint"]:
+        lines.append("No `lint_report` events — run "
+                     "`python scripts/ddplint.py --events-dir DIR` (or "
+                     "`dpp.py --lint-step`) to record static-analysis "
+                     "health next to the runtime telemetry.")
+    else:
+        total = sum(l["n_findings"] or 0 for l in a["lint"])
+        verdict = "clean" if total == 0 else f"**{total} finding(s)**"
+        lines += [
+            f"Static analysis {verdict} across "
+            f"{len(a['lint'])} layer(s):",
+            "",
+            "| layer | findings | rules |",
+            "|---|---:|---|",
+        ]
+        for l in a["lint"]:
+            rules = ", ".join(l["rules"] or []) or "—"
+            lines.append(
+                f"| {l['layer']} | {l['n_findings']} | {rules} |"
+            )
+        for l in a["lint"]:
+            for f in l["findings"]:
+                lines += ["", f"- `{f}`"]
     lines.append("")
 
     # -- Run summary + trace ------------------------------------------

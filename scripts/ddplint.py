@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """ddplint — static SPMD-invariant checker for the DDP reproduction.
 
-Two layers (rule table: ``--list-rules``; registry in
+Layers (rule table: ``--list-rules``; registry in
 ``distributeddataparallel_tpu/analysis/rules.py``):
 
   --ast     AST rules over the package source, dpp.py, and scripts/.
@@ -10,12 +10,20 @@ Two layers (rule table: ``--list-rules``; registry in
             repo's own factories, exercised on tiny CPU-sized configs.
             Traces and lowers but never compiles, so it is fast and
             CPU-safe (forces JAX_PLATFORMS=cpu + 8 host devices).
+            This layer also runs the sharding-flow pass (SF2xx) over
+            each lowered module and — for steps that attach a schedule
+            IR (pipeline stages, bucketed grad-sync) — the
+            schedule-as-data lint (SL3xx).
 
 With neither flag, both layers run.  ``--changed-only`` narrows the AST
 layer to files in ``git diff --name-only HEAD`` and skips the graph
 layer unless step-defining code changed — the fast local pre-push mode.
+``--events-dir DIR`` additionally writes one schema-valid
+``lint_report`` event per layer to ``DIR/events-lint.jsonl`` so run
+reports can show lint health next to runtime telemetry.
 
-Exit status: 0 clean, 1 findings, 2 operational error.
+Exit status: 0 clean, 1 findings, 2 operational error (including a
+checker emitting a rule id the registry doesn't know).
 
 Examples:
     python scripts/ddplint.py --graph --ast       # what CI runs
@@ -65,27 +73,28 @@ def _ensure_cpu() -> None:
         ).strip()
 
 
-def _changed_files() -> list[str]:
+def _changed_files(root: Path | None = None) -> list[str]:
     out = subprocess.run(
         ["git", "diff", "--name-only", "HEAD"],
-        cwd=ROOT, capture_output=True, text=True, check=True,
+        cwd=root or ROOT, capture_output=True, text=True, check=True,
     ).stdout
     return [l.strip() for l in out.splitlines() if l.strip()]
 
 
-def run_ast(changed_only: bool) -> list:
+def run_ast(changed_only: bool, *, root: Path | None = None) -> list:
     from distributeddataparallel_tpu.analysis import ast_rules
 
-    targets = ast_rules.default_targets(ROOT)
+    root = root or ROOT
+    targets = ast_rules.default_targets(root)
     if changed_only:
-        changed = set(_changed_files())
+        changed = set(_changed_files(root))
         targets = [
             t for t in targets
-            if t.relative_to(ROOT).as_posix() in changed
+            if t.relative_to(root).as_posix() in changed
         ]
         if not targets:
             return []
-    return ast_rules.lint_paths(targets, ROOT)
+    return ast_rules.lint_paths(targets, root)
 
 
 def _graph_cases(modes):
@@ -192,16 +201,61 @@ def _graph_cases(modes):
         yield "pp", step, st, b, rng
 
 
-def run_graph(modes, *, verbose: bool = True) -> list:
+def _schedule_ir_of(step, state):
+    """The schedule IR a step carries as data: pipeline factories attach
+    ``.schedule_ir`` directly; bucketed grad-sync steps attach a
+    ``.comm_schedule`` builder keyed on the param tree."""
+    ir = getattr(step, "schedule_ir", None)
+    if ir is None and getattr(step, "comm_schedule", None) is not None:
+        ir = step.comm_schedule(state.params)
+    return ir
+
+
+def run_graph(modes, *, verbose: bool = True) -> dict:
+    """Trace/lower every requested factory config and run the graph
+    (GL0xx), sharding-flow (SF2xx), and schedule (SL3xx) passes.
+    Returns findings per layer: {"graph": [...], "flow": [...],
+    "schedule": [...]}."""
     _ensure_cpu()
+    from distributeddataparallel_tpu.analysis import (
+        schedule_lint,
+        shard_flow,
+    )
     from distributeddataparallel_tpu.analysis.graph_lint import (
         lint_train_step,
     )
+    from distributeddataparallel_tpu.observability.memory import (
+        hbm_budget_bytes,
+    )
 
-    findings = []
+    budget = hbm_budget_bytes()
+    by_layer: dict[str, list] = {"graph": [], "flow": [], "schedule": []}
     for mode, step, state, batch, rng in _graph_cases(modes):
         rep = lint_train_step(step, state, batch, rng, mode=mode)
-        findings += rep.findings
+        by_layer["graph"] += rep.findings
+
+        flow = shard_flow.analyze_step(
+            step, state, batch, rng, mode=mode, hbm_budget_bytes=budget,
+        )
+        by_layer["flow"] += flow.findings
+
+        ir = _schedule_ir_of(step, state)
+        sched = []
+        if ir is not None:
+            hops = sum(
+                c.effective_count for c in (rep.collectives or [])
+                if c.prim == ir.hop_prim and ir.hop_axis in c.axes
+                and c.nonscalar
+            )
+            sched = schedule_lint.lint_schedule(
+                ir,
+                manifest=getattr(step, "collective_manifest", None),
+                traced_hops=hops,
+                bubble=getattr(step, "bubble_accounting", None),
+                where=f"sched:{mode}:{ir.kind}",
+            )
+            by_layer["schedule"] += sched
+
         if verbose:
             counts = " ".join(
                 f"{k}={v}" for k, v in sorted(rep.collective_counts.items())
@@ -215,7 +269,14 @@ def run_graph(modes, *, verbose: bool = True) -> list:
                 f"ddplint graph [{mode}] {status} "
                 f"fp={rep.fingerprint} {counts}{donate}"
             )
-    return findings
+            n_bad = len(flow.findings) + len(sched)
+            sched_note = f" schedule={ir.kind}" if ir is not None else ""
+            print(
+                f"ddplint flow  [{mode}] "
+                f"{'ok' if not n_bad else f'{n_bad} finding(s)'} "
+                f"collectives={len(flow.collectives)}{sched_note}"
+            )
+    return by_layer
 
 
 def main(argv=None) -> int:
@@ -237,11 +298,15 @@ def main(argv=None) -> int:
                          f"{','.join(ALL_MODES)})")
     ap.add_argument("--list-rules", action="store_true",
                     help="print the rule table and exit")
+    ap.add_argument("--events-dir", metavar="DIR",
+                    help="append one lint_report event per layer to "
+                         "DIR/events-lint.jsonl")
     args = ap.parse_args(argv)
 
     from distributeddataparallel_tpu.analysis.rules import (
         format_findings,
         rule_table,
+        unregistered_rule_ids,
     )
 
     if args.list_rules:
@@ -258,16 +323,42 @@ def main(argv=None) -> int:
         ap.error(f"unknown --modes {sorted(unknown)}; pick from "
                  f"{','.join(ALL_MODES)} or 'all'")
 
-    findings = []
+    by_layer: dict[str, list] = {}
     if do_ast:
-        findings += run_ast(args.changed_only)
+        by_layer["ast"] = run_ast(args.changed_only)
     if do_graph:
         if args.changed_only and not any(
             c.startswith(_GRAPH_TRIGGERS) for c in _changed_files()
         ):
             print("ddplint graph: skipped (no step-defining changes)")
         else:
-            findings += run_graph(modes)
+            by_layer.update(run_graph(modes))
+
+    findings = [f for fs in by_layer.values() for f in fs]
+
+    if args.events_dir:
+        from distributeddataparallel_tpu.observability.events import (
+            EventLog,
+        )
+
+        path = os.path.join(args.events_dir, "events-lint.jsonl")
+        with EventLog(path, proc="lint") as ev:
+            for layer, fs in sorted(by_layer.items()):
+                ev.emit(
+                    "lint_report",
+                    layer=layer,
+                    n_findings=len(fs),
+                    rules=sorted({f.rule for f in fs}),
+                    findings=[str(f) for f in fs[:50]],
+                )
+
+    # A checker inventing a rule id is an operational error, not a
+    # finding: CI must hard-fail rather than report it alongside lint.
+    bad_ids = unregistered_rule_ids(findings)
+    if bad_ids:
+        print(f"ddplint: unregistered rule id(s) {bad_ids} — register "
+              "them in analysis/rules.py RULES", file=sys.stderr)
+        return 2
 
     if findings:
         print(format_findings(findings), file=sys.stderr)

@@ -151,6 +151,26 @@ def main(argv: list[str] | None = None) -> int:
         summary, base, thresholds=thresholds,
         metrics=gate_metrics_for(summary, source, args.default_threshold),
     )
+    # GL002 attribution: the fingerprint is an identity, not a gated
+    # metric (compare_metric treats non-numerics as missing), so it gets
+    # explicit handling — same graph means a regression is environment
+    # drift; a different graph means the program itself changed.
+    run_fp = summary.get("collective_fp")
+    base_fp = base.get("collective_fp")
+    attribution = None
+    if run_fp and base_fp:
+        attribution = (
+            "collective graph unchanged vs baseline "
+            f"(fp {run_fp}) — any regression is environment drift"
+            if run_fp == base_fp else
+            f"collective graph CHANGED vs baseline (fp {run_fp} != "
+            f"{base_fp}) — a regression is attributable to the step's "
+            "collective structure"
+        )
+        result["collective_fp"] = {
+            "run": run_fp, "baseline": base_fp,
+            "changed": run_fp != base_fp,
+        }
     if args.json:
         print(json.dumps(result, indent=2))
     else:
@@ -164,6 +184,8 @@ def main(argv: list[str] | None = None) -> int:
                 print(f"  {c['metric']:<18} {mark:>8}  "
                       f"run={c['value']:.6g} baseline={c['baseline']:.6g} "
                       f"bound={c['bound']:.6g} ({c['direction']})")
+    if attribution and not args.json:
+        print(f"  {attribution}")
     if not result["ok"]:
         print(f"perf_gate: REGRESSION vs {args.baseline!r}: "
               + ", ".join(result["regressed"]), file=sys.stderr)

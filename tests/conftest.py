@@ -29,6 +29,14 @@ except AttributeError:
         ).strip()
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: excluded from the tier-1 gate (-m 'not slow'); run "
+        "explicitly or via the dedicated CI stage",
+    )
+
+
 @pytest.fixture(scope="session")
 def devices():
     devs = jax.devices()
