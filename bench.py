@@ -1554,6 +1554,162 @@ def bench_observability() -> dict:
     return out
 
 
+def _zero_sharding_child(out_path, env):
+    """ZeRO-2/3 memory-delta measurement in a fresh 8-device CPU-mesh
+    interpreter (the acceptance target of the sharded-update work is the
+    8-device CPU mesh, and the live-array walk must not see another
+    section's leftovers).  For dp / zero2 / zero3 on the SAME GPT-2 124M
+    fixture it records, into out_path:
+
+    - perdevice_hwm_bytes: busiest-device live-array high-water mark
+      across warm steps (``live_array_bytes_per_device`` — the only view
+      that can see the sharding win; global nbytes cannot);
+    - step_s: mean warm step time (zero2/3 must stay within 10% of dp);
+    - exec memory_analysis of the compiled step (the compiler's own
+      per-device budget, the mesh-sim counterpart of the measured HWM).
+
+    Each variant rebuilds params from the same seed and drops every
+    handle before sampling, so a replicated tree from one variant can
+    never inflate the next one's HWM.
+    """
+    import gc
+    import os
+
+    os.environ.update(env)
+    import json
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    import distributeddataparallel_tpu as ddp
+    from distributeddataparallel_tpu.data.loader import shard_batch
+    from distributeddataparallel_tpu.models import TransformerLM
+    from distributeddataparallel_tpu.models.transformer import gpt2_124m
+    from distributeddataparallel_tpu.observability.memory import (
+        MemoryTelemetry,
+        executable_memory_analysis,
+    )
+    from distributeddataparallel_tpu.ops import lm_cross_entropy
+    from distributeddataparallel_tpu.parallel.zero import zero_state
+    from distributeddataparallel_tpu.training.train_step import (
+        make_train_step,
+    )
+
+    SEQ, PER_CHIP, STEPS = 128, 1, 3
+    mesh = ddp.make_mesh(("data",))
+    n = len(jax.devices())
+    cfg = gpt2_124m(max_seq_len=SEQ, scan_layers=True)
+    model = TransformerLM(cfg)
+    init = jax.jit(model.init)
+
+    def loss_fn(p, batch, rng):
+        toks = batch["tokens"]
+        logits = model.apply({"params": p}, toks[:, :-1],
+                             deterministic=True)
+        return lm_cross_entropy(logits, toks[:, 1:]), {}
+
+    npr = np.random.default_rng(0)
+    batch = shard_batch(
+        {"tokens": npr.integers(
+            0, cfg.vocab_size, size=(PER_CHIP * n, SEQ + 1)
+        ).astype(np.int32)},
+        mesh,
+    )
+    key = jax.random.PRNGKey(0)
+
+    results = {}
+    for name, level in (("dp", 0), ("zero2", 2), ("zero3", 3)):
+        params = init(
+            jax.random.PRNGKey(0), jnp.zeros((1, SEQ), jnp.int32)
+        )["params"]
+        tx = optax.adamw(3e-4)
+        if level:
+            s = zero_state(apply_fn=model.apply, params=params, tx=tx,
+                           mesh=mesh, level=level)
+        else:
+            s = ddp.broadcast_params(
+                ddp.TrainState.create(
+                    apply_fn=model.apply, params=params, tx=tx
+                ),
+                mesh,
+            )
+        # the unsharded init tree must die before sampling or it bills
+        # ~500 MB to one device under every variant alike
+        del params
+        gc.collect()
+
+        step = make_train_step(loss_fn, mesh=mesh, zero=level or False)
+        compiled = step.lower(s, batch, key).compile()
+        mem_tel = MemoryTelemetry()
+        s, _ = step(s, batch, key)  # warm (donates the init state)
+        jax.block_until_ready(jax.tree.leaves(s.params)[0])
+        t0 = time.perf_counter()
+        for i in range(STEPS):
+            s, _ = step(s, batch, key)
+            jax.block_until_ready(jax.tree.leaves(s.params)[0])
+            mem_tel.sample(i)
+        dt = (time.perf_counter() - t0) / STEPS
+        results[name] = {
+            "step_s": round(dt, 4),
+            "perdevice_hwm_bytes": mem_tel.live_perdevice_hwm_bytes,
+            "exec_memory": executable_memory_analysis(compiled),
+        }
+        del s, step, compiled
+        gc.collect()
+
+    with open(out_path, "w") as fh:
+        json.dump(results, fh)
+
+
+def bench_zero_sharding() -> dict:
+    """Sharded weight update done bar: on the 8-device CPU mesh,
+    GPT-2 124M per-device live-array HWM drops >=25% at zero2 vs dp
+    (further at zero3) while step time stays within 10% of dp."""
+    import json as _json
+    import multiprocessing as mp
+    import os
+    import tempfile
+
+    root = tempfile.mkdtemp(prefix="ddp_bench_zero_")
+    out_path = os.path.join(root, "out.json")
+    env = {
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+    }
+    ctx = mp.get_context("spawn")
+    p = ctx.Process(target=_zero_sharding_child, args=(out_path, env))
+    p.start()
+    # three variants x (compile + 4 steps) of GPT-2 on a virtual
+    # 8-device mesh: minutes on a 1-core host, like bench_observability
+    p.join(timeout=900)
+    if p.is_alive():
+        p.terminate()
+        p.join()
+        return {"error": "child timed out"}
+    if p.exitcode != 0 or not os.path.exists(out_path):
+        return {"error": f"child exit {p.exitcode}"}
+    with open(out_path) as fh:
+        out = _json.load(fh)
+    dp_hwm = out.get("dp", {}).get("perdevice_hwm_bytes") or 0
+    dp_s = out.get("dp", {}).get("step_s") or 0.0
+    for v in ("zero2", "zero3"):
+        rec = out.get(v)
+        if not rec or not dp_hwm:
+            continue
+        rec["hwm_drop_vs_dp"] = round(
+            1.0 - rec["perdevice_hwm_bytes"] / dp_hwm, 4
+        )
+        if dp_s:
+            rec["step_over_dp"] = round(rec["step_s"] / dp_s, 3)
+    out["meets_25pct_drop"] = bool(
+        out.get("zero2", {}).get("hwm_drop_vs_dp", 0.0) >= 0.25
+    )
+    return out
+
+
 def _run(fn, label: str) -> dict:
     """Run a bench section; one retry shields the driver's single shot
     from transient tunnel/compile hiccups.  Failures degrade to an error
@@ -1600,6 +1756,7 @@ def main() -> None:
     input_pipe = _run(bench_input_pipeline, "input_pipeline")
     warm = _run(bench_warm_start, "warm_start")
     obs = _run(bench_observability, "observability")
+    zshard = _run(bench_zero_sharding, "zero_sharding")
     # Config 3's done bar: can the host pipeline feed the device?
     if "host_gather_img_s" in input_pipe and "img_s_chip" in resnet:
         dev_rate = resnet["img_s_chip"] * len(jax.devices())
@@ -1639,6 +1796,7 @@ def main() -> None:
             "input_pipeline": input_pipe,
             "warm_start": warm,
             "observability": obs,
+            "zero_sharding": zshard,
         },
     }
     # Full detail: stdout (live readers) + a file next to this script —
@@ -1723,6 +1881,16 @@ def main() -> None:
                 "sync0": obs.get("zero_extra_syncs"),
                 "ok": obs.get("within_2pct"),
             },
+            # flat keys on purpose: perf_gate gates top-level numerics,
+            # and the *_bytes / *_s suffixes make them lower-is-better
+            "z2_hwm_bytes": zshard.get("zero2", {}).get(
+                "perdevice_hwm_bytes"
+            ),
+            "z3_hwm_bytes": zshard.get("zero3", {}).get(
+                "perdevice_hwm_bytes"
+            ),
+            "z2_step_s": zshard.get("zero2", {}).get("step_s"),
+            "z2_hwm_drop": zshard.get("zero2", {}).get("hwm_drop_vs_dp"),
             "detail": "BENCH_DETAIL.json (full sections)",
         },
     }

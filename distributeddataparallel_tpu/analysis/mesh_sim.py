@@ -33,7 +33,10 @@ MODELS = ("cnn", "mlp", "tiny-lm", "gpt2-small")
 
 #: modes the simulator can lower (subset of the live factories that
 #: support AOT lowering on abstract state)
-MODES = ("dp", "zero", "fsdp", "pp")
+MODES = ("dp", "zero", "zero2", "zero3", "fsdp", "pp")
+
+#: mode name -> make_train_step/zero_state sharding level (dp is 0)
+ZERO_LEVELS = {"dp": 0, "zero": 1, "zero2": 2, "zero3": 3}
 
 
 def _build_case(model: str, mode: str, mesh, batch_per_chip: int,
@@ -107,18 +110,19 @@ def _build_case(model: str, mode: str, mesh, batch_per_chip: int,
 
     tx = optax.adam(1e-3)
 
-    if mode in ("dp", "zero"):
+    if mode in ZERO_LEVELS:
         from distributeddataparallel_tpu.training.train_step import (
             make_train_step,
         )
 
-        step = make_train_step(loss_fn, mesh=mesh, zero=(mode == "zero"))
-        if mode == "zero":
+        level = ZERO_LEVELS[mode]
+        step = make_train_step(loss_fn, mesh=mesh, zero=level or False)
+        if level:
             from distributeddataparallel_tpu.parallel.zero import zero_state
 
             state = jax.eval_shape(
                 lambda p: zero_state(
-                    apply_fn=None, params=p, tx=tx, mesh=mesh
+                    apply_fn=None, params=p, tx=tx, mesh=mesh, level=level
                 ),
                 params_shape,
             )

@@ -184,6 +184,116 @@ def dequantize(qparams: Pytree, dtype=jnp.bfloat16) -> Pytree:
     return jax.tree.map(_dq, qparams, is_leaf=_is_entry)
 
 
+# ---------------------------------------------------------------------------
+# Low-bit optimizer moments (training-time, ZeRO sharded update)
+#
+# Unlike the weight-only serving path above, moment compression is a
+# LOSSY round-trip applied every step: state -> low-bit -> state.  The
+# error compensation is stochastic rounding — E[sr(x)] == x — so the
+# quantization noise enters the moment EMA as zero-mean noise instead of
+# a systematic truncation bias (the arXiv:2004.13336 appendix argument
+# for low-precision accumulators, and the same mechanism 8-bit Adam
+# relies on).  Deterministic round-to-nearest would bias small updates
+# toward zero and stall the tail of training.
+# ---------------------------------------------------------------------------
+
+#: Block length for blockwise-absmax int8 moments.  Small enough that
+#: one outlier only poisons 2048 neighbours' scale, large enough that
+#: the f32 scales are a 0.2% overhead on the int8 payload.
+MOMENT_BLOCK = 2048
+
+
+def stochastic_round_bf16(x: jax.Array, key: jax.Array) -> jax.Array:
+    """f32 -> bf16 with unbiased stochastic rounding.
+
+    bf16 is f32 with the low 16 mantissa bits dropped; adding uniform
+    16-bit noise to the f32 bit pattern before truncation rounds up
+    with probability equal to the dropped fraction, so the expectation
+    over keys is exactly ``x``.  Non-finite values bypass the bit
+    arithmetic (adding noise to an inf/nan pattern would walk into
+    adjacent NaN encodings)."""
+    f = x.astype(jnp.float32)
+    bits = jax.lax.bitcast_convert_type(f, jnp.uint32)
+    noise = jax.random.bits(key, f.shape, jnp.uint32) & jnp.uint32(0xFFFF)
+    rounded = jax.lax.bitcast_convert_type(
+        ((bits + noise) >> 16).astype(jnp.uint16), jnp.bfloat16
+    )
+    return jnp.where(jnp.isfinite(f), rounded, f.astype(jnp.bfloat16))
+
+
+#: Smallest representable magnitude of the int8 dynamic codebook,
+#: relative to the block absmax — IN THE SQRT DOMAIN (see
+#: quantize_moment_int8), so the smallest representable linear value is
+#: absmax * 1e-14.  Linear absmax int8 zeroes everything below
+#: absmax/127 — fatal for adam's second moment, whose elements are
+#: squared gradients spanning twice the decades of the first moment
+#: within one block and sit under a sqrt in the update denominator
+#: (nu -> 0 turns the update into m/eps and the run diverges within a
+#: handful of steps).  A geometric grid bounds the RELATIVE error
+#: instead, and quantizing sign(v)*sqrt(|v|) halves the log-range so
+#: every nu whose mu is representable is representable too.
+Q8_DYNAMIC_MIN = 1e-7
+
+
+def _q8_codebook() -> "np.ndarray":
+    """Sorted signed dynamic codebook, 255 entries: exact 0 plus +/-127
+    log-spaced magnitudes over [Q8_DYNAMIC_MIN, 1].  Stored index is
+    ``idx - 127`` so it fits int8."""
+    import numpy as np
+
+    mag = Q8_DYNAMIC_MIN ** ((126 - np.arange(127)) / 126.0)
+    return np.concatenate([-mag[::-1], [0.0], mag]).astype(np.float32)
+
+
+@flax.struct.dataclass
+class Q8Moment:
+    """A flat f32 optimizer-moment vector stored as int8 dynamic-
+    codebook indices + a per-block f32 absmax (block = MOMENT_BLOCK).
+    ``n`` is the unpadded length (the vector is zero-padded up to a
+    block multiple for the (blocks, MOMENT_BLOCK) reshape)."""
+
+    q: jax.Array      # int8 codebook index - 127, (n_blocks * MOMENT_BLOCK,)
+    scale: jax.Array  # f32 per-block absmax, (n_blocks,)
+    n: int = flax.struct.field(pytree_node=False)
+
+
+def quantize_moment_int8(x: jax.Array, key: jax.Array) -> Q8Moment:
+    """Flat f32 vector -> Q8Moment, quantized as sign(v)*sqrt(|v|) on
+    the dynamic codebook and stochastically rounded between the two
+    adjacent entries, so E[quant(x)] == x in the sqrt domain (the
+    error-compensation property the moment EMA needs; the squared-back
+    linear value overshoots by the rounding variance, which shrinks
+    adam updates — the safe direction).  The sqrt transform is what
+    keeps adam's second moment alive: nu is a squared-gradient EMA, so
+    an element whose mu fits the grid can have nu below ANY practical
+    linear floor; in sqrt space both moments share one dynamic range."""
+    n = x.shape[0]
+    pad = (-n) % MOMENT_BLOCK
+    f = jnp.pad(x.astype(jnp.float32), (0, pad)).reshape(-1, MOMENT_BLOCK)
+    absmax = jnp.max(jnp.abs(f), axis=1)
+    scale = jnp.where(absmax > 0, absmax, 1.0)
+    v = f / scale[:, None]
+    y = jnp.sign(v) * jnp.sqrt(jnp.abs(v))
+    code = jnp.asarray(_q8_codebook())
+    hi = jnp.clip(jnp.searchsorted(code, y), 1, code.shape[0] - 1)
+    lo = hi - 1
+    c_lo, c_hi = code[lo], code[hi]
+    p = (y - c_lo) / (c_hi - c_lo)
+    u = jax.random.uniform(key, y.shape, dtype=y.dtype)
+    idx = jnp.where(u < p, hi, lo)
+    return Q8Moment(
+        q=(idx - 127).astype(jnp.int8).reshape(-1), scale=scale, n=n
+    )
+
+
+def dequantize_moment(m: Q8Moment) -> jax.Array:
+    """Q8Moment -> flat f32 vector of the original (unpadded) length."""
+    code = jnp.asarray(_q8_codebook())
+    z = code[m.q.astype(jnp.int32) + 127].reshape(-1, MOMENT_BLOCK)
+    f = jnp.sign(z) * z * z * m.scale[:, None]
+    return f.reshape(-1)[: m.n]
+
+
 def quantized_bytes(qparams: Pytree) -> dict:
     """Byte ledger of a (possibly) quantized tree — what the decode scan
     actually streams from HBM per step."""

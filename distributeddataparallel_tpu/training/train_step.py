@@ -56,7 +56,7 @@ def make_train_step(
     overlap: bool = False,
     donate: bool = True,
     with_model_state: bool = False,
-    zero: bool = False,
+    zero: bool | int = False,
     grad_sync: bool = True,
     buffer_sync: str = "mean",
     cp_axis: str | None = None,
@@ -127,11 +127,24 @@ def make_train_step(
     no cross-shard consistency, silently corrupting the low-rank
     approximation rather than degrading gracefully.
 
-    With ``zero=True``, optimizer state is ZeRO-1-sharded across the data
-    axis (see ``parallel.zero``): grads reduce_scatter instead of
-    all-reduce, the update runs on each replica's 1/N shard, updated
-    params all_gather back.  ``state`` must come from ``zero_state``.
-    Mutually exclusive with ``bucket_bytes``.
+    ``zero`` selects the weight-update sharding level (``parallel.zero``,
+    arXiv 2004.13336).  ``True``/``1``: ZeRO-1 — grads reduce_scatter as
+    one flat vector, the update runs on each replica's 1/N shard,
+    updated params all_gather back; ``state`` must come from
+    ``zero_state``; mutually exclusive with ``bucket_bytes``/``overlap``.
+    ``2``: the BUCKETED layout — grads leave backward via per-bucket
+    reduce-scatter (the full reduced f32 gradient vector never
+    materializes), update on the shard, per-bucket all-gather back;
+    ``bucket_bytes`` now sets the bucket granularity (must match
+    ``zero_state(level=2, bucket_bytes=...)``) and ``overlap`` composes
+    (the TPU latency-hiding options schedule the bucket gathers under
+    tail-of-step compute).  ``3``: additionally params STAY sharded
+    between steps (``Zero3Params``) and re-gather bucketwise inside the
+    differentiated function at the top of each step, so AD's transpose
+    of the gather reduce-scatters the grads; the state never holds a
+    replicated param tree.  Levels 2/3 shard over the data axis only
+    (no tp/ep composition — use level 1 or fsdp for that); both expose
+    their scatter/gather stream as a ``comm_schedule`` IR for SL302.
 
     ``presynced`` (a predicate on gradient-leaf key paths, e.g.
     ``lambda path: path[0] == "layers"``) marks leaves whose gradients
@@ -205,9 +218,21 @@ def make_train_step(
     ``zero=True`` composes with both by the same local-flat-shard
     argument (build the state with ``zero_state(..., ep_axis=...)``).
     """
-    if zero and (bucket_bytes is not None or overlap):
-        raise ValueError("zero=True does its own reduction; drop "
-                         "bucket_bytes/overlap")
+    zero_level = int(zero)
+    if zero_level not in (0, 1, 2, 3):
+        raise ValueError(f"zero={zero!r} (want False/True or a level 0-3)")
+    if zero_level == 1 and (bucket_bytes is not None or overlap):
+        # Level 1's single monolithic flat has no buckets to size or
+        # overlap; levels 2/3 accept both (bucket granularity + the TPU
+        # latency-hiding compile options).
+        raise ValueError("zero=1 does its own reduction; drop "
+                         "bucket_bytes/overlap (or use zero=2/3, whose "
+                         "bucketed stream composes with both)")
+    if zero_level >= 2 and (tp_axis is not None or ep_axis is not None):
+        raise ValueError(
+            "zero=2/3 shard over the data axis only; compose tp/ep with "
+            "zero=1 or the fsdp path"
+        )
     if presynced is not None and (zero or not grad_sync):
         # ZeRO's reduce_scatter SUMS shards: feeding it leaves the model
         # already averaged would divide those grads by the axis size
@@ -273,7 +298,7 @@ def make_train_step(
         "overlap": overlap,
         "donate": donate,
         "with_model_state": with_model_state,
-        "zero": zero,
+        "zero": zero_level,
         "grad_sync": grad_sync,
         "buffer_sync": buffer_sync,
         "cp_axis": cp_axis,
@@ -312,10 +337,17 @@ def make_train_step(
         for p in ("psum", "reduce_scatter", "psum_scatter", "all_gather",
                   "ppermute", "all_to_all")
     }
-    if zero:
+    if zero_level:
+        # All levels promise reduce_scatter in, all_gather out.  Levels
+        # 2/3 additionally promise NO gradient-sized dense psum survives
+        # lowering: with no model-state buffers to sync, every psum in
+        # the program is a scalar (loss/metrics/clip-norm), so the
+        # nonscalar-psum bound is EXACTLY zero — a reintroduced dense
+        # all-reduce fails GL001 by count, not just SF201 by size.
+        ps = (0, None) if (with_model_state or zero_level == 1) else (0, 0)
         _reduce = {axis_name: {"reduce_scatter": (1, None),
                                "all_gather": (1, None),
-                               "psum": (0, None)}}
+                               "psum": ps}}
     elif not grad_sync:
         # no_sync analog: gradients stay per-replica; scalar metric
         # pmeans are uncounted, so just declare the axis with no floor.
@@ -333,7 +365,8 @@ def make_train_step(
         and not nonfinite_guard and grad_clip is None
     )
     collective_manifest_ = collective_manifest(
-        "zero" if zero else "dp",
+        ("zero" if zero_level == 1 else f"zero{zero_level}")
+        if zero_level else "dp",
         grad_reduce=_reduce,
         donate=donate,
         # coalesced buckets and ZeRO master flats legitimately reduce f32
@@ -343,14 +376,18 @@ def make_train_step(
         per_leaf_axes=(axis_name,) if _exact else (),
     )
 
-    def _micro(params, model_state, mb, rng):
-        """One microbatch: returns (loss, aux, new_model_state, grads)."""
+    def _micro(lf, params, model_state, mb, rng):
+        """One microbatch: returns (loss, aux, new_model_state, grads).
+        ``lf`` is the (possibly wrapped) loss function — zero3 passes a
+        wrapper that gathers the flat param shard first, so the grads
+        here are the flat cotangent, already reduce-scattered by the
+        gather's transpose."""
         if with_model_state:
             (loss, (aux, new_ms)), grads = jax.value_and_grad(
-                loss_fn, has_aux=True
+                lf, has_aux=True
             )(params, model_state, mb, rng)
         else:
-            (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            (loss, aux), grads = jax.value_and_grad(lf, has_aux=True)(
                 params, mb, rng
             )
             new_ms = model_state
@@ -365,9 +402,32 @@ def make_train_step(
         if cp_axis is not None:
             rng = jax.random.fold_in(rng, lax.axis_index(cp_axis))
 
+        if zero_level == 3:
+            # Differentiate w.r.t. the flat shard: the bucketwise gather
+            # runs INSIDE the loss, so backward's transpose of it IS the
+            # per-bucket reduce-scatter of the grads (sum semantics —
+            # zero3_update divides by the axis size).
+            from distributeddataparallel_tpu.parallel.zero import (
+                zero3_gather,
+            )
+
+            _meta = state.params.meta
+            if with_model_state:
+                lf = lambda flat, ms, mb, r: loss_fn(
+                    zero3_gather(flat, _meta, axis_name), ms, mb, r
+                )
+            else:
+                lf = lambda flat, mb, r: loss_fn(
+                    zero3_gather(flat, _meta, axis_name), mb, r
+                )
+            params_in = state.params.flat
+        else:
+            lf = loss_fn
+            params_in = state.params
+
         if accum_steps == 1:
             loss, aux, new_ms, grads = _micro(
-                state.params, state.model_state, batch, rng
+                lf, params_in, state.model_state, batch, rng
             )
         else:
             # no_sync analog: accumulate locally, reduce once at the end.
@@ -386,7 +446,7 @@ def make_train_step(
             def body(carry, xs):
                 acc_grads, acc_loss, acc_aux, ms = carry
                 mb, step_rng = xs
-                l, a, ms, g = _micro(state.params, ms, mb, step_rng)
+                l, a, ms, g = _micro(lf, params_in, ms, mb, step_rng)
                 acc_grads = jax.tree.map(jnp.add, acc_grads, g)
                 return (acc_grads, acc_loss + l, jax.tree.map(jnp.add, acc_aux, a), ms), None
 
@@ -395,7 +455,8 @@ def make_train_step(
             # the scan body).
             first_mb = jax.tree.map(lambda x: x[0], micro)
             l_s, a_s, _, g_s = jax.eval_shape(
-                _micro, state.params, state.model_state, first_mb, rng
+                functools.partial(_micro, lf),
+                params_in, state.model_state, first_mb, rng
             )
             zeros = lambda t: jax.tree.map(
                 lambda s: jnp.zeros(s.shape, s.dtype), t
@@ -444,7 +505,7 @@ def make_train_step(
                 lambda g: jnp.where(ok, g, jnp.zeros_like(g)), grads
             )
 
-        if zero:
+        if zero_level == 1:
             # ZeRO-1: reduce_scatter + sharded update + all_gather.
             from distributeddataparallel_tpu.parallel.zero import zero_update
 
@@ -464,6 +525,42 @@ def make_train_step(
             )
             new_state = state.replace(
                 step=state.step + 1, params=new_params,
+                opt_state=new_opt_state,
+            )
+        elif zero_level == 2:
+            # ZeRO-2: bucketed reduce-scatter straight into the shard,
+            # sharded update, bucketed all-gather back.
+            from distributeddataparallel_tpu.parallel.zero import (
+                bucket_plan,
+                zero2_update,
+            )
+
+            plan = bucket_plan(
+                state.params, mesh.shape[axis_name], bucket_bytes
+            )
+            new_params, new_opt_state = zero2_update(
+                grads, state, axis_name, mesh.shape[axis_name], plan,
+                clip_norm=grad_clip,
+            )
+            new_state = state.replace(
+                step=state.step + 1, params=new_params,
+                opt_state=new_opt_state,
+            )
+        elif zero_level == 3:
+            # ZeRO-3: grads arrived flat and reduce-scattered (gather
+            # transpose); the updated shard IS the next state's params.
+            from distributeddataparallel_tpu.parallel.zero import (
+                Zero3Params,
+                zero3_update,
+            )
+
+            new_flat, new_opt_state = zero3_update(
+                grads, state, axis_name, mesh.shape[axis_name],
+                clip_norm=grad_clip,
+            )
+            new_state = state.replace(
+                step=state.step + 1,
+                params=Zero3Params(flat=new_flat, meta=_meta),
                 opt_state=new_opt_state,
             )
         else:
@@ -637,8 +734,37 @@ def make_train_step(
         # depends on the param tree, so it can't be a constant like the
         # pipeline tick tables).  Compressed sync reduces factors, not
         # buckets — no IR.
-        if (
-            grad_sync and not zero and grad_compress is None
+        if zero_level >= 2:
+            # zero2's lintable hop stream is the per-bucket grad
+            # reduce-scatter (once per step, outside any accum scan);
+            # zero3's is the per-bucket param all-gather, which runs
+            # inside the microbatch — so its tick count multiplies by
+            # accum_steps, exactly as the traced-hop counter sees it.
+            from distributeddataparallel_tpu.analysis.schedule_lint import (
+                grad_sync_schedule_ir,
+            )
+            from distributeddataparallel_tpu.parallel.zero import (
+                Zero3Params,
+                bucket_plan,
+            )
+
+            prim = "reduce_scatter" if zero_level == 2 else "all_gather"
+
+            def _zero_cs(params):
+                if isinstance(params, Zero3Params):
+                    nb = params.meta.plan.n_buckets
+                else:
+                    nb = bucket_plan(
+                        params, mesh.shape[axis_name], bucket_bytes
+                    ).n_buckets
+                ticks = nb * (accum_steps if zero_level == 3 else 1)
+                return grad_sync_schedule_ir(
+                    ticks, axis=axis_name, prim=prim
+                )
+
+            fn.comm_schedule = _zero_cs
+        elif (
+            grad_sync and not zero_level and grad_compress is None
             and (bucket_bytes is not None or overlap)
         ):
             from distributeddataparallel_tpu.parallel.overlap import (
@@ -655,7 +781,7 @@ def make_train_step(
         return fn
 
     if (
-        not zero and tp_axis is None and ep_axis is None
+        not zero_level and tp_axis is None and ep_axis is None
         and grad_compress != "powersgd"
     ):
         sharded = jax.shard_map(
@@ -680,7 +806,7 @@ def make_train_step(
     def _build(state: TrainState):
         nonlocal compiled
         if compiled is None:
-            if zero:
+            if zero_level:
                 from distributeddataparallel_tpu.parallel.zero import (
                     state_specs,
                 )

@@ -169,9 +169,24 @@ def parse_args(argv=None):
                    help="dtype for FSDP weight gathers: bf16 halves "
                         "collective bytes and gathered-weight residency "
                         "(f32 master storage either way)")
-    p.add_argument("--zero", action="store_true",
-                   help="ZeRO-1 optimizer-state sharding across the data "
-                        "axis (reduce_scatter + sharded update + all_gather)")
+    p.add_argument("--zero", type=int, nargs="?", const=1, default=0,
+                   choices=[0, 1, 2, 3], metavar="LEVEL",
+                   help="ZeRO weight-update sharding across the data axis. "
+                        "--zero (or --zero 1): optimizer state 1/N "
+                        "(reduce_scatter + sharded update + all_gather). "
+                        "--zero 2: bucketed reduce-scatter straight into "
+                        "the 1/N flat grad shard (the full flat f32 grad "
+                        "copy never materializes). --zero 3: params stay "
+                        "sharded between steps too (1/N stored), gathered "
+                        "per bucket inside the step. Levels 2/3 are "
+                        "data-axis only and compose with --bucket-mb and "
+                        "--overlap")
+    p.add_argument("--moment-dtype", choices=["f32", "bf16", "int8"],
+                   default=None,
+                   help="optimizer-moment storage under --zero: bf16 or "
+                        "blockwise int8 with stochastic rounding "
+                        "(error-compensated, ops/quant.py) halve/quarter "
+                        "the moment bytes; f32 = unchanged")
     p.add_argument("--fsdp", action="store_true",
                    help="fully-sharded data parallelism (ZeRO-3): params, "
                         "grads, and optimizer state all 1/N per device; "
@@ -560,13 +575,32 @@ def validate_args(args) -> None:
                              "step; drop --fsdp/--pp")
         if args.max_bad_steps < 1:
             raise SystemExit("--max-bad-steps must be >= 1")
-    if args.overlap:
-        # ZeRO/FSDP/PP own their reductions (reduce_scatter / per-layer
-        # gathers / stage collectives) — the chained-bucket overlap path
-        # is the plain-DP all-reduce's.
+    if args.zero >= 2:
+        # Levels 2/3 shard the update over the data axis only; the
+        # model-axis compositions ride ZeRO-1's flat layouts.
         bad = [
             f for f, on in (
-                ("--zero", args.zero), ("--fsdp", args.fsdp),
+                ("--tp", args.tp > 1), ("--ep", args.ep > 1),
+                ("--pp", args.pp > 1),
+            ) if on
+        ]
+        if bad:
+            raise SystemExit(
+                f"--zero {args.zero} shards over the data axis only; "
+                f"drop {', '.join(bad)} or use --zero 1"
+            )
+    if args.moment_dtype and not args.zero:
+        raise SystemExit("--moment-dtype rides the ZeRO sharded update; "
+                         "add --zero")
+    if args.overlap:
+        # ZeRO-1/FSDP/PP own their reductions (reduce_scatter /
+        # per-layer gathers / stage collectives) — the chained-bucket
+        # overlap path is the plain-DP all-reduce's.  ZeRO-2/3 already
+        # reduce per bucket, so --overlap there only adds the
+        # latency-hiding compiler options.
+        bad = [
+            f for f, on in (
+                ("--zero", args.zero == 1), ("--fsdp", args.fsdp),
                 ("--pp", args.pp > 1),
             ) if on
         ]
@@ -1013,6 +1047,12 @@ def train(args) -> float:
             ep_axis="expert" if args.ep > 1 else None,
             pp_axis="pipe" if args.pp > 1 else None,
             model_state=model_state,
+            level=args.zero,
+            moment_dtype=args.moment_dtype,
+            bucket_bytes=(
+                int(args.bucket_mb * 1024 * 1024)
+                if args.bucket_mb and args.zero >= 2 else None
+            ),
         )
     elif args.pp > 1:
         state = ddp.TrainState.create(
@@ -1271,6 +1311,16 @@ def train(args) -> float:
                     lambda x: x.astype(ml_dtypes.bfloat16), host
                 )
             return jax.tree.map(jnp.asarray, host)
+        if args.zero >= 3:
+            # ZeRO-3 stores params as a flat 1/N shard; reassemble the
+            # model-layout tree (device-side: the zero3 scale ceiling is
+            # the opt+param residency, and eval needs the full tree
+            # resident anyway).
+            from distributeddataparallel_tpu.parallel.zero import (
+                zero3_gather_params,
+            )
+
+            return zero3_gather_params(state, mesh)
         return state.params
 
     # Fault-tolerance wiring (training.fault_tolerance / utils.chaos):
@@ -1341,7 +1391,7 @@ def train(args) -> float:
         ckpt_meta = topology_meta(
             mesh,
             "fsdp" if args.fsdp
-            else "zero1" if args.zero
+            else f"zero{args.zero}" if args.zero
             else "replicated",
             tp_axis=flat_tp,
             ep_axis=flat_ep,

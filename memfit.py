@@ -274,6 +274,45 @@ def analyze(seq_len: int, microbatches=(1, 2)) -> dict:
             "fsdp32_max_mb_v5e": max_mb(hbm, fsdp32_fixed),
         })
 
+    # ZeRO-level ladder (sharded weight update, adamw, N=8 data shards):
+    # per-chip PEAK coefficient on params bytes P and between-step STORED
+    # state, from the parallel/zero.py byte model.  Peak counts params +
+    # grads + opt-state residency; zero1 and zero2 share a peak line (opt
+    # at 1/N) — zero2's win over zero1 is the scatter TRANSIENT (one
+    # ~1 MiB bucket instead of a full P-byte flat f32 grad copy) and is
+    # below the GB resolution of this table.  zero3 is peak-honest for
+    # the implemented full-gather step: the gathered param tree is live
+    # at peak, so peak EXCEEDS zero2 by P/N while stored drops to
+    # (params + opt)/N — the stored column is what checkpoint/resident
+    # HWM telemetry sees (bench zero_sharding, mesh_sim).
+    ZN = 8
+    P = params_bytes
+    adamw_opt = _tree_bytes(
+        _abstract_state(full_model, optax.adamw(3e-4)).opt_state
+    )
+    opt_coeff = adamw_opt / max(P, 1)  # 2.0 for adamw
+    zero_levels = []
+    for name, peak_coeff, stored in (
+        ("dp", 2.0 + opt_coeff, P + adamw_opt),
+        ("zero1", 2.0 + opt_coeff / ZN, P + adamw_opt / ZN),
+        ("zero2", 2.0 + opt_coeff / ZN, P + adamw_opt / ZN),
+        ("zero3", 2.0 + (1 + opt_coeff) / ZN, (P + adamw_opt) / ZN),
+    ):
+        fixed = peak_coeff * P + residual
+        headroom = max(V5P_HBM_BYTES - residual - slope, 0)
+        zero_levels.append({
+            "level": name,
+            "stored_gb": gb(stored),
+            "fixed_gb": gb(fixed),
+            "max_mb_v5e": max_mb(hbm, fixed),
+            "max_mb_v5p": max_mb(V5P_HBM_BYTES, fixed),
+            # largest f32 param count whose mb=1 step still fits a v5p
+            # chip: invert fixed(P) = coeff*P + residual at one act row
+            "max_params_b_v5p_mb1": round(
+                headroom / peak_coeff / 4 / 1e9, 2
+            ),
+        })
+
     return {
         "device_kind": dev.device_kind,
         "seq_len": seq_len,
@@ -283,6 +322,7 @@ def analyze(seq_len: int, microbatches=(1, 2)) -> dict:
         "model_fixed_gb": gb(model_fixed),
         "validations": checks,
         "optimizers": rows,
+        "zero_levels": zero_levels,
     }
 
 
@@ -343,6 +383,27 @@ def main() -> None:
             f"| {row['fsdp8_fixed_gb']} GB | {row['fsdp8_max_mb_v5p']} "
             f"| {row['fsdp8_max_mb_v5e']} "
             f"| {row['fsdp32_fixed_gb']} GB | {row['fsdp32_max_mb_v5e']} |"
+        )
+    print()
+    print("## Sharded weight update (ZeRO ladder, adamw, N=8 data "
+          "shards)\n")
+    print("Per-chip byte model of the parallel/zero.py update path.  "
+          "'Stored' is the between-step resident state (what HWM "
+          "telemetry and checkpoints see); 'peak' adds the transient "
+          "gradients (and for zero3 the gathered param tree, which the "
+          "implemented full-gather step keeps live at peak — zero3 "
+          "trades a slightly higher peak for 1/N stored params).  zero1 "
+          "and zero2 share a peak line: zero2's win is the scatter "
+          "transient (one ~1 MiB bucket, not a full flat f32 grad "
+          "copy), below this table's GB resolution.\n")
+    print("| level | stored / chip | peak fixed | max mb (v5e 16G) | "
+          "max mb (v5p 95G) | max f32 params @mb=1 (v5p) |")
+    print("|---|---|---|---|---|---|")
+    for z in r["zero_levels"]:
+        print(
+            f"| {z['level']} | {z['stored_gb']} GB | {z['fixed_gb']} GB "
+            f"| {z['max_mb_v5e']} | {z['max_mb_v5p']} "
+            f"| {z['max_params_b_v5p_mb1']} B |"
         )
     import json
     print("\n```json")

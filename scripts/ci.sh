@@ -11,15 +11,20 @@
 #                               registry doesn't know) is an operational
 #                               hard failure, distinct from findings
 #   2. ddp_meshsim --check      compile-only scale smoke: cnn + gpt2-small
-#                               lowered/linted/sized on fake 8- and
-#                               32-device CPU meshes — catches lowering
-#                               breaks and SF2xx/SL3xx regressions at
-#                               topologies the tests never build
+#                               (dp AND the zero2/zero3 sharded-update
+#                               variants) lowered/linted/sized on fake 8-
+#                               and 32-device CPU meshes — catches
+#                               lowering breaks and SF2xx/SL3xx
+#                               regressions at topologies the tests
+#                               never build
 #   3. check_events --schema-sync
 #                               two-way emitter <-> EVENT_KINDS diff, so
 #                               a kind added on one side only is a hard
 #                               error in BOTH directions
-#   4. tier-1 pytest            the ROADMAP verify command (CPU, not slow)
+#   4. tier-1 pytest            the ROADMAP verify command (CPU, not
+#                               slow).  Includes the ZeRO-2/3 bitwise
+#                               dp-parity + low-bit-moment convergence
+#                               tests (tests/test_zero23.py)
 #
 # Opt-in perf regression gate (off by default so tier-1 stays
 # deterministic — perf numbers need a quiet, consistent host):
@@ -30,6 +35,12 @@
 #                              (default runs/); non-zero exit on
 #                              regression.  Seed a baseline first with
 #                              scripts/perf_gate.py ... --update-baseline
+#                              BENCH headlines carry z2_hwm_bytes /
+#                              z3_hwm_bytes / z2_step_s (the zero2/zero3
+#                              per-device live-HWM and step time) — the
+#                              *_bytes/*_s suffixes make them
+#                              lower-is-better, so a sharded-update
+#                              memory regression fails this stage
 set -euo pipefail
 cd "$(dirname "$0")/.."
 

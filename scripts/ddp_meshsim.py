@@ -45,9 +45,13 @@ sys.path.insert(0, ROOT)
 FINDINGS_EXIT = 2
 
 #: --check preset: enough to catch a broken lowering or a lint
-#: regression on both a conv net and the transformer path, small
-#: enough to stay in CI budget
-CHECK_CASES = ("cnn:dp", "gpt2-small:dp")
+#: regression on both a conv net and the transformer path — including
+#: the sharded-update variants (zero2: reduce-scatter manifest + IR;
+#: zero3: params resident as a flat shard, gather-per-bucket IR) —
+#: small enough to stay in CI budget
+CHECK_CASES = (
+    "cnn:dp", "gpt2-small:dp", "gpt2-small:zero2", "gpt2-small:zero3",
+)
 CHECK_DEVICES = (8, 32)
 
 
@@ -125,7 +129,9 @@ def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--model", default="gpt2-small",
                    help="cnn | mlp | tiny-lm | gpt2-small")
-    p.add_argument("--mode", default="dp", help="dp | zero | fsdp | pp")
+    p.add_argument("--mode", default="dp",
+                   help="dp | zero | zero2 | zero3 | fsdp | pp | all "
+                        "(all = every mode the model supports)")
     p.add_argument("--devices", default="8",
                    help="comma-separated fake device counts (one "
                         "subprocess each)")
@@ -172,7 +178,15 @@ def main(argv: list[str] | None = None) -> int:
         cases = [tuple(c.split(":")) for c in CHECK_CASES]
         devices = list(CHECK_DEVICES)
     else:
-        cases = [(args.model, args.mode)]
+        if args.mode == "all":
+            # fsdp/pp lower transformers only; the sharded-update
+            # family (dp/zero*) lowers everything
+            modes = ["dp", "zero", "zero2", "zero3"]
+            if args.model not in ("cnn", "mlp"):
+                modes += ["fsdp", "pp"]
+            cases = [(args.model, m) for m in modes]
+        else:
+            cases = [(args.model, args.mode)]
         try:
             devices = [int(d) for d in args.devices.split(",") if d]
         except ValueError:
