@@ -110,6 +110,105 @@ def _fix_unstacked_quant(params, dtype):
     return {**params, "layers": fixed}
 
 
+def _live_params(cfg, params, quantized: bool):
+    """The serving param policy, applied per apply (traced — fuses into
+    the consumer jit).
+
+    Quantized trees (ops.quant): dequantize PER APPLY so the bf16
+    matrices are produced on-chip inside each matmul's operand fusion
+    and decode streams int8 from HBM — hoisting one dequant out would
+    re-materialize the bf16 tree and forfeit the bandwidth win.  Scanned
+    configs go further: the stacked 'layers' subtree passes through AS
+    QuantLeaf nodes and dequantizes per layer slice inside the layer
+    scan (cfg.quant_serving / _ScanBlock) — dequantizing the whole stack
+    here would materialize it in full per decode step.
+
+    Dense f32 masters: cast to the compute dtype (a no-op identity map
+    when the caller already pre-cast — decode is weight-streaming-bound,
+    so loops should cast once and reuse; see _generate_jit).
+    """
+    if quantized:
+        from distributeddataparallel_tpu.ops.quant import dequantize
+
+        if cfg.scan_layers:
+            return {
+                k: (v if k == "layers" else dequantize(v, cfg.dtype))
+                for k, v in params.items()
+            }
+        return dequantize(params, cfg.dtype)
+    if cfg.dtype != jnp.float32:
+        return jax.tree.map(
+            lambda p: p.astype(cfg.dtype)
+            if p.dtype == jnp.float32 else p,
+            params,
+        )
+    return params
+
+
+def init_cache(model, batch_size: int):
+    """Allocate the decode twin's KV cache for ``batch_size`` rows.
+
+    Shapes depend only on ``batch_size`` and ``cfg.max_seq_len`` (each
+    layer holds ``cached_key``/``cached_value`` of shape
+    ``(B, max_seq_len, kv_heads, head_dim)``; scanned configs stack a
+    leading layer dim).  The init-time params are discarded — callers
+    apply with their own.
+    """
+    dm = model if model.cfg.decode else decode_model(model)
+    return dm.init(
+        jax.random.PRNGKey(0),
+        jnp.zeros((batch_size, 1), jnp.int32),
+        positions=jnp.arange(1),
+    )["cache"]
+
+
+def _step_fns(dm, quantized: bool):
+    """(prefill_fn, decode_fn) over an already-built decode twin."""
+    cfg = dm.cfg
+
+    def prefill_fn(params, cache, tokens, positions):
+        """One prefill apply: ``tokens`` (B, S) at global ``positions``
+        (S,) — chunked prefill passes successive chunks with
+        ``positions = start + arange(S)``.  Returns ((B, S, V) logits,
+        updated cache)."""
+        logits, upd = dm.apply(
+            {"params": _live_params(cfg, params, quantized),
+             "cache": cache},
+            tokens, positions=positions, mutable=["cache"],
+        )
+        return logits, upd["cache"]
+
+    def decode_fn(params, cache, token, pos):
+        """One decode step: ``token`` (B, 1); ``pos`` is either a shared
+        (1,) global position or a per-row (B, 1) position vector
+        (continuous batching — every slot at its own length).  Returns
+        ((B, 1, V) logits, updated cache)."""
+        logits, upd = dm.apply(
+            {"params": _live_params(cfg, params, quantized),
+             "cache": cache},
+            token, positions=pos, mutable=["cache"],
+        )
+        return logits, upd["cache"]
+
+    return prefill_fn, decode_fn
+
+
+def make_step_fns(model, *, quantized: bool = False):
+    """Build reusable ``(prefill_fn, decode_fn)`` over ``model``'s
+    decode twin, for callers that drive decoding step-by-step (the
+    serving engine's continuous-batching loop) instead of through the
+    closed ``generate()`` scan.
+
+    Both returned fns are pure ``(params, cache, tokens, positions) ->
+    (logits, new_cache)`` — jit them with your own donation/sharding
+    policy.  ``params`` follow the ``generate()`` convention: raw
+    training params, or an ops.quant int8 tree when ``quantized=True``.
+    Allocate ``cache`` with :func:`init_cache`.
+    """
+    dm = _quant_decode_model(model) if quantized else decode_model(model)
+    return _step_fns(dm, quantized)
+
+
 @functools.partial(
     jax.jit,
     static_argnums=(0, 3),
@@ -121,54 +220,26 @@ def _generate_jit(
 ):
     cfg = model.cfg
     B, P = prompt.shape
+    prefill_fn, decode_fn = _step_fns(model, quantized)
 
-    if quantized:
-        # Weight-only int8 serving (ops.quant): ``params`` is the
-        # quantized tree; dequantize PER APPLY (below) so the bf16
-        # matrices are produced on-chip inside each matmul's operand
-        # fusion and the scan streams int8 from HBM — hoisting one
-        # dequant up here would re-materialize the bf16 tree and
-        # forfeit the bandwidth win.  Scanned configs go further: the
-        # stacked 'layers' subtree passes through AS QuantLeaf nodes and
-        # dequantizes per layer slice inside the layer scan
-        # (cfg.quant_serving / _ScanBlock) — dequantizing the whole
-        # stack here would materialize it in full per decode step.
-        from distributeddataparallel_tpu.ops.quant import dequantize
+    if not quantized and cfg.dtype != jnp.float32:
+        # Decode is weight-streaming-bound: every step reads the whole
+        # matrix stack from HBM.  Cast f32 masters to the compute dtype
+        # ONCE here (inside the jit: one fused device pass, amortized
+        # over the whole generation) so the scan streams half the
+        # bytes; _live_params then sees an already-cast tree and is an
+        # identity map.
+        params = jax.tree.map(
+            lambda p: p.astype(cfg.dtype)
+            if p.dtype == jnp.float32 else p,
+            params,
+        )
 
-        if cfg.scan_layers:
-            live = lambda: {  # noqa: E731
-                k: (v if k == "layers" else dequantize(v, cfg.dtype))
-                for k, v in params.items()
-            }
-        else:
-            live = lambda: dequantize(params, cfg.dtype)  # noqa: E731
-    else:
-        if cfg.dtype != jnp.float32:
-            # Decode is weight-streaming-bound: every step reads the
-            # whole matrix stack from HBM.  Cast f32 masters to the
-            # compute dtype ONCE here (inside the jit: one fused device
-            # pass, amortized over the whole generation) so the scan
-            # streams half the bytes; compute ran in cfg.dtype
-            # regardless.
-            params = jax.tree.map(
-                lambda p: p.astype(cfg.dtype)
-                if p.dtype == jnp.float32 else p,
-                params,
-            )
-        live = lambda: params  # noqa: E731
-
-    # Cache allocation: init on a 1-token input (shapes depend only on B
-    # and cfg.max_seq_len), params discarded — the caller's are used.
-    cache = model.init(
-        jax.random.PRNGKey(0), prompt[:, :1],
-        positions=jnp.arange(1),
-    )["cache"]
+    # Cache allocation: shapes depend only on B and cfg.max_seq_len.
+    cache = init_cache(model, B)
 
     # Prefill: the whole prompt in one apply; take the last position.
-    logits, upd = model.apply(
-        {"params": live(), "cache": cache}, prompt,
-        positions=jnp.arange(P), mutable=["cache"],
-    )
+    logits, cache = prefill_fn(params, cache, prompt, jnp.arange(P))
     rng, sub = jax.random.split(rng)
     next_tok = _sample(
         logits[:, -1], sub, temperature, top_k
@@ -176,20 +247,17 @@ def _generate_jit(
 
     def body(carry, t):
         cache, tok, rng = carry
-        logits, upd = model.apply(
-            {"params": live(), "cache": cache}, tok[:, None],
-            positions=t[None], mutable=["cache"],
-        )
+        logits, cache = decode_fn(params, cache, tok[:, None], t[None])
         rng, sub = jax.random.split(rng)
         nxt = _sample(logits[:, -1], sub, temperature, top_k)
-        return (upd["cache"], nxt, rng), tok
+        return (cache, nxt, rng), tok
 
     # N - 1 decode steps: each emits its incoming carried token (step i's
     # is the token at global position P + i) and samples the next; the
     # final carry is token P + N - 1, so no apply is ever wasted.
     (_, last, _), toks = jax.lax.scan(
         body,
-        (upd["cache"], next_tok, rng),
+        (cache, next_tok, rng),
         P + jnp.arange(max_new_tokens - 1),
     )
     return jnp.concatenate([prompt, toks.T, last[:, None]], axis=1)

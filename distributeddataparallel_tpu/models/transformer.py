@@ -290,11 +290,11 @@ class Attention(nn.Module):
                     "(models.generate passes them)"
                 )
             from distributeddataparallel_tpu.ops.attention import (
+                NEG_INF,
                 causal_mask_bias,
                 dot_product_attention,
             )
 
-            pos = positions.reshape(-1)  # (S,) global token positions
             ck = self.variable(
                 "cache", "cached_key", jnp.zeros,
                 (B, cfg.max_seq_len, Hkvl, D), k.dtype,
@@ -303,22 +303,51 @@ class Attention(nn.Module):
                 "cache", "cached_value", jnp.zeros,
                 (B, cfg.max_seq_len, Hkvl, D), v.dtype,
             )
-            ck.value = jax.lax.dynamic_update_slice(
-                ck.value, k, (0, pos[0], 0, 0)
-            )
-            cv.value = jax.lax.dynamic_update_slice(
-                cv.value, v, (0, pos[0], 0, 0)
-            )
-            kf = repeat_kv(ck.value, Hl // Hkvl)
-            vf = repeat_kv(cv.value, Hl // Hkvl)
-            # Positions are contiguous from pos[0] (the insert offset), so
-            # the cache mask is the ordinary causal bias at that q offset.
-            bias = causal_mask_bias(
-                S, cfg.max_seq_len, q_offset=pos[0]
-            )
-            out = dot_product_attention(
-                q, kf, vf, causal=False, bias=bias[None, None]
-            )
+            if positions.ndim == 2:
+                # Per-row positions (B, 1): a continuous-batching decode
+                # step where every slot sits at its own length (serving
+                # engine).  Insert row-wise and mask row-wise; rows past
+                # a slot's position hold stale/garbage values, which the
+                # finite NEG_INF bias zeroes exactly in the softmax.
+                if S != 1:
+                    raise ValueError(
+                        "per-row positions decode a single token per "
+                        f"row, got seq len {S}"
+                    )
+                row = jnp.arange(B)
+                pos_b = positions[:, 0]  # (B,)
+                ck.value = ck.value.at[row, pos_b].set(k[:, 0])
+                cv.value = cv.value.at[row, pos_b].set(v[:, 0])
+                kf = repeat_kv(ck.value, Hl // Hkvl)
+                vf = repeat_kv(cv.value, Hl // Hkvl)
+                kv_pos = jnp.arange(cfg.max_seq_len)
+                bias = jnp.where(
+                    kv_pos[None, None, None, :]
+                    <= pos_b[:, None, None, None],
+                    0.0, NEG_INF,
+                ).astype(jnp.float32)  # (B, 1, 1, max_seq_len)
+                out = dot_product_attention(
+                    q, kf, vf, causal=False, bias=bias
+                )
+            else:
+                pos = positions.reshape(-1)  # (S,) global token positions
+                ck.value = jax.lax.dynamic_update_slice(
+                    ck.value, k, (0, pos[0], 0, 0)
+                )
+                cv.value = jax.lax.dynamic_update_slice(
+                    cv.value, v, (0, pos[0], 0, 0)
+                )
+                kf = repeat_kv(ck.value, Hl // Hkvl)
+                vf = repeat_kv(cv.value, Hl // Hkvl)
+                # Positions are contiguous from pos[0] (the insert
+                # offset), so the cache mask is the ordinary causal bias
+                # at that q offset.
+                bias = causal_mask_bias(
+                    S, cfg.max_seq_len, q_offset=pos[0]
+                )
+                out = dot_product_attention(
+                    q, kf, vf, causal=False, bias=bias[None, None]
+                )
         elif cfg.cp_axis is not None and cfg.cp_impl == "ulysses":
             from distributeddataparallel_tpu.parallel.context_parallel import (
                 ulysses_attention,

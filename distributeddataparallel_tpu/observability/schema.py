@@ -58,6 +58,12 @@ EVENT_KINDS: dict[str, tuple[str, ...]] = {
     "run_summary": ("windows", "restarts"),
     # Static-analysis layer (ddplint):
     "lint_report": ("layer", "n_findings", "rules"),
+    # Serving layer (serving/engine request lifecycle):
+    "request_admit": ("req",),
+    "prefill_chunk": ("req", "start", "len"),
+    "decode_step": ("step", "n_active"),
+    "request_done": ("req", "ttft_s", "tokens"),
+    "kv_evict": ("blocks",),
 }
 
 

@@ -36,6 +36,8 @@ import json
 _COUNTER_KINDS = {
     "mfu": ("mfu", "mfu"),
     "memory": ("memory_bytes", "live_bytes"),
+    # Serving: active decode slots over time — occupancy at a glance.
+    "decode_step": ("active_slots", "n_active"),
 }
 
 #: kinds rendered as instant events (fields worth carrying into args)
@@ -47,6 +49,12 @@ _INSTANT_KINDS = {
     "restart_exhausted": ("attempt",),
     "loader_starved": ("window", "step"),
     "alert": ("rule", "step", "value", "threshold"),
+    # Serving lifecycle marks (request spans come through "span"
+    # records named "request:<rid>" and need no mapping here).
+    "request_admit": ("req", "prompt_tokens", "slot", "queued_s"),
+    "prefill_chunk": ("req", "start", "len"),
+    "request_done": ("req", "ttft_s", "tokens", "latency_s"),
+    "kv_evict": ("blocks", "req", "reason"),
 }
 
 SUPERVISOR_PID = 0

@@ -1,0 +1,37 @@
+"""Serving subsystem: continuous batching over a paged KV cache.
+
+The training half of the framework reproduces the reference DDP
+trainer; this package opens the inference half of the north star
+("serve heavy traffic"): a vLLM-style block/paged KV cache over the
+TransformerLM decode twin (``kv_cache``), a host-side continuous-
+batching scheduler with chunked prefill (``scheduler``), the engine
+that compiles exactly two device programs — one decode step over the
+fixed slot batch, one prefill chunk — and drives them per scheduler
+step (``engine``), and a seeded Poisson open-loop load generator
+(``loadgen``).  ``scripts/ddp_serve.py`` is the CLI.
+"""
+
+from distributeddataparallel_tpu.serving.kv_cache import (  # noqa: F401
+    SCRATCH_BLOCK,
+    BlockAllocator,
+    gather_block_cache,
+    kv_pool_bytes,
+    make_pool,
+    scatter_decode,
+    scatter_prefill,
+)
+from distributeddataparallel_tpu.serving.scheduler import (  # noqa: F401
+    Request,
+    Scheduler,
+    StepPlan,
+)
+from distributeddataparallel_tpu.serving.engine import (  # noqa: F401
+    EngineConfig,
+    InferenceEngine,
+)
+from distributeddataparallel_tpu.serving.loadgen import (  # noqa: F401
+    LoadConfig,
+    VirtualClock,
+    make_trace,
+    run_load,
+)

@@ -1,0 +1,423 @@
+"""Continuous-batching inference engine over the paged KV pool.
+
+Exactly TWO device programs serve any traffic mix, each compiled once
+per (model, engine-shape) configuration and persisted through the
+warm-start ``ExecutableStore``:
+
+- the **decode program** steps every slot of the fixed ``num_slots``
+  batch at once: gather dense caches from the pool through the slot
+  block tables, one per-row-position decode apply (every slot at its
+  own length — the capability ``models.transformer`` grew for this
+  engine), scatter the newly-inserted KV rows back, greedy-sample on
+  device.  The pool is DONATED: the update is in-place, pool HBM is
+  never doubled (ddplint's ``serve`` mode gates this).
+- the **prefill program** consumes one fixed-size chunk
+  (``prefill_chunk`` tokens, B=1) of one request's context: gather,
+  one batched prefill apply at positions ``start + arange(chunk)``,
+  scatter through the request's table with padding rows routed to
+  scratch, and the chunk's last real row's argmax (only the final
+  chunk's is consumed — it is the request's first generated token).
+
+Static shapes fall out of the slot/bucket discipline: tokens per decode
+step is always ``(num_slots, 1)``, a prefill chunk is always
+``(1, prefill_chunk)``, block tables are always
+``(·, max_seq_len // block_size)`` — so the program space is exactly
+{decode} x {prefill_chunk} and nothing retraces at traffic time.
+
+The host loop is the scheduler's :class:`StepPlan` executed verbatim,
+emitting the serving lifecycle through the versioned event schema
+(``request_admit`` / ``prefill_chunk`` / ``decode_step`` /
+``request_done`` / ``kv_evict`` + a ``request:<rid>`` span per
+completion) so ddp_monitor / ddp_trace / ddp_report work on serving
+runs unchanged.
+
+Greedy decoding only (argmax on device): the engine's contract with the
+parity tests is bit-identical continuations vs ``generate()`` at
+temperature 0, and sampling would put an rng split on the slot batch
+hot path for no serving-bench benefit.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributeddataparallel_tpu.serving.kv_cache import (
+    SCRATCH_BLOCK,
+    BlockAllocator,
+    gather_block_cache,
+    make_pool,
+    scatter_decode,
+    scatter_prefill,
+)
+from distributeddataparallel_tpu.serving.scheduler import (
+    Request,
+    Scheduler,
+)
+
+Pytree = Any
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineConfig:
+    """Engine shape knobs (everything here is in the compile key)."""
+
+    num_slots: int = 8
+    num_blocks: int = 64
+    block_size: int = 16
+    prefill_chunk: int = 32
+    max_prefill_chunks_per_step: int = 1
+    quantized_kv: bool = False
+    quantize_weights: bool = False
+    store_dir: str | None = None  # ExecutableStore root (warm start)
+
+
+class InferenceEngine:
+    """Drive the decode twin step-by-step under continuous batching.
+
+    ``time_fn`` is injectable (the loadgen's virtual clock in replay
+    tests); it must be monotonic.  ``events`` is an ``EventLog`` (or
+    None), ``registry`` a ``MetricsRegistry`` (or None).
+    """
+
+    def __init__(
+        self,
+        model,
+        params: Pytree,
+        config: EngineConfig = EngineConfig(),
+        *,
+        events=None,
+        registry=None,
+        time_fn=time.monotonic,
+    ):
+        from distributeddataparallel_tpu.models.generate import (
+            _quant_decode_model,
+            _step_fns,
+            decode_model,
+        )
+
+        cfg = model.cfg
+        if cfg.max_seq_len % config.block_size:
+            raise ValueError(
+                f"block_size ({config.block_size}) must divide "
+                f"max_seq_len ({cfg.max_seq_len})"
+            )
+        self.config = config
+        self.events = events
+        self.registry = registry
+        self._time = time_fn
+        self._step_idx = 0
+        self._next_rid = 0
+        self.completed: dict[int, Request] = {}
+
+        quantized = config.quantize_weights
+        if quantized:
+            from distributeddataparallel_tpu.ops.quant import (
+                is_quantized,
+                quantize_for_decode,
+            )
+
+            if not is_quantized(params):
+                params = quantize_for_decode(params, cfg.scan_layers)
+            self._dm = _quant_decode_model(model)
+        else:
+            self._dm = decode_model(model)
+            if cfg.dtype != jnp.float32:
+                # One-time host-side cast: decode streams the whole
+                # matrix stack every step, so f32 masters would double
+                # the bytes (same policy as _generate_jit's pre-cast).
+                params = jax.tree.map(
+                    lambda p: p.astype(cfg.dtype)
+                    if p.dtype == jnp.float32 else p,
+                    params,
+                )
+        self.params = params
+        prefill_fn, decode_fn = _step_fns(self._dm, quantized)
+
+        self.blocks_per_seq = cfg.max_seq_len // config.block_size
+        self.pool = make_pool(
+            self._dm, config.num_blocks, config.block_size,
+            quantized_kv=config.quantized_kv,
+        )
+        self.allocator = BlockAllocator(
+            config.num_blocks, config.block_size
+        )
+        self.scheduler = Scheduler(
+            self.allocator,
+            num_slots=config.num_slots,
+            prefill_chunk=config.prefill_chunk,
+            max_seq_len=cfg.max_seq_len,
+            max_prefill_chunks_per_step=(
+                config.max_prefill_chunks_per_step
+            ),
+        )
+
+        bs = config.block_size
+        chunk = config.prefill_chunk
+
+        def decode_program(params, pool, tables, toks, pos):
+            dense = gather_block_cache(pool, tables, dtype=cfg.dtype)
+            logits, dense = decode_fn(params, dense, toks, pos[:, None])
+            pool = scatter_decode(
+                pool, dense, tables, pos, block_size=bs
+            )
+            nxt = jnp.argmax(
+                logits[:, -1].astype(jnp.float32), axis=-1
+            ).astype(jnp.int32)
+            return pool, nxt
+
+        def prefill_program(params, pool, table, tokens, start, limit):
+            dense = gather_block_cache(
+                pool, table[None], dtype=cfg.dtype
+            )
+            logits, dense = prefill_fn(
+                params, dense, tokens[None], start + jnp.arange(chunk)
+            )
+            pool = scatter_prefill(
+                pool, dense, table, start, chunk, limit, block_size=bs
+            )
+            last = logits[
+                0, jnp.clip(limit - 1 - start, 0, chunk - 1)
+            ].astype(jnp.float32)
+            return pool, jnp.argmax(last).astype(jnp.int32)
+
+        self._decode_prog = jax.jit(decode_program, donate_argnums=(1,))
+        self._prefill_prog = jax.jit(
+            prefill_program, donate_argnums=(1,)
+        )
+        if config.store_dir:
+            self._wire_warm_start(model)
+
+    # -- warm start ---------------------------------------------------
+    def _wire_warm_start(self, model) -> None:
+        """Persist both programs through the AOT ExecutableStore so a
+        restarted server skips trace+compile entirely (same discipline
+        as ``warm_train_step``; the programs' shapes are fully
+        determined by the engine config, so the example args below ARE
+        the live call shapes)."""
+        from distributeddataparallel_tpu.training.warm_start import (
+            ExecutableStore,
+            executable_key,
+            warm_program,
+        )
+
+        c = self.config
+        store = ExecutableStore(c.store_dir)
+        base = executable_key(
+            model_config=model.cfg,
+            step_signature=dataclasses.asdict(c),
+        )
+        toks = jnp.zeros((c.num_slots, 1), jnp.int32)
+        pos = jnp.zeros((c.num_slots,), jnp.int32)
+        tables = jnp.zeros(
+            (c.num_slots, self.blocks_per_seq), jnp.int32
+        )
+        table1 = jnp.zeros((self.blocks_per_seq,), jnp.int32)
+        ptoks = jnp.zeros((c.prefill_chunk,), jnp.int32)
+        zero = jnp.zeros((), jnp.int32)
+        tok_out = jnp.zeros((c.num_slots,), jnp.int32)
+
+        decode = warm_program(
+            self._decode_prog, store=store,
+            key={**base, "program": "decode"}, name="serve_decode",
+        )
+        decode.resolve(
+            (self.params, self.pool, tables, toks, pos),
+            (self.pool, tok_out),
+        )
+        prefill = warm_program(
+            self._prefill_prog, store=store,
+            key={**base, "program": "prefill"}, name="serve_prefill",
+        )
+        prefill.resolve(
+            (self.params, self.pool, table1, ptoks, zero, zero),
+            (self.pool, zero),
+        )
+        self._decode_prog = decode
+        self._prefill_prog = prefill
+        self.warm_report = {
+            "decode": dict(decode.report),
+            "prefill": dict(prefill.report),
+        }
+
+    # -- intake -------------------------------------------------------
+    def submit(
+        self, prompt, max_new_tokens: int, *, arrival_s: float | None = None
+    ) -> int:
+        rid = self._next_rid
+        self._next_rid += 1
+        req = Request(
+            rid=rid,
+            prompt=np.asarray(prompt, np.int32).reshape(-1),
+            max_new_tokens=int(max_new_tokens),
+            arrival_s=(
+                self._time() if arrival_s is None else float(arrival_s)
+            ),
+        )
+        self.scheduler.submit(req)
+        return rid
+
+    def has_work(self) -> bool:
+        return self.scheduler.has_work()
+
+    # -- telemetry helpers --------------------------------------------
+    def emit(self, kind: str, **fields) -> None:
+        if self.events is not None:
+            self.events.emit(kind, **fields)
+
+    def _observe_ttft(self, req: Request) -> None:
+        req.first_token_s = self._time()
+        if self.registry is not None:
+            self.registry.histogram("serve_ttft_s").observe(
+                req.first_token_s - req.arrival_s
+            )
+
+    def _finish(self, req: Request) -> None:
+        req.done_s = self._time()
+        retired = self.scheduler.finish(req)
+        self.completed[req.rid] = req
+        ttft = (req.first_token_s or req.done_s) - req.arrival_s
+        self.emit(
+            "request_done",
+            req=req.rid,
+            ttft_s=ttft,
+            tokens=len(req.generated),
+            latency_s=req.done_s - req.arrival_s,
+            preemptions=req.preemptions,
+            retired_blocks=retired,
+        )
+        # A per-request span on the timeline: Perfetto renders it as a
+        # complete ("X") slice via the existing span mapping.
+        self.emit(
+            "span",
+            name=f"request:{req.rid}",
+            dur_s=req.done_s - req.arrival_s,
+        )
+        if self.registry is not None:
+            self.registry.counter("serve_requests_done").inc()
+            self.registry.counter("serve_tokens_out").inc(
+                len(req.generated)
+            )
+            if len(req.generated) > 1 and req.first_token_s is not None:
+                self.registry.histogram("serve_tok_latency_s").observe(
+                    (req.done_s - req.first_token_s)
+                    / (len(req.generated) - 1)
+                )
+
+    # -- the step -----------------------------------------------------
+    def step(self) -> dict:
+        """Execute one scheduler plan; returns host-side step stats."""
+        plan = self.scheduler.plan_step()
+        for rid, blocks in plan.evicted:
+            self.emit("kv_evict", blocks=blocks, req=rid, reason="lru")
+        for req, released in plan.preempted:
+            self.emit(
+                "kv_evict", blocks=released, req=req.rid,
+                reason="preempt",
+            )
+        for req in plan.admitted:
+            req.admit_s = self._time()
+            self.emit(
+                "request_admit",
+                req=req.rid,
+                prompt_tokens=req.prompt_len,
+                slot=req.slot,
+                queued_s=req.admit_s - req.arrival_s,
+            )
+
+        c = self.config
+        for req, start, n in plan.prefill_chunks:
+            ctx = req.ctx_tokens()
+            tokens = np.zeros((c.prefill_chunk,), np.int32)
+            tokens[:n] = ctx[start:start + n]
+            table = self.allocator.table_array(
+                req.rid, self.blocks_per_seq
+            )
+            self.pool, first = self._prefill_prog(
+                self.params, self.pool, jnp.asarray(table),
+                jnp.asarray(tokens), jnp.int32(start),
+                jnp.int32(start + n),
+            )
+            self.emit(
+                "prefill_chunk", req=req.rid, start=start, len=n
+            )
+            if self.scheduler.advance_prefill(req, n):
+                if not req.generated:
+                    # Fresh prefill: the final chunk's last-row argmax
+                    # is the request's first token (TTFT clock stops).
+                    req.generated.append(int(first))
+                    self._observe_ttft(req)
+                    if req.done:
+                        self._finish(req)
+                # else: recompute after preemption — the pending token
+                # is already known, decode just resumes.
+
+        running = dict(self.scheduler.running)
+        n_active = len(running)
+        if running:
+            tables = np.full(
+                (c.num_slots, self.blocks_per_seq),
+                SCRATCH_BLOCK, np.int32,
+            )
+            toks = np.zeros((c.num_slots, 1), np.int32)
+            pos = np.zeros((c.num_slots,), np.int32)
+            for slot, req in running.items():
+                tables[slot] = self.allocator.table_array(
+                    req.rid, self.blocks_per_seq
+                )
+                toks[slot, 0] = req.generated[-1]
+                pos[slot] = req.next_pos
+            self.pool, nxt = self._decode_prog(
+                self.params, self.pool, jnp.asarray(tables),
+                jnp.asarray(toks), jnp.asarray(pos),
+            )
+            # One host sync per engine step (the whole slot batch's
+            # next tokens at once) — completion detection needs the
+            # values; this is the serving analog of the train loop's
+            # bounded dispatch, with depth 0.
+            nxt = np.asarray(nxt)
+            for slot, req in running.items():
+                req.generated.append(int(nxt[slot]))
+                if req.done:
+                    self._finish(req)
+            self.emit(
+                "decode_step", step=self._step_idx, n_active=n_active
+            )
+        if self.registry is not None:
+            self.registry.gauge("serve_slots_active").set(n_active)
+            self.registry.gauge("serve_blocks_live").set(
+                self.allocator.live_blocks
+            )
+        self._step_idx += 1
+        return {
+            "step": self._step_idx - 1,
+            "n_active": n_active,
+            "prefill_chunks": len(plan.prefill_chunks),
+            "admitted": len(plan.admitted),
+            "preempted": len(plan.preempted),
+            "free_blocks": self.allocator.free_blocks,
+        }
+
+    def run(self, *, max_steps: int = 100_000) -> dict[int, Request]:
+        """Step until drained (no waiting/prefilling/running work)."""
+        steps = 0
+        while self.has_work():
+            if steps >= max_steps:
+                raise RuntimeError(
+                    f"engine did not drain within {max_steps} steps"
+                )
+            self.step()
+            steps += 1
+        return self.completed
+
+    def output_tokens(self, rid: int) -> np.ndarray:
+        """prompt + generated continuation of a completed request."""
+        req = self.completed[rid]
+        return np.concatenate(
+            [req.prompt, np.asarray(req.generated, np.int32)]
+        )

@@ -1,0 +1,410 @@
+"""Paged (block) KV cache: pool layout, gather/scatter, host allocator.
+
+The decode twin's attention reads a dense per-sequence cache of shape
+``(B, max_seq_len, kv_heads, head_dim)`` per layer.  Serving many
+sequences of wildly different lengths through dense caches wastes HBM
+proportional to ``max_seq_len - actual_len`` per slot; the paged layout
+(vLLM's central trick) stores KV in fixed-size blocks inside one
+preallocated pool and maps each sequence to blocks through a small
+integer table:
+
+- pool leaf (unrolled layers): ``(num_blocks, block_size, H, D)``
+- pool leaf (scanned layers):  ``(L, num_blocks, block_size, H, D)``
+- block table per sequence:    ``(max_seq_len // block_size,)`` int32
+
+Device side, the engine round-trips through the dense layout every
+step: ``gather_block_cache`` materializes the slot batch's dense caches
+from the pool (one vectorized take — bandwidth-equivalent to what dense
+decode attention reads anyway), the decode twin runs unmodified, and
+``scatter_decode``/``scatter_prefill`` write only the newly-inserted
+rows back.  Capacity, placement and eviction therefore live entirely in
+the pool; the transient gathered dense batch is scratch XLA reuses
+across steps.
+
+Block 0 is RESERVED scratch: unallocated table entries point at it, and
+prefill rows past the prompt (chunk padding) are routed into it.  Reads
+through scratch return finite garbage that the decode twin's positional
+masking multiplies by an exactly-zero softmax weight (f32 ``NEG_INF``
+bias), so scratch never perturbs logits — the property the bitwise
+paged-vs-dense parity test pins down.
+
+int8 KV (``quantized_kv=True``): pool leaves become ``{"q": int8,
+"scale": f32}`` pairs with one absmax scale per (block row, kv head) —
+the same symmetric recipe as ``ops.quant`` applied at row granularity,
+halving pool HBM.  Gather dequantizes into the compute dtype; scatter
+quantizes the inserted rows.
+
+The host side (``BlockAllocator``) does the bookkeeping: free-list
+allocation, per-sequence tables, immediate release on preemption, and
+deferred release on completion — finished sequences park their blocks
+in an LRU "evictable" list and are only reclaimed (``kv_evict``) under
+pool pressure, which keeps the eviction path exercised without a
+prefix-reuse feature riding on it yet.
+"""
+
+from __future__ import annotations
+
+import math
+from collections import OrderedDict
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+Pytree = Any
+
+#: Reserved block: never allocated, target of unallocated table entries
+#: and of junk rows (chunk padding, idle decode slots).
+SCRATCH_BLOCK = 0
+
+_SCALE_EPS = 1e-8
+
+
+def _is_qkv(leaf) -> bool:
+    return isinstance(leaf, dict) and set(leaf) == {"q", "scale"}
+
+
+def _quant_rows(rows):
+    """int8-quantize KV rows ``(..., H, D)`` with one absmax scale per
+    (row, head) — head_dim shares a scale, heads/rows do not."""
+    scale = (
+        jnp.max(jnp.abs(rows), axis=-1, keepdims=True).astype(jnp.float32)
+        / 127.0
+    )
+    scale = jnp.maximum(scale, _SCALE_EPS)
+    q = jnp.clip(
+        jnp.round(rows.astype(jnp.float32) / scale), -127, 127
+    ).astype(jnp.int8)
+    return q, scale
+
+
+def make_pool(
+    model, num_blocks: int, block_size: int, *, quantized_kv: bool = False
+) -> Pytree:
+    """Preallocate the block pool, cache-pytree shaped.
+
+    Structure mirrors the decode twin's cache (so gather can rebuild it
+    leaf-for-leaf) with each dense leaf's ``(B, max_seq_len)`` leading
+    dims replaced by ``(num_blocks, block_size)``.
+    """
+    from distributeddataparallel_tpu.models.generate import init_cache
+
+    if num_blocks < 2:
+        raise ValueError(
+            f"num_blocks must be >= 2 (block {SCRATCH_BLOCK} is reserved "
+            f"scratch), got {num_blocks}"
+        )
+    cache = init_cache(model, 1)
+
+    def one(leaf):
+        if leaf.ndim == 4:  # (1, S, H, D) — unrolled layers
+            shp = (num_blocks, block_size) + leaf.shape[2:]
+        elif leaf.ndim == 5:  # (L, 1, S, H, D) — scanned layers
+            shp = (leaf.shape[0], num_blocks, block_size) + leaf.shape[3:]
+        else:
+            raise ValueError(f"unexpected cache leaf rank {leaf.ndim}")
+        if quantized_kv:
+            return {
+                "q": jnp.zeros(shp, jnp.int8),
+                "scale": jnp.full(
+                    shp[:-1] + (1,), _SCALE_EPS, jnp.float32
+                ),
+            }
+        return jnp.zeros(shp, leaf.dtype)
+
+    return jax.tree.map(one, cache)
+
+
+def kv_pool_bytes(
+    cfg, num_blocks: int, block_size: int, *, quantized_kv: bool = False
+) -> int:
+    """Pool HBM bytes for a model config: ``2 (k+v) x layers x
+    num_blocks x block_size x kv_heads x head_dim`` x itemsize, plus the
+    f32 per-(row, head) scales when int8 (see MEMFIT.md, Serving)."""
+    heads = cfg.num_kv_heads or cfg.num_heads
+    head_dim = cfg.head_dim or cfg.d_model // cfg.num_heads
+    rows = 2 * cfg.num_layers * num_blocks * block_size * heads
+    if quantized_kv:
+        return rows * head_dim * 1 + rows * 4
+    return rows * head_dim * jnp.dtype(cfg.dtype).itemsize
+
+
+def gather_block_cache(pool: Pytree, tables, *, dtype) -> Pytree:
+    """Materialize dense per-slot caches from the pool.
+
+    ``tables`` is ``(B, max_seq_len // block_size)`` int32; returns a
+    cache pytree of ``(B, max_seq_len, H, D)`` leaves (scanned:
+    ``(L, B, max_seq_len, H, D)``).  int8 pool leaves dequantize into
+    ``dtype``.
+    """
+    B, nb = tables.shape
+
+    def take(leaf):
+        if leaf.ndim == 4:  # (N, bs, H, D)
+            g = leaf[tables]  # (B, nb, bs, H, D)
+            return g.reshape(B, nb * leaf.shape[1], *leaf.shape[2:])
+        # (L, N, bs, H, D)
+        g = jnp.take(leaf, tables, axis=1)  # (L, B, nb, bs, H, D)
+        return g.reshape(
+            leaf.shape[0], B, nb * leaf.shape[2], *leaf.shape[3:]
+        )
+
+    def one(leaf):
+        if _is_qkv(leaf):
+            q = take(leaf["q"])
+            s = take(leaf["scale"])
+            return (q.astype(jnp.float32) * s).astype(dtype)
+        return take(leaf)
+
+    return jax.tree.map(one, pool, is_leaf=_is_qkv)
+
+
+def scatter_decode(
+    pool: Pytree, dense: Pytree, tables, pos, *, block_size: int
+) -> Pytree:
+    """Write each slot's newly-inserted decode row back into the pool.
+
+    ``dense`` is the cache pytree AFTER a per-row decode apply (row
+    ``b``'s new KV sits at ``pos[b]``); the write lands at block
+    ``tables[b, pos[b] // block_size]``, offset ``pos[b] % block_size``.
+    Idle slots (all-scratch tables, pos 0) write into the scratch block;
+    those writes may collide with each other — scratch content is never
+    read unmasked, so the nondeterminism is invisible.
+    """
+    B = tables.shape[0]
+    row = jnp.arange(B)
+    blk = tables[row, pos // block_size]  # (B,)
+    off = pos % block_size
+
+    def one(pl, dn):
+        if dn.ndim == 4:  # dense (B, S, H, D), pool (N, bs, H, D)
+            new = dn[row, pos]  # (B, H, D)
+            if _is_qkv(pl):
+                q, s = _quant_rows(new)
+                return {
+                    "q": pl["q"].at[blk, off].set(q),
+                    "scale": pl["scale"].at[blk, off].set(s),
+                }
+            return pl.at[blk, off].set(new.astype(pl.dtype))
+        # dense (L, B, S, H, D), pool (L, N, bs, H, D)
+        new = dn[:, row, pos]  # (L, B, H, D)
+        if _is_qkv(pl):
+            q, s = _quant_rows(new)
+            return {
+                "q": pl["q"].at[:, blk, off].set(q),
+                "scale": pl["scale"].at[:, blk, off].set(s),
+            }
+        return pl.at[:, blk, off].set(new.astype(pl.dtype))
+
+    return jax.tree.map(one, pool, dense, is_leaf=_is_qkv)
+
+
+def scatter_prefill(
+    pool: Pytree,
+    dense: Pytree,
+    table,
+    start,
+    length: int,
+    limit,
+    *,
+    block_size: int,
+) -> Pytree:
+    """Write one B=1 prefill chunk's rows ``[start, start + length)``
+    into the pool through ``table`` (1-D per-sequence block table).
+
+    ``length`` is the STATIC chunk size; ``start``/``limit`` are traced.
+    Rows at global position ``>= limit`` (chunk padding past the real
+    prompt) are routed to the scratch block, so the table only ever
+    needs blocks for real tokens.
+    """
+    p = start + jnp.arange(length)
+    blk = jnp.where(p < limit, table[p // block_size], SCRATCH_BLOCK)
+    off = p % block_size
+
+    def rows_of(dn):
+        if dn.ndim == 4:  # (1, S, H, D)
+            return jax.lax.dynamic_slice_in_dim(
+                dn[0], start, length, axis=0
+            )  # (C, H, D)
+        return jax.lax.dynamic_slice_in_dim(
+            dn[:, 0], start, length, axis=1
+        )  # (L, C, H, D)
+
+    def one(pl, dn):
+        new = rows_of(dn)
+        if dn.ndim == 4:
+            if _is_qkv(pl):
+                q, s = _quant_rows(new)
+                return {
+                    "q": pl["q"].at[blk, off].set(q),
+                    "scale": pl["scale"].at[blk, off].set(s),
+                }
+            return pl.at[blk, off].set(new.astype(pl.dtype))
+        if _is_qkv(pl):
+            q, s = _quant_rows(new)
+            return {
+                "q": pl["q"].at[:, blk, off].set(q),
+                "scale": pl["scale"].at[:, blk, off].set(s),
+            }
+        return pl.at[:, blk, off].set(new.astype(pl.dtype))
+
+    return jax.tree.map(one, pool, dense, is_leaf=_is_qkv)
+
+
+class BlockAllocator:
+    """Host-side block accounting for one pool.
+
+    Invariants (asserted by :meth:`check`):
+
+    - block ``SCRATCH_BLOCK`` is never allocated;
+    - every other block is in exactly one of {free, some live table,
+      some retired table};
+    - eviction only reclaims RETIRED (finished) sequences, oldest
+      retirement first (LRU), and only under allocation pressure.
+
+    All methods are plain host work — the allocator never touches a
+    device value.
+    """
+
+    def __init__(self, num_blocks: int, block_size: int):
+        if num_blocks < 2:
+            raise ValueError(
+                f"num_blocks must be >= 2, got {num_blocks}"
+            )
+        if block_size < 1:
+            raise ValueError(f"block_size must be >= 1, got {block_size}")
+        self.num_blocks = num_blocks
+        self.block_size = block_size
+        # Stack: pop() hands out low block ids first (stable layouts
+        # make pool dumps readable).
+        self._free: list[int] = list(range(num_blocks - 1, 0, -1))
+        self._tables: dict[Any, list[int]] = {}
+        self._retired: OrderedDict[Any, list[int]] = OrderedDict()
+        self.evictions = 0
+        self.evicted_blocks = 0
+
+    # -- capacity -----------------------------------------------------
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    @property
+    def evictable_blocks(self) -> int:
+        return sum(len(b) for b in self._retired.values())
+
+    @property
+    def live_blocks(self) -> int:
+        return sum(len(b) for b in self._tables.values())
+
+    def blocks_for(self, tokens: int) -> int:
+        return max(1, math.ceil(tokens / self.block_size))
+
+    def can_alloc(self, tokens: int) -> bool:
+        return (
+            self.free_blocks + self.evictable_blocks
+            >= self.blocks_for(tokens)
+        )
+
+    def can_extend(self, rid, tokens: int) -> bool:
+        need = self.blocks_for(tokens) - len(self._tables[rid])
+        return need <= 0 or self.free_blocks + self.evictable_blocks >= need
+
+    # -- allocation ---------------------------------------------------
+    def _reclaim(self, need: int) -> list[tuple[Any, int]]:
+        """Evict oldest-retired sequences until ``need`` blocks are
+        free; returns ``(rid, n_blocks)`` per eviction."""
+        evicted = []
+        while len(self._free) < need and self._retired:
+            rid, blocks = self._retired.popitem(last=False)
+            self._free.extend(blocks)
+            self.evictions += 1
+            self.evicted_blocks += len(blocks)
+            evicted.append((rid, len(blocks)))
+        return evicted
+
+    def alloc(self, rid, tokens: int) -> list[tuple[Any, int]]:
+        """Allocate a fresh table covering ``tokens``; returns the
+        evictions it forced.  Callers gate on :meth:`can_alloc`."""
+        if rid in self._tables:
+            raise ValueError(f"request {rid!r} already has a table")
+        need = self.blocks_for(tokens)
+        if not self.can_alloc(tokens):
+            raise RuntimeError(
+                f"pool exhausted: need {need} blocks, have "
+                f"{self.free_blocks} free + {self.evictable_blocks} "
+                "evictable"
+            )
+        evicted = self._reclaim(need)
+        self._tables[rid] = [self._free.pop() for _ in range(need)]
+        return evicted
+
+    def extend(self, rid, tokens: int) -> list[tuple[Any, int]]:
+        """Grow ``rid``'s table to cover ``tokens`` total; returns the
+        evictions it forced.  Callers gate on :meth:`can_extend`."""
+        table = self._tables[rid]
+        need = self.blocks_for(tokens) - len(table)
+        if need <= 0:
+            return []
+        if self.free_blocks + self.evictable_blocks < need:
+            raise RuntimeError(
+                f"pool exhausted extending {rid!r}: need {need} more"
+            )
+        evicted = self._reclaim(need)
+        table.extend(self._free.pop() for _ in range(need))
+        return evicted
+
+    # -- release ------------------------------------------------------
+    def release(self, rid) -> int:
+        """Immediately return ``rid``'s blocks to the free list (the
+        preemption path — a preempted sequence is recomputed, its old
+        KV is garbage).  Returns the block count."""
+        blocks = self._tables.pop(rid)
+        self._free.extend(blocks)
+        return len(blocks)
+
+    def retire(self, rid) -> int:
+        """Finished sequence: park blocks in the LRU evictable list;
+        reclaimed by :meth:`alloc`/:meth:`extend` only under pressure."""
+        blocks = self._tables.pop(rid)
+        self._retired[rid] = blocks
+        return len(blocks)
+
+    # -- tables -------------------------------------------------------
+    def table_of(self, rid) -> tuple[int, ...]:
+        return tuple(self._tables[rid])
+
+    def table_array(self, rid, blocks_per_seq: int):
+        """Fixed-shape int32 table padded with the scratch block."""
+        import numpy as np
+
+        out = np.full((blocks_per_seq,), SCRATCH_BLOCK, np.int32)
+        blocks = self._tables[rid]
+        if len(blocks) > blocks_per_seq:
+            raise ValueError(
+                f"table of {rid!r} ({len(blocks)} blocks) exceeds "
+                f"blocks_per_seq {blocks_per_seq}"
+            )
+        out[: len(blocks)] = blocks
+        return out
+
+    def check(self) -> None:
+        """Assert the partition invariant (tests call this liberally)."""
+        seen: set[int] = set()
+        for group in (
+            [self._free],
+            self._tables.values(),
+            self._retired.values(),
+        ):
+            for blocks in group:
+                for b in blocks:
+                    if b == SCRATCH_BLOCK:
+                        raise AssertionError("scratch block allocated")
+                    if not 0 < b < self.num_blocks:
+                        raise AssertionError(f"block {b} out of range")
+                    if b in seen:
+                        raise AssertionError(f"block {b} double-owned")
+                    seen.add(b)
+        if len(seen) != self.num_blocks - 1:
+            raise AssertionError(
+                f"{self.num_blocks - 1 - len(seen)} blocks leaked"
+            )

@@ -274,15 +274,19 @@ class ExecutableStore:
         key: dict,
         *,
         example_args: tuple,
-        state,
+        state=None,
         strict: bool = False,
+        out_template=None,
     ):
         """Deserialize ``name`` if its stored key matches ``key``.
 
-        ``example_args`` is the live ``(state, batch, rng)`` the step
-        will be called with; ``state`` seeds the output template.
-        Returns the loaded executable, or None after a LOUD warning on
-        any mismatch/corruption (``strict=True`` raises instead).
+        ``example_args`` is the live argument tuple the program will be
+        called with.  The output treedef is rebuilt from
+        ``out_template`` when given (any pytree with the program's
+        output STRUCTURE — leaf values are ignored); otherwise from the
+        train-step convention ``(state, {metric_key: 0.0})``.  Returns
+        the loaded executable, or None after a LOUD warning on any
+        mismatch/corruption (``strict=True`` raises instead).
         """
         aot_path, _ = self._paths(name)
         meta = self.meta(name)
@@ -310,10 +314,11 @@ class ExecutableStore:
             with open(aot_path, "rb") as fh:
                 payload = pickle.loads(fh.read())
             in_tree = jax.tree_util.tree_flatten((tuple(example_args), {}))[1]
-            out_template = (
-                state,
-                {k: 0.0 for k in meta.get("metric_keys", [])},
-            )
+            if out_template is None:
+                out_template = (
+                    state,
+                    {k: 0.0 for k in meta.get("metric_keys", [])},
+                )
             out_tree = jax.tree_util.tree_flatten(out_template)[1]
             return serialize_executable.deserialize_and_load(
                 payload, in_tree, out_tree
@@ -470,6 +475,131 @@ def warm_train_step(
     wrapped.report = wrapped_report
     wrapped.resolve = resolve
     wrapped.lower = getattr(step_fn, "lower", None)
+    return wrapped
+
+
+def warm_program(
+    program: Callable,
+    *,
+    store: ExecutableStore,
+    key: dict,
+    name: str,
+):
+    """Load-or-compile-and-save for an arbitrary jit'd program — the
+    serving engine's prefill/decode executables get the same restart
+    discipline as the train step (``warm_train_step``), without the
+    train-step output convention.
+
+    The output structure is program-specific, so a warm restart needs
+    the caller to resolve explicitly with example args plus an output
+    template (any pytree with the program's output STRUCTURE — leaf
+    values ignored)::
+
+        fn = warm_program(decode_prog, store=store, key=key, name=...)
+        fn.resolve(example_args, out_template)  # AOT load, or compile+save
+        out = fn(*args)                         # dispatch
+
+    An unresolved call resolves lazily from its own arguments but skips
+    the AOT load (no template to rebuild the treedef from) — it still
+    compiles through the persistent cache and saves for the next
+    process.  Explicit resolve is what makes restarts warm.
+    """
+    box: dict[str, Any] = {"fn": None}
+    report: dict[str, Any] = {"mode": "unresolved"}
+
+    def _compile_and_save(args) -> None:
+        log = get_logger()
+        if not hasattr(program, "lower"):
+            log.warning(
+                "program '%s' has no .lower — AOT store disabled for "
+                "this path, using plain JIT", name,
+            )
+            box["fn"] = program
+            report.update(mode="jit")
+            return
+        stats = CompileCacheStats()
+        try:
+            t0 = time.perf_counter()
+            compiled = program.lower(*args).compile()
+            compile_s = time.perf_counter() - t0
+        # ddplint: allow[broad-except] — compile failure → plain JIT
+        except Exception as exc:  # noqa: BLE001
+            stats.close()
+            log.warning(
+                "explicit lower/compile of '%s' failed (%s: %s) — using "
+                "plain JIT", name, type(exc).__name__, exc,
+            )
+            box["fn"] = program
+            report.update(mode="jit")
+            return
+        stats.close()
+        box["fn"] = compiled
+        report.update(
+            mode="cache-hit" if stats.hits else "cold",
+            compile_s=round(compile_s, 3),
+            cache_hits=stats.hits,
+        )
+        try:
+            # Fresh compiles only (see warm_train_step: re-serializing a
+            # cache-returned executable produced incomplete payloads).
+            if stats.hits == 0 or store.meta(name) is None:
+                store.save(name, key, compiled, metric_keys=())
+        # ddplint: allow[broad-except] — saving is best-effort
+        except Exception as exc:  # noqa: BLE001
+            log.warning(
+                "AOT store save of '%s' failed (%s: %s) — next start "
+                "will recompile", name, type(exc).__name__, exc,
+            )
+
+    def resolve(example_args: tuple, out_template=None) -> dict:
+        """Acquire the executable WITHOUT running it; idempotent."""
+        if box["fn"] is not None:
+            return dict(report)
+        if out_template is not None:
+            t0 = time.perf_counter()
+            loaded = None
+            try:
+                loaded = store.load(
+                    name, key, example_args=example_args,
+                    out_template=out_template,
+                )
+            # ddplint: allow[broad-except] — store-level surprises → JIT
+            except Exception as exc:  # noqa: BLE001
+                get_logger().warning(
+                    "AOT store load of '%s' failed (%s: %s) — falling "
+                    "back to compile", name, type(exc).__name__, exc,
+                )
+            if loaded is not None:
+                box["fn"] = loaded
+                report.update(
+                    mode="aot", load_s=round(time.perf_counter() - t0, 3)
+                )
+                return dict(report)
+        _compile_and_save(example_args)
+        return dict(report)
+
+    def wrapped(*args):
+        if box["fn"] is None:
+            resolve(tuple(args))
+        try:
+            return box["fn"](*args)
+        except TypeError as exc:
+            if report.get("mode") != "aot":
+                raise
+            # Loaded binary rejected the live arguments — the check runs
+            # before any donation, so the inputs are intact; rerun
+            # through JIT and stay there (same policy as the train step).
+            get_logger().warning(
+                "AOT executable '%s' rejected live arguments (%s) — "
+                "falling back to JIT for the rest of the run", name, exc,
+            )
+            box["fn"] = program
+            report["mode"] = "jit-fallback"
+            return program(*args)
+
+    wrapped.report = report
+    wrapped.resolve = resolve
+    wrapped.lower = getattr(program, "lower", None)
     return wrapped
 
 

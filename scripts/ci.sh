@@ -21,7 +21,18 @@
 #                               two-way emitter <-> EVENT_KINDS diff, so
 #                               a kind added on one side only is a hard
 #                               error in BOTH directions
-#   4. tier-1 pytest            the ROADMAP verify command (CPU, not
+#   4. ddplint --modes serve    inference-engine graph lint: the decode
+#                               program must carry NO training
+#                               collectives and must keep its KV-pool
+#                               donation (GL003) — a lost pool alias
+#                               doubles serving memory
+#   5. ddp_serve --smoke        end-to-end serving smoke on a tiny
+#                               model under a deterministic virtual
+#                               clock: >=1 request completes and the
+#                               events dir yields a schema-valid
+#                               timeline + structurally valid Perfetto
+#                               trace with the request-lifecycle kinds
+#   6. tier-1 pytest            the ROADMAP verify command (CPU, not
 #                               slow).  Includes the ZeRO-2/3 bitwise
 #                               dp-parity + low-bit-moment convergence
 #                               tests (tests/test_zero23.py)
@@ -52,6 +63,14 @@ python scripts/ddp_meshsim.py --check
 
 echo "== check_events --schema-sync =="
 python scripts/check_events.py --schema-sync
+
+echo "== ddplint --graph --modes serve =="
+python scripts/ddplint.py --graph --modes serve
+
+echo "== ddp_serve --smoke =="
+SERVE_SMOKE_DIR="$(mktemp -d)"
+python scripts/ddp_serve.py --smoke --events-dir "${SERVE_SMOKE_DIR}"
+rm -rf "${SERVE_SMOKE_DIR}"
 
 if [[ "${DDP_PERF_GATE:-0}" == "1" ]]; then
     echo "== perf_gate =="

@@ -79,6 +79,7 @@ def analyze(records: list[dict]) -> dict:
         "alerts": [],
         "lint": [],
         "run_summary": None,
+        "serving": None,
     }
     if worker_procs:
         out["goodput"] = goodput_from_timeline(records, proc=worker_procs[0])
@@ -140,7 +141,68 @@ def analyze(records: list[dict]) -> dict:
             out["run_summary"] = {
                 k: v for k, v in r.items() if k not in ("v", "seq", "kind")
             }
+        elif kind in ("request_admit", "prefill_chunk", "decode_step",
+                      "request_done", "kv_evict"):
+            s = out["serving"]
+            if s is None:
+                s = out["serving"] = {
+                    "admitted": 0, "completed": 0, "tokens_out": 0,
+                    "prefill_chunks": 0, "decode_steps": 0,
+                    "active_sum": 0, "active_max": 0,
+                    "evictions": {"lru": 0, "preempt": 0},
+                    "evicted_blocks": 0, "ttft_s": [],
+                    "first_ts": None, "last_ts": None,
+                }
+            ts = r.get("ts")
+            if isinstance(ts, (int, float)):
+                s["first_ts"] = ts if s["first_ts"] is None \
+                    else min(s["first_ts"], ts)
+                s["last_ts"] = ts if s["last_ts"] is None \
+                    else max(s["last_ts"], ts)
+            if kind == "request_admit":
+                s["admitted"] += 1
+            elif kind == "prefill_chunk":
+                s["prefill_chunks"] += 1
+            elif kind == "decode_step":
+                s["decode_steps"] += 1
+                n = r.get("n_active") or 0
+                s["active_sum"] += n
+                s["active_max"] = max(s["active_max"], n)
+            elif kind == "request_done":
+                s["completed"] += 1
+                s["tokens_out"] += r.get("tokens") or 0
+                if isinstance(r.get("ttft_s"), (int, float)):
+                    s["ttft_s"].append(r["ttft_s"])
+            elif kind == "kv_evict":
+                reason = r.get("reason") or "lru"
+                s["evictions"][reason] = (
+                    s["evictions"].get(reason, 0) + 1
+                )
+                s["evicted_blocks"] += r.get("blocks") or 0
+    if out["serving"]:
+        s = out["serving"]
+        span = (
+            (s["last_ts"] - s["first_ts"])
+            if s["first_ts"] is not None else 0.0
+        )
+        s["tok_s"] = s["tokens_out"] / span if span > 0 else None
+        s["mean_active"] = (
+            s["active_sum"] / s["decode_steps"]
+            if s["decode_steps"] else 0.0
+        )
+        ttfts = sorted(s.pop("ttft_s"))
+        s["ttft_p50_s"] = _quantile(ttfts, 0.50)
+        s["ttft_p99_s"] = _quantile(ttfts, 0.99)
     return out
+
+
+def _quantile(sorted_vals: list, q: float):
+    """Nearest-rank quantile over an already-sorted list (stdlib-only —
+    this script must run without numpy)."""
+    if not sorted_vals:
+        return None
+    idx = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[idx]
 
 
 def render_markdown(a: dict, events_dir: str) -> str:
@@ -331,6 +393,36 @@ def render_markdown(a: dict, events_dir: str) -> str:
         for l in a["lint"]:
             for f in l["findings"]:
                 lines += ["", f"- `{f}`"]
+    lines.append("")
+
+    # -- Serving ------------------------------------------------------
+    lines += ["## Serving", ""]
+    sv = a["serving"]
+    if sv is None:
+        lines.append("No serving events — a training-only run (serve "
+                     "with `python scripts/ddp_serve.py --events-dir "
+                     "DIR` to record the request lifecycle).")
+    else:
+        tok_s = "-" if sv["tok_s"] is None else f"{sv['tok_s']:.1f}"
+        p50 = sv["ttft_p50_s"]
+        p99 = sv["ttft_p99_s"]
+        lines += [
+            f"**{sv['completed']}/{sv['admitted']} requests completed**, "
+            f"{sv['tokens_out']} tokens out at {tok_s} tok/s "
+            f"(event-span clock).",
+            "",
+            "| metric | value |",
+            "|---|---:|",
+            f"| TTFT p50 | {'-' if p50 is None else f'{p50 * 1e3:.1f} ms'} |",
+            f"| TTFT p99 | {'-' if p99 is None else f'{p99 * 1e3:.1f} ms'} |",
+            f"| decode steps | {sv['decode_steps']} |",
+            f"| mean active slots | {sv['mean_active']:.2f} |",
+            f"| max active slots | {sv['active_max']} |",
+            f"| prefill chunks | {sv['prefill_chunks']} |",
+            f"| LRU evictions | {sv['evictions'].get('lru', 0)} |",
+            f"| preempt evictions | {sv['evictions'].get('preempt', 0)} |",
+            f"| blocks reclaimed | {sv['evicted_blocks']} |",
+        ]
     lines.append("")
 
     # -- Run summary + trace ------------------------------------------

@@ -1,0 +1,218 @@
+#!/usr/bin/env python
+"""Serving CLI: continuous-batching engine under Poisson open-loop load.
+
+Usage:
+    python scripts/ddp_serve.py --model tiny --rate 20 --duration 2 \
+        --events-dir runs/serve
+    python scripts/ddp_serve.py --smoke          # CI: tiny burst, asserts
+    python scripts/ddp_serve.py --model gpt2_124m --seq-len 256 \
+        --slots 8 --rate 4 --duration 5 --store .aot-cache
+
+Builds the model with randomly-initialized params (the traffic is
+synthetic token ids — serving-path performance and correctness do not
+depend on trained weights), wires the engine to an events dir +
+metrics registry, replays a seeded loadgen trace, and prints the
+serving summary as JSON.  The events dir afterwards holds a mergeable
+timeline that ``ddp_trace.py`` exports to Perfetto (request spans,
+active-slot counter) and ``ddp_report.py`` renders with its Serving
+section.
+
+``--smoke`` is the CI gate: tiny model, ~2s virtual burst, asserting
+at least one completed request and a structurally valid trace export.
+``--virtual-dt`` makes any run deterministic (the clock advances per
+engine step instead of reading the host clock).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _ensure_cpu() -> None:
+    """CPU-safe defaults when no accelerator is configured (same
+    contract as ddplint: must run before the first jax import)."""
+    if "jax" in sys.modules:
+        return
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--model", default="tiny",
+                    choices=("tiny", "gpt2_124m"))
+    ap.add_argument("--seq-len", type=int, default=None,
+                    help="override max_seq_len (default: model's)")
+    ap.add_argument("--slots", type=int, default=8)
+    ap.add_argument("--blocks", type=int, default=64)
+    ap.add_argument("--block-size", type=int, default=16)
+    ap.add_argument("--chunk", type=int, default=32,
+                    help="prefill chunk tokens")
+    ap.add_argument("--max-prefill-chunks", type=int, default=1)
+    ap.add_argument("--rate", type=float, default=8.0,
+                    help="Poisson arrival rate, requests/s")
+    ap.add_argument("--duration", type=float, default=2.0)
+    ap.add_argument("--prompt-len", default="4,24",
+                    help="uniform prompt length range 'lo,hi'")
+    ap.add_argument("--output-len", default="4,16",
+                    help="uniform output length range 'lo,hi'")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--quantize-kv", action="store_true")
+    ap.add_argument("--quantize-weights", action="store_true")
+    ap.add_argument("--events-dir", default=None)
+    ap.add_argument("--store", default=None,
+                    help="ExecutableStore dir (warm-start AOT reuse)")
+    ap.add_argument("--virtual-dt", type=float, default=None,
+                    help="deterministic mode: seconds per engine step")
+    ap.add_argument("--smoke", action="store_true",
+                    help="CI smoke: tiny burst + trace validity asserts")
+    return ap
+
+
+def _range(spec: str) -> tuple[int, int]:
+    lo, hi = (int(x) for x in spec.split(","))
+    return lo, hi
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    _ensure_cpu()
+
+    import jax
+    import jax.numpy as jnp
+
+    from distributeddataparallel_tpu.models import TransformerLM
+    from distributeddataparallel_tpu.models.transformer import (
+        gpt2_124m,
+        tiny_lm,
+    )
+    from distributeddataparallel_tpu.observability.events import (
+        EventLog,
+        events_path,
+        merge_timeline,
+    )
+    from distributeddataparallel_tpu.observability.registry import (
+        MetricsRegistry,
+    )
+    from distributeddataparallel_tpu.serving import (
+        EngineConfig,
+        InferenceEngine,
+        LoadConfig,
+        VirtualClock,
+        kv_pool_bytes,
+        make_trace,
+        run_load,
+    )
+
+    if args.smoke:
+        args.model = "tiny"
+        args.virtual_dt = args.virtual_dt or 0.005
+        args.duration = min(args.duration, 2.0)
+
+    if args.model == "gpt2_124m":
+        cfg = gpt2_124m(max_seq_len=args.seq_len or 256,
+                        dtype=jnp.bfloat16)
+    else:
+        cfg = tiny_lm(max_seq_len=args.seq_len or 128)
+    model = TransformerLM(cfg)
+    params = model.init(
+        jax.random.PRNGKey(args.seed),
+        jnp.zeros((1, 4), jnp.int32),
+    )["params"]
+
+    events = None
+    if args.events_dir:
+        os.makedirs(args.events_dir, exist_ok=True)
+        events = EventLog(events_path(args.events_dir, 0), 0)
+        events.emit("run_start", argv=sys.argv[1:], role="serve")
+    registry = MetricsRegistry()
+
+    clock = VirtualClock(args.virtual_dt) if args.virtual_dt else None
+    ecfg = EngineConfig(
+        num_slots=args.slots,
+        num_blocks=args.blocks,
+        block_size=args.block_size,
+        prefill_chunk=args.chunk,
+        max_prefill_chunks_per_step=args.max_prefill_chunks,
+        quantized_kv=args.quantize_kv,
+        quantize_weights=args.quantize_weights,
+        store_dir=args.store,
+    )
+    engine = InferenceEngine(
+        model, params, ecfg, events=events, registry=registry,
+        **({"time_fn": clock} if clock else {}),
+    )
+    trace = make_trace(LoadConfig(
+        rate_rps=args.rate,
+        duration_s=args.duration,
+        prompt_len=_range(args.prompt_len),
+        output_len=_range(args.output_len),
+        vocab_size=cfg.vocab_size,
+        seed=args.seed,
+    ))
+    out = run_load(engine, trace, clock=clock)
+    out["requests"] = len(trace)
+    out["kv_pool_bytes"] = kv_pool_bytes(
+        cfg, args.blocks, args.block_size, quantized_kv=args.quantize_kv
+    )
+    if getattr(engine, "warm_report", None):
+        out["warm_start"] = engine.warm_report
+
+    if events is not None:
+        events.emit("metrics", snapshot=registry.snapshot())
+        events.emit("run_end", status="ok")
+        events.close()
+        merge_timeline(args.events_dir)
+
+    print(json.dumps(out, indent=1, sort_keys=True, default=str))
+
+    if args.smoke:
+        from distributeddataparallel_tpu.observability.trace_export import (
+            to_trace_events,
+            validate_trace,
+        )
+
+        failures = []
+        if out["completed"] < 1:
+            failures.append("smoke: no request completed")
+        if args.events_dir:
+            from distributeddataparallel_tpu.observability.events import (
+                load_timeline,
+            )
+            from distributeddataparallel_tpu.observability.schema import (
+                validate_file,
+            )
+
+            problems = validate_file(
+                os.path.join(args.events_dir, "timeline.jsonl")
+            )
+            failures.extend(problems[:5])
+            records = load_timeline(args.events_dir)
+            trace_problems = validate_trace(to_trace_events(records))
+            failures.extend(trace_problems[:5])
+            kinds = {r.get("kind") for r in records}
+            for needed in ("request_admit", "decode_step",
+                           "request_done"):
+                if needed not in kinds:
+                    failures.append(f"smoke: no {needed} event")
+        if failures:
+            print("SMOKE FAIL:\n  " + "\n  ".join(failures),
+                  file=sys.stderr)
+            return 1
+        print("serving smoke OK: "
+              f"{out['completed']}/{out['requests']} requests, "
+              f"{out.get('serve_tok_s', 0):.1f} tok/s")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -28,7 +28,8 @@ checker emitting a rule id the registry doesn't know).
 Examples:
     python scripts/ddplint.py --graph --ast       # what CI runs
     python scripts/ddplint.py --ast --changed-only
-    python scripts/ddplint.py --graph --modes all # adds fsdp + pp
+    python scripts/ddplint.py --graph --modes all # adds fsdp, pp, serve
+    python scripts/ddplint.py --graph --modes serve  # inference engine
 """
 
 from __future__ import annotations
@@ -53,7 +54,7 @@ _GRAPH_TRIGGERS = (
 
 #: graph-lint driver modes; "all" expands to every key
 DEFAULT_MODES = ("dp", "zero", "bucket", "bf16")
-ALL_MODES = ("dp", "zero", "bucket", "bf16", "fsdp", "pp")
+ALL_MODES = ("dp", "zero", "bucket", "bf16", "fsdp", "pp", "serve")
 
 
 def _ensure_cpu() -> None:
@@ -147,7 +148,7 @@ def _graph_cases(modes):
         step = make_train_step(loss_fn, mesh=mesh)
         yield "bf16", step, mlp_state(bf16), batch, rng
 
-    if not ({"fsdp", "pp"} & set(modes)):
+    if not ({"fsdp", "pp", "serve"} & set(modes)):
         return
     from distributeddataparallel_tpu.models import TransformerLM, tiny_lm
 
@@ -199,6 +200,71 @@ def _graph_cases(modes):
         )
         step = make_pp_train_step(cfg, mesh=mesh2, microbatches=2)
         yield "pp", step, st, b, rng
+
+    if "serve" in modes:
+        from typing import Any
+
+        import flax.struct
+
+        from distributeddataparallel_tpu.analysis.rules import (
+            collective_manifest,
+        )
+        from distributeddataparallel_tpu.serving import (
+            EngineConfig,
+            InferenceEngine,
+        )
+
+        cfg = tiny_lm(
+            num_layers=2, num_heads=2, d_model=32, d_ff=64,
+            max_seq_len=32,
+        )
+        lm = TransformerLM(cfg)
+        p = lm.init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+        )["params"]
+        engine = InferenceEngine(
+            lm, p,
+            EngineConfig(num_slots=4, num_blocks=8, block_size=8,
+                         prefill_chunk=8),
+        )
+
+        # The decode program adapted to the linter's (state, batch,
+        # rng) contract: state.params is the KV POOL — the buffer set
+        # the manifest's donate=True makes GL003 verify is aliased
+        # input->output in the lowered module (a lost pool donation
+        # doubles serving memory every step).  grad_reduce={} asserts
+        # the inference step carries NO training collectives on any
+        # axis — a psum leaking in through a shared model path would
+        # wedge a serving replica that has no gang to sync with.
+        @flax.struct.dataclass
+        class ServeState:
+            params: Any
+            opt_state: Any
+
+        bps = engine.blocks_per_seq
+        sbatch = {
+            "tables": jnp.zeros((4, bps), jnp.int32),
+            "toks": jnp.zeros((4, 1), jnp.int32),
+            "pos": jnp.zeros((4,), jnp.int32),
+        }
+
+        def serve_step(state, batch, _rng, _eng=engine):
+            return _eng._decode_prog(
+                _eng.params, state.params, batch["tables"],
+                batch["toks"], batch["pos"],
+            )
+
+        serve_step.lower = (
+            lambda state, batch, _rng, _eng=engine: _eng._decode_prog.lower(
+                _eng.params, state.params, batch["tables"],
+                batch["toks"], batch["pos"],
+            )
+        )
+        serve_step.collective_manifest = collective_manifest(
+            "serve", grad_reduce={}, donate=True,
+        )
+        yield ("serve", serve_step,
+               ServeState(params=engine.pool, opt_state=()), sbatch, rng)
 
 
 def _schedule_ir_of(step, state):

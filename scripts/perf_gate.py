@@ -52,8 +52,15 @@ _LOWER_BETTER = re.compile(
     r"(bubble|step_s|_s$|bytes|overhead|_us$|_ms$|restart|latency|skew)"
 )
 
+#: throughput names that END in a rate suffix (tok_s, img_s, ..._per_s)
+#: would otherwise hit _LOWER_BETTER's ``_s$`` and gate backwards —
+#: a serving tok/s IMPROVEMENT must not read as a regression.
+_HIGHER_BETTER = re.compile(r"(tok_s|img_s|_per_s)$")
+
 
 def _bench_direction(name: str) -> str:
+    if _HIGHER_BETTER.search(name):
+        return "higher"
     return "lower" if _LOWER_BETTER.search(name) else "higher"
 
 
