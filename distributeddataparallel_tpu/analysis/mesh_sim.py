@@ -32,8 +32,9 @@ from __future__ import annotations
 MODELS = ("cnn", "mlp", "tiny-lm", "gpt2-small")
 
 #: modes the simulator can lower (subset of the live factories that
-#: support AOT lowering on abstract state)
-MODES = ("dp", "zero", "zero2", "zero3", "fsdp", "pp")
+#: support AOT lowering on abstract state); pp_zb is the pipeline
+#: factory under the zero-bubble schedule — same mesh, B/W-split scans
+MODES = ("dp", "zero", "zero2", "zero3", "fsdp", "pp", "pp_zb")
 
 #: mode name -> make_train_step/zero_state sharding level (dp is 0)
 ZERO_LEVELS = {"dp": 0, "zero": 1, "zero2": 2, "zero3": 3}
@@ -154,14 +155,22 @@ def _build_case(model: str, mode: str, mesh, batch_per_chip: int,
         state = fsdp_state(cfg, params, tx, mesh)
         return step, state, batch, rng
 
-    if mode == "pp":
+    if mode in ("pp", "pp_zb"):
         if model in ("cnn", "mlp"):
             raise ValueError("pp simulation requires a transformer model")
         from distributeddataparallel_tpu.parallel.pipeline_parallel import (
             make_pp_train_step,
         )
 
-        step = make_pp_train_step(cfg, mesh=mesh, microbatches=2)
+        if mode == "pp_zb":
+            # zb only pays off with a steady state: M >= stages (the
+            # same minimum dpp.py enforces for --pp-schedule zb).
+            stages = mesh.shape["pipe"]
+            step = make_pp_train_step(
+                cfg, mesh=mesh, microbatches=2 * stages, schedule="zb"
+            )
+        else:
+            step = make_pp_train_step(cfg, mesh=mesh, microbatches=2)
         # abstract state only: the step's shard_map specs come from the
         # factory, so placement (shard_state_pp) is irrelevant to
         # lowering and the simulation never materializes the state
@@ -223,9 +232,14 @@ def simulate(
 
     n = len(jax.devices())
     budget = hbm_budget_bytes or default_budget()
-    if mode == "pp":
+    if mode in ("pp", "pp_zb"):
         stages = min(pp_stages, n)
         mesh = ddp.make_mesh(("data", "pipe"), shape=(n // stages, stages))
+        if mode == "pp_zb":
+            # The zb case runs 2*stages microbatches (see _build_case);
+            # the local batch shard must supply at least one row per
+            # microbatch for the M-way reshape.
+            batch_per_chip = max(batch_per_chip, 2 * stages)
     else:
         mesh = ddp.make_mesh(("data",))
 

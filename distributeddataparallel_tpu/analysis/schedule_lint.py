@@ -46,8 +46,9 @@ import dataclasses
 
 from distributeddataparallel_tpu.analysis.rules import Finding
 
-#: phase tags: forward, backward, grad-sync
-PHASES = ("F", "B", "S")
+#: phase tags: forward, activation-grad backward, weight-grad backward
+#: (zb's deferrable W unit), grad-sync
+PHASES = ("F", "B", "W", "S")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -68,7 +69,7 @@ class ScheduleIR:
     the communication/memory contract the lint verifies against the
     factory's manifest and traced step."""
 
-    kind: str                     # "gpipe" | "1f1b" | "grad-sync"
+    kind: str                     # "gpipe" | "1f1b" | "zb" | "grad-sync"
     n_stages: int
     n_microbatches: int
     virtual: int                  # chunks per stage (1 = non-interleaved)
@@ -82,13 +83,32 @@ class ScheduleIR:
     #: (c, m) is c*modulus + m % modulus, last slot is the off-schedule
     #: scratch.  None for schedules without a ring (GPipe saves via AD).
     ring: dict | None = None
+    #: phase -> [start, end) tick window in which that phase's slot
+    #: EXISTS in the compiled rendering.  None means every phase's slot
+    #: exists every tick (the uniform-body scans).  Segmented schedules
+    #: (zb) declare their windows so capacity/hop accounting prices
+    #: only the slots that actually execute.
+    slot_windows: dict | None = None
+    #: total boundary hops of the whole schedule when it is NOT
+    #: hops_per_tick x ticks (segmented bodies); overrides the product
+    #: in SL302 when set.
+    hops_total: int | None = None
 
     def bubble_fraction(self) -> float:
-        """Idle fraction straight from the table: stage-tick cells with
-        no unit over all stage-tick cells.  One tick holds at most one
-        F and one B cell per stage, so capacity = phases x stages x T."""
-        phases = len({u.phase for u in self.units}) or 1
-        capacity = phases * self.n_stages * self.ticks
+        """Idle fraction straight from the table: stage-slot cells with
+        no unit over all stage-slot cells.  Capacity is phases x stages
+        x T for uniform-body schedules (one slot per phase per stage
+        per tick); with ``slot_windows`` each phase's slot only exists
+        inside its window, so capacity is the window lengths summed."""
+        if self.slot_windows:
+            per_stage = sum(
+                int(end) - int(start)
+                for start, end in self.slot_windows.values()
+            )
+            capacity = self.n_stages * per_stage
+        else:
+            phases = len({u.phase for u in self.units}) or 1
+            capacity = phases * self.n_stages * self.ticks
         return round((capacity - len(self.units)) / capacity, 4)
 
     def to_dict(self) -> dict:
@@ -161,6 +181,64 @@ def one_f_one_b_schedule_ir(
     )
 
 
+def zb_schedule_ir(
+    n_stages: int,
+    microbatches: int,
+    virtual: int = 1,
+    *,
+    hop_axis: str = "pipe",
+) -> ScheduleIR:
+    """Zero-bubble (ZB-H1-style W/B split) table, derived from the
+    schedule DEFINITION: the F and B placements are exactly 1F1B's
+    (forward of unit ``j`` on stage ``s`` at tick ``j + s``; backward
+    at ``j + (v·n - 1) + (n - 1 - s)``, chunk order reversed) and the
+    weight-grad unit W runs the SAME tick as its B (deferral depth 0 —
+    deferring W in the segmented-scan rendering lengthens the scan
+    without creating capacity).  What changes is the CAPACITY model:
+    phase slots only exist inside their windows (warm-up ticks have no
+    B/W slot, drain ticks no F slot), declared via ``slot_windows``
+    derived here from the table's own tick extents — deliberately NOT
+    a call into ``pipeline_parallel._zb_segments``; SL304 exists to
+    catch the two derivations disagreeing.  Boundary hops follow the
+    windows too (one F hop per F-window tick, one B hop per B-window
+    tick, W never hops), so ``hops_total`` replaces the uniform
+    hops_per_tick x ticks product in SL302.
+    """
+    n, M, v = n_stages, microbatches, virtual
+    units = []
+    groups = (M + n - 1) // n
+    for g in range(groups):
+        for c in range(v):
+            for off in range(n):
+                m = g * n + off
+                if m >= M:
+                    continue
+                j = g * (n * v) + c * n + off
+                for s in range(n):
+                    tf = j + s
+                    tb = j + (v * n - 1) + (n - 1 - s)
+                    units.append(ScheduleUnit(tf, s, c, m, "F"))
+                    units.append(ScheduleUnit(tb, s, v - 1 - c, m, "B"))
+                    units.append(ScheduleUnit(tb, s, v - 1 - c, m, "W"))
+    f_ticks = [u.tick for u in units if u.phase == "F"]
+    b_ticks = [u.tick for u in units if u.phase == "B"]
+    ticks = max(b_ticks) + 1
+    windows = {
+        "F": (0, max(f_ticks) + 1),
+        "B": (min(b_ticks), ticks),
+        "W": (min(b_ticks), ticks),
+    }
+    hops_total = (windows["F"][1] - windows["F"][0]) \
+        + (windows["B"][1] - windows["B"][0])
+    return ScheduleIR(
+        kind="zb", n_stages=n, n_microbatches=M, virtual=v,
+        ticks=ticks, hop_prim="ppermute", hop_axis=hop_axis,
+        hops_per_tick=2, exact_hops=True, units=tuple(units),
+        ring={"n_slots": v * 2 * n + 1, "modulus": 2 * n},
+        slot_windows=windows, hops_total=hops_total,
+    )
+
+
 def grad_sync_schedule_ir(
     n_buckets: int,
     *,
@@ -185,9 +263,11 @@ def grad_sync_schedule_ir(
 def _check_table(ir: ScheduleIR, where: str) -> list:
     """SL301: the table is a well-formed pipeline."""
     findings = []
-    expect_phases = ("F", "B") if ir.kind == "1f1b" else (
-        ("F",) if ir.kind == "gpipe" else ("S",)
-    )
+    expect_phases = {
+        "1f1b": ("F", "B"),
+        "zb": ("F", "B", "W"),
+        "gpipe": ("F",),
+    }.get(ir.kind, ("S",))
     seen: dict[tuple, ScheduleUnit] = {}
     for u in ir.units:
         if not 0 <= u.tick < ir.ticks:
@@ -251,15 +331,37 @@ def _check_table(ir: ScheduleIR, where: str) -> list:
                         f"(stage={s}, chunk={c}, mb={m}): backward at "
                         f"tick {b.tick} before forward at {f.tick}",
                     ))
+                w = seen.get((s, c, m, "W"))
+                # W consumes B's cotangent seed: it may run the same
+                # tick (F -> B -> W within a tick) but never earlier.
+                if w and b and w.tick < b.tick:
+                    findings.append(Finding(
+                        "SL301", where,
+                        f"(stage={s}, chunk={c}, mb={m}): weight-grad "
+                        f"W at tick {w.tick} before its activation-grad "
+                        f"B at {b.tick}",
+                    ))
+    if ir.slot_windows:
+        for u in ir.units:
+            win = ir.slot_windows.get(u.phase)
+            if win and not win[0] <= u.tick < win[1]:
+                findings.append(Finding(
+                    "SL301", where,
+                    f"unit {u} outside its declared {u.phase}-slot "
+                    f"window [{win[0]}, {win[1]}) — the segmented "
+                    "rendering has no slot to run it in",
+                ))
     return findings
 
 
 def _check_ring(ir: ScheduleIR, where: str) -> list:
     """SL303: saved-activation ring slot lifetimes.  Slot of (c, m) is
-    written at the unit's F tick and read at its B tick; a second write
-    landing at or before a pending read clobbers a live buffer (F runs
-    before B within a tick, so equality is a clobber too)."""
-    if not ir.ring or ir.kind != "1f1b":
+    written at the unit's F tick and read at its B tick (zb's W unit
+    reads the same slot the same tick as its B, so the B-read lifetime
+    covers it); a second write landing at or before a pending read
+    clobbers a live buffer (F runs before B within a tick, so equality
+    is a clobber too)."""
+    if not ir.ring or ir.kind not in ("1f1b", "zb"):
         return []
     findings = []
     modulus = int(ir.ring["modulus"])
@@ -331,7 +433,12 @@ def lint_schedule(
                 "step the schedule requires",
             ))
     if traced_hops is not None:
-        expected = ir.hops_per_tick * ir.ticks
+        if ir.hops_total is not None:
+            expected = ir.hops_total
+            how = "window-derived total"
+        else:
+            expected = ir.hops_per_tick * ir.ticks
+            how = f"{ir.hops_per_tick}/tick x {ir.ticks} ticks"
         bad = (traced_hops != expected) if ir.exact_hops \
             else (traced_hops < expected)
         if bad:
@@ -340,9 +447,8 @@ def lint_schedule(
                 "SL302", where,
                 f"traced {ir.hop_prim} count {traced_hops} on axis "
                 f"'{ir.hop_axis}' violates schedule expectation "
-                f"{rel} {expected} ({ir.hops_per_tick}/tick x "
-                f"{ir.ticks} ticks) — the compiled step does not run "
-                "this schedule",
+                f"{rel} {expected} ({how}) — the compiled step does "
+                "not run this schedule",
             ))
 
     # SL304: table bubble vs the factory's accounting.

@@ -58,6 +58,8 @@ EVENT_KINDS: dict[str, tuple[str, ...]] = {
     "run_summary": ("windows", "restarts"),
     # Static-analysis layer (ddplint):
     "lint_report": ("layer", "n_findings", "rules"),
+    # Pipeline-parallel layer (measured schedule-bubble counters):
+    "pp_phase": ("schedule", "n_stages", "counts"),
     # Serving layer (serving/engine request lifecycle):
     "request_admit": ("req",),
     "prefill_chunk": ("req", "start", "len"),

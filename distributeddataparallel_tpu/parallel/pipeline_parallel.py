@@ -8,10 +8,17 @@ stages" is literally "shard that leading dim over the pipe axis" — each
 mesh position holds ``L / n_stages`` layers and runs the same scanned
 block code on its slice.
 
-Two schedules share the stage sharding (``make_pp_train_step(...,
-schedule=)``): GPipe (default, below) and 1F1B
+Three schedules share the stage sharding (``make_pp_train_step(...,
+schedule=)``): GPipe (default, below), 1F1B
 (``_pp_1f1b_loss_and_grads`` — interleaved manual backward, O(stages)
-activation memory instead of O(microbatches); see its docstring).
+activation memory instead of O(microbatches); see its docstring), and
+``zb`` — a ZB-H1-style zero-bubble variant of 1F1B that splits each
+backward into an activation-grad unit B (critical path) and a
+weight-grad unit W, rendered as three segmented scans so warm-up ticks
+never execute a dead backward slot and drain ticks never execute a
+dead forward slot.  The per-stage useful-slot counters the schedules
+carry make the bubble MEASURED (``pp_phase_counts`` in the step
+metrics), not just analytic.
 
 The GPipe schedule inside ``shard_map``:
 
@@ -432,10 +439,15 @@ def _pp_1f1b_loss_and_grads(
     microbatches: int,
     moe_aux_weight: float = 0.0,
     virtual: int = 1,
+    schedule: str = "1f1b",
 ):
-    """1F1B schedule with a MANUAL backward: returns ``(loss, grads)``
-    shaped exactly like ``value_and_grad(pp_loss)`` so the surrounding
-    step (pipe psum completion, DP sync, ZeRO) is schedule-agnostic.
+    """1F1B schedule with a MANUAL backward: returns ``(loss, grads,
+    phase_counts)`` with the (loss, grads) pair shaped exactly like
+    ``value_and_grad(pp_loss)`` so the surrounding step (pipe psum
+    completion, DP sync, ZeRO) is schedule-agnostic.  ``phase_counts``
+    is a per-stage ``(3,)`` int32 vector counting the VALID (F, B, W)
+    slots this stage executed — the measured side of the bubble
+    accounting (off-schedule masked slots don't count).
 
     GPipe (``pp_loss``) differentiates through the whole tick loop, so
     AD keeps every microbatch's stage activations alive until the
@@ -497,6 +509,35 @@ def _pp_1f1b_loss_and_grads(
     by ``pp_bubble_fraction`` and recorded in the bench.  Requires
     ``num_layers % (n·v) == 0``; the unit ordering needs no divisibility
     of M (off-group units are masked like any bubble tick).
+
+    ``schedule="zb"`` — ZERO-BUBBLE (ZB-H1-style W/B decomposition,
+    arXiv 2401.10241 lineage; see also arXiv 2412.14374): the joint
+    stage vjp splits into an activation-grad unit **B** (``jax.vjp``
+    w.r.t. the stage input only — the cotangent must keep flowing up
+    the pipe, so B stays on the critical path) and a weight-grad unit
+    **W** (``jax.vjp`` w.r.t. the layer params only — nothing
+    downstream consumes it, so it is off the critical path).  XLA CSE
+    merges the two vjps' duplicated forward recompute, and each
+    primitive's transpose is evaluated identically in both renderings,
+    so dx/dW are bit-identical to the joint vjp's.
+
+    In this SPMD masked-scan rendering a masked slot still burns wall
+    clock, so the win comes from SEGMENTATION, not from moving W: the
+    1F1B scan executes an F-slot AND a B-slot every tick (2T slots of
+    capacity for 2Mv useful), while the zb rendering runs three scans
+    with heterogeneous bodies — warm-up ticks ``[0, vn-1)`` execute
+    only the F slot, steady ticks ``[vn-1, j_last+n)`` execute F+B+W,
+    drain ticks ``[j_last+n, T)`` execute only B+W — so the dead
+    phases genuinely do not execute.  Capacity drops to
+    ``3·(j_last+n)`` slots for ``3Mv`` useful: bubble
+    ``1 - Mv/(j_last+n)`` vs 1F1B's ``1 - Mv/T``
+    (``_zb_segments`` / ``pp_bubble_fraction(schedule="zb")``).  W
+    runs the SAME tick as its B (deferral depth 0): deferring W
+    further would lengthen the scan without creating capacity, and
+    same-tick W keeps memory identical to 1F1B — the activation ring
+    is unchanged and no pending-W state accumulates.  Composition
+    limits in v1: no ``cfg.cp_axis`` and no MoE aux loss (the factory
+    rejects both loudly); TP and ZeRO compose as in 1F1B.
     """
     from distributeddataparallel_tpu.models.transformer import (
         rope_frequencies,
@@ -606,19 +647,23 @@ def _pp_1f1b_loss_and_grads(
         )
 
     _, T = _1f1b_ticks(n, M, v)
+    split_bw = schedule == "zb"
+    if split_bw and use_aux:
+        raise ValueError("zb schedule does not support the MoE aux loss")
 
-    # One scan iteration = one F-tick + one B-tick (the even/odd clock
-    # flattened).  lax.scan, NOT an unrolled python loop, for two
-    # load-bearing reasons: the carried ring buffer updates alias in
-    # place, and iteration boundaries stop the scheduler from hoisting
-    # every B-tick's recompute ahead of the backwards (which would
-    # resurrect the O(M) liveness this schedule exists to kill).
-    def tick(carry, i):
-        saved, fbuf, bbuf, gacc, loss_acc, aux_acc = carry
-        # --- F-tick i: stage s runs forward of unit i - s --------------
+    # lax.scan, NOT an unrolled python loop, for two load-bearing
+    # reasons: the carried ring buffer updates alias in place, and
+    # iteration boundaries stop the scheduler from hoisting every
+    # B-tick's recompute ahead of the backwards (which would resurrect
+    # the O(M) liveness this schedule exists to kill).  The tick body
+    # is factored into per-phase SLOTS so 1f1b (F+B every tick) and zb
+    # (segmented F / F+B+W / B+W bodies) render from the same code.
+    def f_slot(carry, i):
+        # --- F slot, tick i: stage s runs forward of unit i - s --------
         # (0 <= m < M subsumes the tick-range bound: i < T implies the
         # per-stage unit index is already past the last unit when
         # off-schedule)
+        saved, fbuf, bbuf, gacc, loss_acc, aux_acc, counts = carry
         cf, mf, valid = _decode_unit(i - s)
         mc = jnp.clip(mf, 0, M - 1)
         toks = lax.dynamic_index_in_dim(mbs_in, mc, 0, keepdims=False)
@@ -626,15 +671,28 @@ def _pp_1f1b_loss_and_grads(
         slot = jnp.where(valid, cf * (2 * n) + mc % (2 * n), v * 2 * n)
         saved = lax.dynamic_update_slice_in_dim(saved, x[None], slot, 0)
         fbuf = lax.ppermute(stage_fn(_chunk_params(cf), x), pp_axis, perm_f)
-        # --- B-tick i: stage s runs backward of unit
+        counts = counts + valid.astype(jnp.int32) * jnp.array(
+            [1, 0, 0], jnp.int32
+        )
+        return (saved, fbuf, bbuf, gacc, loss_acc, aux_acc, counts)
+
+    def bw_slot(carry, i):
+        # --- B (+W) slot, tick i: stage s runs backward of unit
         #     i - (vn - 1) - (n - 1 - s), chunks in REVERSE order -------
+        saved, fbuf, bbuf, gacc, loss_acc, aux_acc, counts = carry
         cb, mb_, valid = _decode_unit(i - (v * n - 1) - (n - 1 - s))
         cb = v - 1 - cb
         mc = jnp.clip(mb_, 0, M - 1)
         slot = jnp.where(valid, cb * (2 * n) + mc % (2 * n), v * 2 * n)
         xb = lax.dynamic_index_in_dim(saved, slot, 0, keepdims=False)
         chunk_p = _chunk_params(cb)
-        if use_aux:
+        if split_bw:
+            # ZB W/B decomposition: B = vjp w.r.t. the stage INPUT only
+            # (params enter as a closure constant, so no dW cotangent
+            # path is built); W below is the params-only twin.
+            y, b_vjp = jax.vjp(lambda xx: stage_fn(chunk_p, xx), xb)
+            aux = jnp.zeros((), jnp.float32)
+        elif use_aux:
             (y, aux), stage_vjp = jax.vjp(stage_fn_aux, chunk_p, xb)
         else:
             y, stage_vjp = jax.vjp(stage_fn, chunk_p, xb)
@@ -663,7 +721,16 @@ def _pp_1f1b_loss_and_grads(
 
         lval, dhp, dy_head = lax.cond(on_last, do_head, skip_head, y)
         gy = jnp.where(on_last, dy_head.astype(fbuf.dtype), bbuf)
-        if use_aux:
+        if split_bw:
+            # B unit: activation grad only — the cotangent the next
+            # stage up is waiting on.  W unit: weight grad only, same
+            # tick (deferral depth 0 — see the docstring).  Each vjp
+            # transposes the same primitives the joint vjp would, so
+            # dx/dlayers are bit-identical and CSE shares the recompute.
+            (dx,) = b_vjp(gy)
+            _, w_vjp = jax.vjp(lambda lp: stage_fn(lp, xb), chunk_p)
+            (dlayers,) = w_vjp(gy)
+        elif use_aux:
             # The aux output's cotangent: GPipe adds
             # moe_aux_weight * psum(aux_acc) / (n*M) to the loss, so
             # every valid (stage-chunk, microbatch) aux value carries
@@ -713,14 +780,52 @@ def _pp_1f1b_loss_and_grads(
         loss_acc = loss_acc + jnp.where(valid & on_last, lval, 0.0)
         aux_acc = aux_acc + jnp.where(valid, aux, 0.0)
         bbuf = lax.ppermute(dx, pp_axis, perm_b)
-        return (saved, fbuf, bbuf, gacc, loss_acc, aux_acc), None
+        counts = counts + valid.astype(jnp.int32) * (
+            jnp.array([0, 1, 1], jnp.int32) if split_bw
+            else jnp.array([0, 1, 0], jnp.int32)
+        )
+        return (saved, fbuf, bbuf, gacc, loss_acc, aux_acc, counts)
 
     aux_acc = jnp.zeros((), jnp.float32)
-    (saved, fbuf, bbuf, gacc, loss_acc, aux_acc), _ = lax.scan(
-        tick,
-        (saved, fbuf, bbuf, gacc, loss_acc, aux_acc),
-        jnp.arange(T, dtype=jnp.int32),
-    )
+    counts = jnp.zeros((3,), jnp.int32)
+    carry = (saved, fbuf, bbuf, gacc, loss_acc, aux_acc, counts)
+    if split_bw:
+        # Three segmented scans with heterogeneous bodies — THE
+        # zero-bubble mechanism (the W split alone buys nothing in an
+        # SPMD rendering where masked slots still burn wall clock):
+        # warm-up ticks run no backward slot, drain ticks run no
+        # forward slot, so per-stage capacity is 3·(j_last+n) slots
+        # instead of the uniform body's 3·T.  Tick indices stay GLOBAL
+        # across the segments; the arithmetic is _zb_segments — the
+        # same closed form pp_bubble_fraction(schedule="zb") prices.
+        warm, _steady, _drain, f_end = _zb_segments(n, M, v)
+
+        def f_tick(c, i):
+            return f_slot(c, i), None
+
+        def fbw_tick(c, i):
+            return bw_slot(f_slot(c, i), i), None
+
+        def bw_tick(c, i):
+            return bw_slot(c, i), None
+
+        carry, _ = lax.scan(
+            f_tick, carry, jnp.arange(0, warm, dtype=jnp.int32)
+        )
+        carry, _ = lax.scan(
+            fbw_tick, carry, jnp.arange(warm, f_end, dtype=jnp.int32)
+        )
+        carry, _ = lax.scan(
+            bw_tick, carry, jnp.arange(f_end, T, dtype=jnp.int32)
+        )
+    else:
+        # One scan iteration = one F-tick + one B-tick (the even/odd
+        # clock flattened).
+        def tick(c, i):
+            return bw_slot(f_slot(c, i), i), None
+
+        carry, _ = lax.scan(tick, carry, jnp.arange(T, dtype=jnp.int32))
+    saved, fbuf, bbuf, gacc, loss_acc, aux_acc, counts = carry
 
     # Only the last stage accumulated loss; psum-fwd/identity-bwd is
     # irrelevant here (no AD through this), plain psum replicates it.
@@ -731,7 +836,7 @@ def _pp_1f1b_loss_and_grads(
         loss = loss + moe_aux_weight * (
             lax.psum(aux_acc, pp_axis) / (n * v * M)
         )
-    return loss, gacc
+    return loss, gacc, counts
 
 
 def _1f1b_ticks(n: int, M: int, v: int) -> tuple[int, int]:
@@ -747,28 +852,81 @@ def _1f1b_ticks(n: int, M: int, v: int) -> tuple[int, int]:
     return j_last, j_last + v * n + n - 1
 
 
-def pp_bubble_fraction(
-    n: int, microbatches: int, virtual: int = 1
-) -> dict:
-    """Exact tick accounting of the 1F1B schedule's pipeline bubble.
+def _zb_segments(n: int, M: int, v: int) -> tuple[int, int, int, int]:
+    """(warmup, steady, drain, f_end) tick-segment lengths of the zb
+    schedule — THE zb tick arithmetic, shared by the compiled
+    three-scan rendering and ``pp_bubble_fraction(schedule="zb")``.
 
-    The scan runs ``T`` iterations; each executes one F-unit and one
-    B-unit slot of ``1/virtual`` stage-work each, masked off-schedule.
-    Useful work per device = ``2·M·virtual`` unit-slots out of ``2·T``
-    — the rest is bubble (warm-up/drain idle).  ``T`` comes from
-    ``_1f1b_ticks``, the same arithmetic the compiled schedule uses, so
-    the number IS the schedule, not an estimate; the bench records it
-    next to the wall-clock step times.
+    Warm-up ``[0, vn-1)`` runs F slots only (the first backward — unit
+    0 on stage n-1 — cannot start before tick ``vn-1``); steady
+    ``[vn-1, f_end)`` runs F+B+W; drain ``[f_end, T)`` runs B+W only
+    (the last forward — unit j_last on stage n-1 — finishes at tick
+    ``f_end - 1``).  The segments sum to the 1F1B scan length T, so zb
+    changes per-tick slot CAPACITY, never the critical path.
+    """
+    j_last, T = _1f1b_ticks(n, M, v)
+    warm = v * n - 1
+    f_end = j_last + n
+    return warm, f_end - warm, T - f_end, f_end
+
+
+def pp_bubble_fraction(
+    n: int, microbatches: int, virtual: int = 1, schedule: str = "1f1b"
+) -> dict:
+    """Exact slot accounting of a pipeline schedule's bubble.
+
+    ``schedule="1f1b"``: the scan runs ``T`` iterations; each executes
+    one F-unit and one B-unit slot of ``1/virtual`` stage-work each,
+    masked off-schedule.  Useful work per device = ``2·M·virtual``
+    unit-slots out of ``2·T`` — the rest is bubble (warm-up/drain
+    idle).  ``T`` comes from ``_1f1b_ticks``, the same arithmetic the
+    compiled schedule uses, so the number IS the schedule, not an
+    estimate; the bench records it next to the wall-clock step times.
+
+    ``schedule="zb"``: three phases (F, B, W) over the segmented scans
+    of ``_zb_segments`` — slot capacity per stage is F-window + B-window
+    + W-window = ``3·(j_last+n)`` for ``3·M·virtual`` useful slots, so
+    the bubble is ``1 - M·v/(j_last+n)`` < the 1F1B fraction at every
+    (n, M, v).  ``slot_windows`` (phase -> [start, end) tick) is the
+    per-phase capacity table the measured-bubble reconstruction and
+    the SL30x lint both consume.
     """
     M, v = microbatches, virtual
-    _, T = _1f1b_ticks(n, M, v)
+    j_last, T = _1f1b_ticks(n, M, v)
+    if schedule == "zb":
+        warm, steady, drain, f_end = _zb_segments(n, M, v)
+        useful = 3 * M * v
+        total = 3 * f_end
+        return {
+            "n_stages": n,
+            "microbatches": M,
+            "virtual": v,
+            "schedule": "zb",
+            "ticks": T,
+            "segments": {"warmup": warm, "steady": steady, "drain": drain},
+            "slot_windows": {
+                "F": (0, f_end),
+                "B": (v * n - 1, T),
+                "W": (v * n - 1, T),
+            },
+            "useful_slots": useful,
+            "slot_capacity": total,
+            "bubble_fraction": round((total - useful) / total, 4),
+            # per-device idle in full-stage-compute units: 3 slots/v
+            # make up one stage-unit of F+B+W work.
+            "bubble_stage_units": round((total - useful) / (3 * v), 4),
+        }
     useful = 2 * M * v
     total = 2 * T
     return {
         "n_stages": n,
         "microbatches": M,
         "virtual": v,
+        "schedule": "1f1b",
         "ticks": T,
+        "slot_windows": {"F": (0, T), "B": (0, T)},
+        "useful_slots": useful,
+        "slot_capacity": total,
         "bubble_fraction": round((total - useful) / total, 4),
         # per-device idle in full-stage-compute units (ticks are 1/v of
         # a stage): the cross-virtual-degree comparable number.
@@ -793,12 +951,20 @@ def make_pp_train_step(
 ):
     """Compiled DP x PP train step for a scanned TransformerLM config.
 
-    ``virtual > 1`` selects INTERLEAVED 1F1B (v layer chunks per stage;
-    state must be placed with ``shard_state_pp(virtual=v)`` so each pipe
-    position's contiguous rows are its round-robin chunks).  Requires
-    ``schedule="1f1b"`` and ``num_layers % (n_stages · v) == 0``; see
-    ``_pp_1f1b_loss_and_grads`` for the schedule and
-    ``pp_bubble_fraction`` for the measured bubble accounting.
+    ``virtual > 1`` selects INTERLEAVED scheduling (v layer chunks per
+    stage; state must be placed with ``shard_state_pp(virtual=v)`` so
+    each pipe position's contiguous rows are its round-robin chunks).
+    Requires ``schedule="1f1b"`` or ``"zb"`` and
+    ``num_layers % (n_stages · v) == 0``; see
+    ``_pp_1f1b_loss_and_grads`` for the schedules and
+    ``pp_bubble_fraction`` for the bubble accounting.
+
+    ``schedule="zb"`` — zero-bubble ZB-H1-style W/B split (see
+    ``_pp_1f1b_loss_and_grads``): bit-identical losses/grads to 1f1b,
+    smaller bubble (``1 - Mv/(j_last+n)`` vs ``1 - Mv/T``), same
+    activation memory.  v1 rejects ``cfg.cp_axis`` and the MoE aux
+    loss.  The 1f1b and zb steps return measured per-stage
+    ``pp_phase_counts`` (F/B/W useful-slot counters) in their metrics.
 
     ``zero=True``: ZeRO-1 over the data axis on the PIPE-LOCAL param
     shards — after the pipe psum completes every gradient, each
@@ -851,15 +1017,27 @@ def make_pp_train_step(
         # have per-replica norms — clipping would scale each data-axis
         # replica differently and params would drift.
         raise ValueError("grad_clip requires grad_sync=True")
-    if schedule not in ("gpipe", "1f1b"):
+    if schedule not in ("gpipe", "1f1b", "zb"):
         raise ValueError(f"unknown pipeline schedule {schedule!r}")
     if virtual < 1:
         raise ValueError(f"virtual must be >= 1, got {virtual}")
-    if virtual > 1 and schedule != "1f1b":
+    if virtual > 1 and schedule == "gpipe":
         raise ValueError(
             "virtual (interleaved) stages require schedule='1f1b' — the "
             "GPipe path runs whole contiguous stages"
         )
+    if schedule == "zb":
+        if cfg.cp_axis is not None:
+            raise ValueError(
+                "zb schedule does not compose with cp_axis yet — use "
+                "schedule='1f1b' for context-parallel pipelines"
+            )
+        if cfg.moe_experts > 0 and moe_aux_weight > 0.0:
+            raise ValueError(
+                "zb schedule does not support the MoE aux loss (the B/W "
+                "split has no aux cotangent path) — set "
+                "moe_aux_weight=0.0 or use schedule='1f1b'"
+            )
     n_stages = mesh.shape[pp_axis]
     M = microbatches
     stack = _stage_stack(cfg, n_stages * virtual)
@@ -955,16 +1133,18 @@ def make_pp_train_step(
         else:
             toks = batch["tokens"]
             inputs, targets = toks[:, :-1], toks[:, 1:]
-        if schedule == "1f1b":
-            loss, grads = _pp_1f1b_loss_and_grads(
+        if schedule in ("1f1b", "zb"):
+            loss, grads, phase_counts = _pp_1f1b_loss_and_grads(
                 cfg, stack, state.params, inputs, targets,
                 pp_axis=pp_axis, n=n_stages, microbatches=M,
                 moe_aux_weight=moe_aux_weight, virtual=virtual,
+                schedule=schedule,
             )
         else:
             loss, grads = jax.value_and_grad(pp_loss)(
                 state.params, inputs, targets
             )
+            phase_counts = None
         # Complete replicated-param grads over the pipe (only the stages
         # that use them contributed); layer-slice grads stay local.
         gspecs = pp_param_specs(grads, pp_axis, cfg.tp_axis, cfg.ep_axis)
@@ -1013,7 +1193,17 @@ def make_pp_train_step(
                 )
                 grads = jax.tree.map(lambda g: g * scale, grads)
             new_state = state.apply_gradients(grads)
-        return new_state, {"loss": lax.pmean(loss, data_axis)}
+        metrics = {"loss": lax.pmean(loss, data_axis)}
+        if phase_counts is not None:
+            # Measured per-stage useful-slot counters, gathered over the
+            # pipe into an (n_stages, 3) [F, B, W] table — identical on
+            # every device, so the replicated out-spec is exact.  This
+            # is the device-side half of the measured-bubble loop
+            # (observability.pipeline reconstructs the fraction).
+            metrics["pp_phase_counts"] = lax.all_gather(
+                phase_counts, pp_axis
+            )
+        return new_state, metrics
 
     compiled = None
     jit_kwargs = {"donate_argnums": (0,)} if donate else {}
@@ -1094,9 +1284,17 @@ def make_pp_train_step(
     from distributeddataparallel_tpu.analysis.schedule_lint import (
         gpipe_schedule_ir,
         one_f_one_b_schedule_ir,
+        zb_schedule_ir,
     )
 
-    if schedule == "1f1b":
+    if schedule == "zb":
+        step.schedule_ir = zb_schedule_ir(
+            n_stages, M, virtual, hop_axis=pp_axis
+        )
+        step.bubble_accounting = pp_bubble_fraction(
+            n_stages, M, virtual, schedule="zb"
+        )
+    elif schedule == "1f1b":
         step.schedule_ir = one_f_one_b_schedule_ir(
             n_stages, M, virtual, hop_axis=pp_axis
         )

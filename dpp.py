@@ -137,11 +137,15 @@ def parse_args(argv=None):
     p.add_argument("--pp-microbatches", type=int, default=None,
                    help="pipeline microbatches per step (default: --pp)")
     p.add_argument("--pp-schedule", default="gpipe",
-                   choices=["gpipe", "1f1b"],
+                   choices=["gpipe", "1f1b", "zb"],
                    help="pipeline schedule: gpipe (AD through the tick "
-                        "loop, O(microbatches) activation memory) or 1f1b "
+                        "loop, O(microbatches) activation memory), 1f1b "
                         "(interleaved manual backward, O(stages) activation "
-                        "memory — the Megatron-LM 1F1B schedule)")
+                        "memory — the Megatron-LM 1F1B schedule), or zb "
+                        "(ZB-H1-style zero-bubble: backward split into "
+                        "activation-grad B and weight-grad W units so W "
+                        "fills the warm-up/drain bubble; same memory as "
+                        "1f1b)")
     p.add_argument("--pp-virtual", type=int, default=1,
                    help="interleaved 1F1B: virtual layer chunks per stage "
                         "(Megatron interleaved schedule; requires "
@@ -499,9 +503,31 @@ def validate_args(args) -> None:
                 + (f" x --pp-virtual {args.pp_virtual}"
                    if args.pp_virtual > 1 else "")
             )
+        if args.pp_schedule == "zb":
+            M = args.pp_microbatches or args.pp
+            if M < args.pp:
+                raise SystemExit(
+                    f"--pp-schedule zb needs --pp-microbatches >= --pp "
+                    f"(got {M} < {args.pp}): with fewer microbatches than "
+                    f"stages the steady state never forms and there is no "
+                    f"W work to fill the bubble — use 1f1b"
+                )
+            if args.cp > 1:
+                raise SystemExit(
+                    "--pp-schedule zb does not compose with --cp yet; "
+                    "use --pp-schedule 1f1b for context-parallel pipelines"
+                )
+            if args.moe_experts and args.moe_aux_weight:
+                raise SystemExit(
+                    "--pp-schedule zb does not support the MoE aux loss "
+                    "(the B/W split has no aux cotangent path); set "
+                    "--moe-aux-weight 0 or use --pp-schedule 1f1b"
+                )
         if args.pp_virtual > 1:
-            if args.pp_schedule != "1f1b":
-                raise SystemExit("--pp-virtual requires --pp-schedule 1f1b")
+            if args.pp_schedule not in ("1f1b", "zb"):
+                raise SystemExit(
+                    "--pp-virtual requires --pp-schedule 1f1b or zb"
+                )
             if args.zero:
                 # ZeRO's flat layouts flatten the PERMUTED local shards;
                 # the elastic reshard's logical-geometry assumption would
@@ -1206,10 +1232,12 @@ def train(args) -> float:
                 f"--batch-size {args.batch_size} must be divisible by "
                 f"--pp-microbatches {M}"
             )
-        if model.cfg.num_layers % args.pp:
+        if model.cfg.num_layers % (args.pp * args.pp_virtual):
             raise SystemExit(
                 f"model layer count {model.cfg.num_layers} must be "
                 f"divisible by --pp {args.pp}"
+                + (f" x --pp-virtual {args.pp_virtual}"
+                   if args.pp_virtual > 1 else "")
             )
         step_fn = ddp.make_pp_train_step(
             model.cfg, mesh=mesh, microbatches=M, zero=args.zero,
@@ -1960,6 +1988,27 @@ def train(args) -> float:
                         )
                         if goodput is not None:
                             goodput.add("compile", timer.compile_s)
+                        if events is not None and "pp_phase_counts" in metrics:
+                            # Measured-schedule counters: the compiled
+                            # scan counted useful (valid) slots per
+                            # stage per phase; emit them once with the
+                            # factory's analytic accounting so the
+                            # report can reconstruct the measured
+                            # bubble post hoc.
+                            from distributeddataparallel_tpu.observability.pipeline import (
+                                phase_counts_payload,
+                            )
+                            events.emit("pp_phase", **phase_counts_payload(
+                                jax.device_get(metrics["pp_phase_counts"]),
+                                schedule=args.pp_schedule,
+                                n_stages=args.pp,
+                                virtual=args.pp_virtual,
+                                microbatches=args.pp_microbatches or args.pp,
+                                accounting=getattr(
+                                    step_fn, "bubble_accounting", None
+                                ),
+                                step=gstep,
+                            ))
                         if mem_tel is not None:
                             # One-time compiler memory budget for the
                             # step program.  lower().compile() is a

@@ -47,10 +47,12 @@ FINDINGS_EXIT = 2
 #: --check preset: enough to catch a broken lowering or a lint
 #: regression on both a conv net and the transformer path — including
 #: the sharded-update variants (zero2: reduce-scatter manifest + IR;
-#: zero3: params resident as a flat shard, gather-per-bucket IR) —
-#: small enough to stay in CI budget
+#: zero3: params resident as a flat shard, gather-per-bucket IR) and
+#: the zero-bubble pipeline (B/W-split scans + zb schedule IR through
+#: SL301-SL304) — small enough to stay in CI budget
 CHECK_CASES = (
     "cnn:dp", "gpt2-small:dp", "gpt2-small:zero2", "gpt2-small:zero3",
+    "gpt2-small:pp_zb",
 )
 CHECK_DEVICES = (8, 32)
 
@@ -130,8 +132,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--model", default="gpt2-small",
                    help="cnn | mlp | tiny-lm | gpt2-small")
     p.add_argument("--mode", default="dp",
-                   help="dp | zero | zero2 | zero3 | fsdp | pp | all "
-                        "(all = every mode the model supports)")
+                   help="dp | zero | zero2 | zero3 | fsdp | pp | pp_zb "
+                        "| all (all = every mode the model supports)")
     p.add_argument("--devices", default="8",
                    help="comma-separated fake device counts (one "
                         "subprocess each)")
@@ -183,7 +185,7 @@ def main(argv: list[str] | None = None) -> int:
             # family (dp/zero*) lowers everything
             modes = ["dp", "zero", "zero2", "zero3"]
             if args.model not in ("cnn", "mlp"):
-                modes += ["fsdp", "pp"]
+                modes += ["fsdp", "pp", "pp_zb"]
             cases = [(args.model, m) for m in modes]
         else:
             cases = [(args.model, args.mode)]

@@ -43,6 +43,10 @@ from distributeddataparallel_tpu.observability.events import (  # noqa: E402
 from distributeddataparallel_tpu.observability.goodput import (  # noqa: E402
     goodput_from_timeline,
 )
+from distributeddataparallel_tpu.observability.pipeline import (  # noqa: E402
+    PHASE_COLUMNS,
+    measured_bubble_fraction,
+)
 from distributeddataparallel_tpu.observability.straggler import (  # noqa: E402
     straggler_report,
 )
@@ -75,6 +79,7 @@ def analyze(records: list[dict]) -> dict:
         "memory": {},
         "exec_memory": [],
         "straggler": None,
+        "pipeline": measured_bubble_fraction(records),
         "restarts": [],
         "alerts": [],
         "lint": [],
@@ -328,6 +333,43 @@ def render_markdown(a: dict, events_dir: str) -> str:
             ]
             for label, count in s["skew_histogram"].items():
                 lines.append(f"| {label} | {count} |")
+    lines.append("")
+
+    # -- Pipeline -----------------------------------------------------
+    lines += ["## Pipeline", ""]
+    pp = a["pipeline"]
+    if pp is None:
+        lines.append("No `pp_phase` events — not a pipeline-parallel run "
+                     "(train with `--pp N --pp-schedule 1f1b|zb` to "
+                     "record the schedule's phase counters).")
+    else:
+        meas = pp.get("measured_bubble_fraction")
+        ana = pp.get("analytic_bubble_fraction")
+        drift = (
+            None if meas is None or ana is None else round(meas - ana, 4)
+        )
+        lines += [
+            f"Schedule **{pp.get('schedule')}** on {pp.get('n_stages')} "
+            f"stage(s), {pp.get('microbatches')} microbatch(es), "
+            f"virtual {pp.get('virtual')}: measured bubble "
+            f"{_pct(meas)} vs analytic {_pct(ana)}"
+            + ("" if drift is None else f" (drift {drift:+.4f})")
+            + ".",
+            "",
+            "| stage | " + " | ".join(PHASE_COLUMNS)
+            + " | useful slots | bubble |",
+            "|---:|" + "---:|" * (len(PHASE_COLUMNS) + 2),
+        ]
+        for st in pp.get("per_stage", []):
+            cols = " | ".join(str(st.get(c, 0)) for c in PHASE_COLUMNS)
+            lines.append(
+                f"| {st.get('stage')} | {cols} | {st.get('useful_slots')}"
+                f" | {_pct(st.get('bubble_fraction'))} |"
+            )
+        if meas is not None and ana is not None and abs(drift) > 1e-9:
+            lines += ["", "Measured and analytic bubbles DISAGREE — the "
+                          "compiled schedule did not execute the tick "
+                          "table the factory accounted for."]
     lines.append("")
 
     # -- Restarts -----------------------------------------------------

@@ -47,9 +47,12 @@ from distributeddataparallel_tpu.observability.events import (  # noqa: E402
 
 REGRESS_EXIT = 3
 
-#: metric-name patterns that mean "lower is better" in bench headlines
+#: metric-name patterns that mean "lower is better" in bench headlines;
+#: *_frac/_fraction are idle/waste shares (bubble, overhead, skew) — an
+#: improvement shrinks them, so they must not gate backwards
 _LOWER_BETTER = re.compile(
-    r"(bubble|step_s|_s$|bytes|overhead|_us$|_ms$|restart|latency|skew)"
+    r"(bubble|step_s|_s$|bytes|overhead|_us$|_ms$|restart|latency|skew"
+    r"|_frac$|_fraction$)"
 )
 
 #: throughput names that END in a rate suffix (tok_s, img_s, ..._per_s)

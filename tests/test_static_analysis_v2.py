@@ -28,6 +28,7 @@ from distributeddataparallel_tpu.analysis.schedule_lint import (
     gpipe_schedule_ir,
     lint_schedule,
     one_f_one_b_schedule_ir,
+    zb_schedule_ir,
 )
 from distributeddataparallel_tpu.observability import baseline as bl
 
@@ -221,6 +222,75 @@ def test_1f1b_table_matches_factory_accounting():
         assert lint_schedule(ir, bubble=acct) == []
 
 
+def test_zb_table_matches_factory_accounting():
+    from distributeddataparallel_tpu.parallel.pipeline_parallel import (
+        pp_bubble_fraction,
+    )
+
+    # same cross-check for the zero-bubble table: the IR derives its
+    # phase windows from its own unit extents, the factory from
+    # _zb_segments — independent arithmetic that must agree exactly
+    for n, m, v in [(2, 2, 1), (2, 4, 1), (4, 8, 1), (4, 16, 1),
+                    (2, 4, 2), (4, 8, 2), (8, 32, 1), (3, 7, 1)]:
+        ir = zb_schedule_ir(n, m, v)
+        acct = pp_bubble_fraction(n, m, v, schedule="zb")
+        assert abs(ir.bubble_fraction() - acct["bubble_fraction"]) < 5e-4, (
+            (n, m, v)
+        )
+        assert lint_schedule(ir, bubble=acct) == [], (n, m, v)
+        # zb keeps W work on the table: every (stage, chunk, microbatch)
+        # triple contributes exactly one F, one B, and one W unit
+        phases = [u.phase for u in ir.units]
+        assert phases.count("F") == phases.count("B") == \
+            phases.count("W") == n * m * v
+
+
+def test_sl301_zb_w_before_b_fires():
+    import dataclasses
+
+    ir = zb_schedule_ir(4, 8)
+    assert lint_schedule(ir) == []
+    units = list(ir.units)
+    # drag one W unit to before its B: weight grads need the incoming
+    # cotangent, so a W ahead of its B is an impossible schedule
+    for i, u in enumerate(units):
+        if u.phase == "W" and u.tick > 0:
+            units[i] = dataclasses.replace(u, tick=0)
+            break
+    broken = dataclasses.replace(ir, units=tuple(units))
+    assert "SL301" in {f.rule for f in lint_schedule(broken)}
+
+
+def test_sl302_zb_dropped_and_extra_hop_fire():
+    ir = zb_schedule_ir(4, 8)
+    manifest = {"grad_reduce": {ir.hop_axis: {"ppermute": (1, None)}}}
+    assert ir.hops_total is not None
+    ok = lint_schedule(ir, manifest=manifest, traced_hops=ir.hops_total)
+    assert ok == [], [str(f) for f in ok]
+    # dropped boundary hop (a ppermute optimized away / miscounted)
+    assert "SL302" in {
+        f.rule for f in lint_schedule(
+            ir, manifest=manifest, traced_hops=ir.hops_total - 1
+        )
+    }
+    # extra hop (double-send)
+    assert "SL302" in {
+        f.rule for f in lint_schedule(
+            ir, manifest=manifest, traced_hops=ir.hops_total + 1
+        )
+    }
+
+
+def test_sl304_zb_bubble_drift_fires():
+    ir = zb_schedule_ir(4, 16)
+    assert lint_schedule(ir, bubble=ir.bubble_fraction()) == []
+    # seeded mutant: factory accounting that disagrees with the table
+    assert "SL304" in {
+        f.rule
+        for f in lint_schedule(ir, bubble=ir.bubble_fraction() + 0.05)
+    }
+
+
 def test_sl301_missing_unit_fires():
     import dataclasses
 
@@ -288,7 +358,7 @@ def test_pp_factory_attaches_schedule_ir(devices):
         num_layers=4, num_heads=2, d_model=32, d_ff=64,
         max_seq_len=32, scan_layers=True,
     )
-    for schedule in ("gpipe", "1f1b"):
+    for schedule in ("gpipe", "1f1b", "zb"):
         step = make_pp_train_step(
             cfg, mesh=mesh2, microbatches=4, schedule=schedule,
         )

@@ -306,6 +306,43 @@ def test_perf_gate_bench_headline_mode(tmp_path):
                            "--baseline", "bench"]) == 0
 
 
+def test_bench_direction_suffix_inference():
+    # *_frac / *_fraction are waste shares -> lower is better; the zb
+    # bubble headline must never gate backwards.
+    assert perf_gate._bench_direction("zb_bubble_frac") == "lower"
+    assert perf_gate._bench_direction("measured_bubble_fraction") == "lower"
+    assert perf_gate._bench_direction("zb_step_s") == "lower"
+    assert perf_gate._bench_direction("pipeline_1f1b_bubble") == "lower"
+    # ...while rate suffixes stay higher-better (the PR 9 fix shape)
+    assert perf_gate._bench_direction("serve_tok_s") == "higher"
+    assert perf_gate._bench_direction("host_gather_img_s") == "higher"
+    assert perf_gate._bench_direction("tokens_per_s") == "higher"
+    assert perf_gate._bench_direction("gpt2_mfu") == "higher"
+
+
+def test_perf_gate_zb_bubble_gates_lower_better(tmp_path):
+    store = str(tmp_path / "runs")
+    base = tmp_path / "BENCH_a.json"
+    base.write_text(json.dumps({"parsed": {"headline": {
+        "zb_bubble_frac": 0.16, "zb_step_s": 0.10,
+    }}}))
+    assert perf_gate.main([str(base), "--store", store,
+                           "--baseline", "zb", "--update-baseline"]) == 0
+    # bubble grew -> regression; shrank -> pass
+    worse = tmp_path / "BENCH_b.json"
+    worse.write_text(json.dumps({"parsed": {"headline": {
+        "zb_bubble_frac": 0.20, "zb_step_s": 0.10,
+    }}}))
+    assert perf_gate.main([str(worse), "--store", store,
+                           "--baseline", "zb"]) == perf_gate.REGRESS_EXIT
+    better = tmp_path / "BENCH_c.json"
+    better.write_text(json.dumps({"parsed": {"headline": {
+        "zb_bubble_frac": 0.12, "zb_step_s": 0.09,
+    }}}))
+    assert perf_gate.main([str(better), "--store", store,
+                           "--baseline", "zb"]) == 0
+
+
 def test_compare_to_baseline_direction_arithmetic():
     summary = _summary(step_s_p50=0.104, live_hwm_bytes=1_200_000)
     res = compare_to_baseline(summary, _summary())
