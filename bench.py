@@ -1503,6 +1503,111 @@ def bench_warm_start() -> dict:
     return out
 
 
+def bench_elastic_resize() -> dict:
+    """Elastic gang resize vs supervised cold restart, head to head: the
+    SAME 8-fake-device CPU gang loses one worker mid-run (chaos), once
+    with ``--elastic`` (in-process resize to 7, no checkpoint read) and
+    once under the fixed-size supervisor (whole-gang respawn, AOT warm
+    start — the strongest restart baseline this repo has).  Downtime is
+    measured the same way on both sides, from the timeline each run
+    leaves behind: first post-recovery step-span ts minus the
+    chaos_inject ts.  Headlines: ``resize_downtime_s`` (lower-better)
+    and ``restart_reclaimed_s`` = cold restart minus resize downtime
+    (ends in _s but HIGHER is better — seconds given back; perf_gate's
+    _HIGHER_BETTER knows the suffix)."""
+    import os
+    import subprocess
+    import sys
+    import tempfile
+
+    from distributeddataparallel_tpu.observability.events import (
+        load_timeline,
+    )
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    root = tempfile.mkdtemp(prefix="ddp_bench_elastic_")
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS="--xla_force_host_platform_device_count=8",
+    )
+    env.pop("_DDP_SUPERVISED", None)
+    env.pop("DDP_ELASTIC_WORLD", None)
+    base = [
+        sys.executable, os.path.join(here, "dpp.py"),
+        "--model", "mlp", "--fake-devices", "8", "--batch-size", "4",
+        "--epochs", "1", "--steps-per-epoch", "12",
+    ]
+    runs = {
+        # in-process resize: kill rank 5 at step 4, keep training at 7
+        "resize": ["--elastic", "--chaos", "worker-kill@4:5"],
+        # fixed-size baseline: same loss at the same step, whole-gang
+        # respawn through the supervisor (checkpoint-dir is required by
+        # --max-restarts and hosts the chaos marker files that keep the
+        # preempt from re-firing in the respawn)
+        "restart": ["--chaos", "preempt@4", "--max-restarts", "1"],
+    }
+    out = {}
+    records = {}
+    for mode, extra in runs.items():
+        ev = os.path.join(root, f"ev_{mode}")
+        cc = os.path.join(root, f"cc_{mode}")
+        cmd = base + extra + ["--events-dir", ev, "--compile-cache", cc]
+        if mode == "restart":
+            cmd += ["--checkpoint-dir", os.path.join(root, "ckpt")]
+        try:
+            proc = subprocess.run(
+                cmd, env=env, cwd=here, timeout=420,
+                capture_output=True, text=True,
+            )
+        except subprocess.TimeoutExpired:
+            out[mode] = {"error": "timed out"}
+            continue
+        recs = load_timeline(ev) if os.path.isdir(ev) else []
+        records[mode] = recs
+        out[mode] = {
+            "exit": proc.returncode,
+            "n_records": len(recs),
+            "kinds": sorted({r.get("kind") for r in recs
+                             if r.get("kind") in (
+                                 "gang_resize", "restart_attempt",
+                                 "resize_downtime")}),
+        }
+        if proc.returncode != 0:
+            out[mode]["error"] = (proc.stderr or "")[-400:]
+
+    def downtime(recs, disrupt_prefix, recover_kind):
+        """First step-span ts at or after the recovery marker, minus the
+        chaos_inject ts — the wall seconds training stood still."""
+        dis = next((r["ts"] for r in recs
+                    if r.get("kind") == "chaos_inject"
+                    and str(r.get("entry", "")).startswith(disrupt_prefix)),
+                   None)
+        mark = next((r["ts"] for r in recs
+                     if r.get("kind") == recover_kind), None)
+        if dis is None or mark is None:
+            return None
+        rec = min((r["ts"] for r in recs
+                   if r.get("kind") == "span" and r.get("name") == "step"
+                   and r["ts"] >= mark), default=None)
+        return None if rec is None else round(rec - dis, 3)
+
+    rd = downtime(records.get("resize", []), "worker-kill", "gang_resize")
+    cd = downtime(records.get("restart", []), "preempt", "restart_attempt")
+    out["resize_downtime_s"] = rd
+    out["cold_restart_s"] = cd
+    if rd is not None and cd is not None:
+        out["restart_reclaimed_s"] = round(cd - rd, 3)
+        out["resize_beats_restart"] = rd < cd
+    # the done bar of the elastic subsystem: the resize path must never
+    # have fallen back to supervision, and vice versa
+    out["resize_clean"] = (
+        "restart_attempt" not in out.get("resize", {}).get("kinds", ())
+        and "gang_resize" in out.get("resize", {}).get("kinds", ())
+    )
+    return out
+
+
 def _observability_child(out_path, events_dir, env):
     """Telemetry-overhead measurement in a fresh 8-device CPU-mesh
     interpreter (same isolation rationale as _warm_start_child: the
@@ -2144,6 +2249,7 @@ def main() -> None:
     pp_bubble = pp_zb.get("analytic", {})  # roofline column rides along
     input_pipe = _run(bench_input_pipeline, "input_pipeline")
     warm = _run(bench_warm_start, "warm_start")
+    elastic = _run(bench_elastic_resize, "elastic_resize")
     obs = _run(bench_observability, "observability")
     zshard = _run(bench_zero_sharding, "zero_sharding")
     serving = _run(bench_serving, "serving")
@@ -2186,6 +2292,7 @@ def main() -> None:
             "pipeline_zb": pp_zb,
             "input_pipeline": input_pipe,
             "warm_start": warm,
+            "elastic_resize": elastic,
             "observability": obs,
             "zero_sharding": zshard,
             "serving": serving,
@@ -2274,6 +2381,12 @@ def main() -> None:
                 "aot": warm.get("aot", {}).get("acquire_s"),
                 "aot_x": warm.get("aot_speedup"),
             },
+            # flat on purpose (perf_gate): resize_downtime_s is
+            # lower-better via _s$; restart_reclaimed_s is the seconds
+            # the elastic path gave back vs a cold restart — HIGHER is
+            # better (_HIGHER_BETTER's reclaimed_s$ override)
+            "resize_downtime_s": elastic.get("resize_downtime_s"),
+            "restart_reclaimed_s": elastic.get("restart_reclaimed_s"),
             "obs": {
                 "ovh": obs.get("overhead_frac_micro"),
                 "sync0": obs.get("zero_extra_syncs"),

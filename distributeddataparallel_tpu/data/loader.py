@@ -116,6 +116,7 @@ class DataLoader:
         with_mask: bool = False,
         augment=None,
         starvation_window: int = 50,
+        index_shards=None,
     ):
         """``place_fn(host_batch) -> device_batch`` overrides the default
         data-axis ``shard_batch`` placement (e.g. ``shard_lm_batch`` for
@@ -197,18 +198,42 @@ class DataLoader:
         # the human warning.
         self.events = None
 
-        self._samplers = [
-            DistributedSampler(
-                len(dataset),
-                num_replicas=self.num_replicas,
-                rank=self.host_id * self.local_replicas + r,
-                shuffle=shuffle,
-                seed=seed,
-                drop_last=False,
-            )
-            for r in range(self.local_replicas)
-        ]
-        per_replica_samples = self._samplers[0].num_samples
+        # Explicit per-replica index shards override the samplers — the
+        # elastic-resize path feeds the remainder of an interrupted epoch
+        # through here (data.sharded.resize_index_plan), already strided
+        # for the NEW replica count.  set_epoch is then a no-op: the
+        # shards are one epoch's tail, not a reshuffleable schedule.
+        self._index_shards = None
+        if index_shards is not None:
+            if with_mask:
+                raise ValueError(
+                    "index_shards + with_mask is unsupported (pad-slot "
+                    "masks are a function of sampler geometry)"
+                )
+            shards_in = [np.asarray(s, np.int64) for s in index_shards]
+            if len(shards_in) != self.local_replicas:
+                raise ValueError(
+                    f"index_shards has {len(shards_in)} rows for "
+                    f"{self.local_replicas} local replicas"
+                )
+            if len({len(s) for s in shards_in}) > 1:
+                raise ValueError("index_shards rows must be equal length")
+            self._index_shards = shards_in
+            self._samplers = []
+            per_replica_samples = len(shards_in[0])
+        else:
+            self._samplers = [
+                DistributedSampler(
+                    len(dataset),
+                    num_replicas=self.num_replicas,
+                    rank=self.host_id * self.local_replicas + r,
+                    shuffle=shuffle,
+                    seed=seed,
+                    drop_last=False,
+                )
+                for r in range(self.local_replicas)
+            ]
+            per_replica_samples = self._samplers[0].num_samples
         if drop_last:
             self.steps_per_epoch = per_replica_samples // per_replica_batch
         else:
@@ -284,14 +309,19 @@ class DataLoader:
         }
 
     def _host_batches(self) -> Iterator[Pytree]:
-        shards = [s.local_indices() for s in self._samplers]
+        shards = (
+            self._index_shards
+            if self._index_shards is not None
+            else [s.local_indices() for s in self._samplers]
+        )
         B = self.per_replica_batch
         for step in range(self.steps_per_epoch):
             rows, masks = [], []
-            for smp, shard in zip(self._samplers, shards):
+            for ri, shard in enumerate(shards):
                 idx = shard[step * B : (step + 1) * B]
                 rows.append(idx)
                 if self.with_mask:
+                    smp = self._samplers[ri]
                     p = np.arange(step * B, step * B + len(idx))
                     masks.append(
                         smp.rank + p * smp.num_replicas < smp.dataset_len

@@ -273,3 +273,57 @@ class ShardedImageDataset:
     def __getitem__(self, idx):
         b = self.gather(np.asarray([idx]))
         return b["image"][0], b["label"][0]
+
+
+def resize_index_plan(
+    dataset_len: int,
+    *,
+    per_replica_batch: int,
+    old_world: int,
+    new_world: int,
+    consumed_steps: int,
+    seed: int = 0,
+    epoch: int = 0,
+    membership_epoch: int = 0,
+    shuffle: bool = True,
+) -> np.ndarray:
+    """Deterministic per-replica index shards for the rest of an epoch
+    after a mid-epoch gang resize — every sample still seen exactly once
+    per pass.
+
+    Reconstructs the epoch's global permutation exactly as the
+    ``DistributedSampler`` gang at ``old_world`` replicas built it
+    (``default_rng(seed + epoch)``), drops the prefix the old gang
+    already trained on — after ``consumed_steps`` batches at batch ``B``
+    the strided shards have consumed precisely positions
+    ``[0, consumed_steps * B * old_world)`` of the permutation — and
+    re-shards the remainder across ``new_world`` replicas under a fresh
+    permutation keyed on the MEMBERSHIP epoch, so a second resize in the
+    same data epoch reshuffles again instead of replaying the same order.
+
+    Returns an int64 array of shape ``(new_world, steps * B)`` where
+    ``steps = remaining // (B * new_world)`` (drop-last, matching the
+    training loader's static-shape contract); row r is replica r's index
+    list, strided exactly like ``DistributedSampler`` would
+    (``remaining_perm[r::new_world]`` truncated to whole batches).
+    """
+    if per_replica_batch < 1 or old_world < 1 or new_world < 1:
+        raise ValueError("per_replica_batch / old_world / new_world "
+                         "must be >= 1")
+    B = per_replica_batch
+    if shuffle:
+        perm = np.random.default_rng(seed + epoch).permutation(dataset_len)
+    else:
+        perm = np.arange(dataset_len)
+    consumed = min(consumed_steps * B * old_world, dataset_len)
+    remaining = perm[consumed:]
+    # Epoch-keyed reseed: the RESHARD order depends on the membership
+    # epoch (not just the data epoch), deterministically across every
+    # survivor and any replay of the run.
+    rng = np.random.default_rng((seed, 0xE1A57, epoch, membership_epoch))
+    remaining = remaining[rng.permutation(len(remaining))]
+    steps = len(remaining) // (B * new_world)
+    shards = np.empty((new_world, steps * B), dtype=np.int64)
+    for r in range(new_world):
+        shards[r] = remaining[r :: new_world][: steps * B]
+    return shards

@@ -24,8 +24,12 @@ from __future__ import annotations
 import time
 
 #: every non-productive bucket the ledger recognises; "productive" is
-#: always the remainder, so it can never double-count
-BUCKETS = ("compile", "checkpoint", "eval", "restart", "stall")
+#: always the remainder, so it can never double-count.  ``resize`` is
+#: deliberately distinct from ``restart``: a restart pays gang respawn +
+#: checkpoint restore + warm start, a resize pays only the in-memory
+#: reshard + mesh rebuild — the difference between the two buckets IS
+#: the elasticity win ddp_report's "Elasticity" section reports.
+BUCKETS = ("compile", "checkpoint", "eval", "restart", "resize", "stall")
 
 
 class GoodputLedger:
@@ -105,6 +109,10 @@ def _incarnation_summary(recs: list[dict]) -> dict:
             buckets["eval"] += r.get("dur_s", 0.0)
         elif r.get("kind") == "warm_start":
             buckets["compile"] += r.get("first_step_s") or 0.0
+        elif r.get("kind") == "resize_downtime":
+            # Killed incarnations never emit their own goodput event, so
+            # in-place resizes they performed are rebuilt here too.
+            buckets["resize"] += r.get("seconds") or 0.0
     out["total_s"] = round(max(end_ts - start_ts, 0.0), 3)
     out["buckets"] = {k: round(v, 3) for k, v in buckets.items()}
     return out

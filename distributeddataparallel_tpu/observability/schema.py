@@ -45,6 +45,10 @@ EVENT_KINDS: dict[str, tuple[str, ...]] = {
     "chaos_inject": ("entry", "step"),
     "restart_attempt": ("attempt",),
     "restart_exhausted": ("attempt",),
+    # Elastic gang runtime (runtime.elastic_gang / rendezvous):
+    "membership_epoch": ("epoch", "roster", "size"),
+    "gang_resize": ("epoch", "old_size", "new_size"),
+    "resize_downtime": ("epoch", "seconds"),
     "profile_start": ("reason",),
     "profile_stop": (),
     "loader_starved": ("window",),

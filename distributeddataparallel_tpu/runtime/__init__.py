@@ -10,3 +10,13 @@ from distributeddataparallel_tpu.runtime.distributed import (  # noqa: F401
     barrier,
 )
 from distributeddataparallel_tpu.runtime.launcher import spawn  # noqa: F401
+from distributeddataparallel_tpu.runtime.rendezvous import (  # noqa: F401
+    RendezvousStore,
+    TCPRendezvousClient,
+    TCPRendezvousServer,
+)
+from distributeddataparallel_tpu.runtime.elastic_gang import (  # noqa: F401
+    ElasticGangCoordinator,
+    ResizeDecision,
+    reshard_live_state,
+)

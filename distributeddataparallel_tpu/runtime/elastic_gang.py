@@ -1,0 +1,343 @@
+"""Elastic gang runtime: resize the mesh instead of restarting the gang.
+
+The fixed-size supervision story (launcher ``max_restarts``) treats any
+worker loss as gang death: kill everyone, respawn, reload a checkpoint,
+recompile or AOT-load, replay from the last durable epoch.  This module
+is the other half of ROADMAP item 4 — keep the survivors' live state and
+*resize*:
+
+1. membership drift (death, join) is observed in the rendezvous store
+   (``runtime.rendezvous``) — heartbeats + tombstones;
+2. survivors run one membership-epoch transition: barrier, agree on the
+   epoch-(k+1) roster, the deterministic proposer writes it atomically;
+3. the mesh is rebuilt over the surviving devices and the live train
+   state is resharded IN MEMORY — a host round-trip of the live arrays
+   through ``training.elastic``'s positional flat-reshard math, no orbax
+   restore anywhere on the path;
+4. data re-shards deterministically (``data.sharded.resize_index_plan``)
+   and warm start lands on a pre-compiled N±1 executable
+   (``training.warm_start.BackgroundPrecompiler``).
+
+The CPU-simulation topology note: this jaxlib's CPU backend refuses
+cross-process collectives, so (as everywhere in this repo) a "gang" on
+CPU is one process holding N fake devices — gang members are fake-device
+ranks, and the resize is an in-process mesh rebuild.  The rendezvous
+protocol itself is pure files/TCP and is exercised with real processes
+and threads in the tests; on real multi-host TPU the same coordinator
+runs one-member-per-process.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Sequence
+
+from distributeddataparallel_tpu.runtime.rendezvous import RendezvousStore
+
+
+@dataclasses.dataclass(frozen=True)
+class ResizeDecision:
+    """One agreed membership-epoch transition, as seen by a survivor."""
+
+    epoch: int
+    roster: tuple[str, ...]
+    prev_roster: tuple[str, ...]
+    left: tuple[str, ...]
+    joined: tuple[str, ...]
+
+    @property
+    def old_size(self) -> int:
+        return len(self.prev_roster)
+
+    @property
+    def new_size(self) -> int:
+        return len(self.roster)
+
+
+class ElasticGangCoordinator:
+    """Membership-epoch coordinator for one process's gang members.
+
+    ``world`` is the list of member names THIS process hosts: one name
+    per process on real multi-host topologies, every fake-device rank on
+    the single-process CPU-simulation gangs.  ``poll()`` is the step-
+    boundary hook — cheap (a few ``os.stat`` calls) when membership is
+    stable, and when it has drifted it runs the epoch transition and
+    returns the :class:`ResizeDecision` every survivor agrees on.
+    """
+
+    def __init__(
+        self,
+        store: RendezvousStore | str,
+        *,
+        world: Sequence[str | int],
+        min_size: int = 1,
+        events=None,
+        transition_timeout_s: float = 30.0,
+    ):
+        if isinstance(store, (str, bytes)):
+            store = RendezvousStore(store)
+        self.store = store
+        self.world = [str(w) for w in world]
+        if not self.world:
+            raise ValueError("world must name at least one hosted member")
+        self.min_size = int(min_size)
+        self.events = events
+        self.transition_timeout_s = float(transition_timeout_s)
+        self.epoch = -1
+        self.roster: tuple[str, ...] = ()
+
+    # -- lifecycle ------------------------------------------------------
+
+    def start(self) -> dict:
+        """Join all hosted members and establish the membership epoch.
+
+        No epoch in the store → propose epoch 0 over the live set.  An
+        existing epoch whose roster no longer matches the live set is a
+        resized respawn (the supervisor tombstoned the dead gang's whole
+        roster before relaunching at the surviving size — see
+        ``launcher.spawn(elastic_store=...)``): propose the next epoch
+        over the members that actually came back, so epochs stay
+        monotonic across the respawn.
+        """
+        for m in self.world:
+            self.store.join(m)
+        rec = self.store.epoch()
+        if rec["epoch"] < 0:
+            rec = self.store.propose(self.store.alive(), epoch=0)
+            self._emit_epoch(rec)
+        elif set(self.store.alive()) != set(rec["roster"]):
+            rec = self.store.propose(self.store.alive())
+            self._emit_epoch(rec)
+        self.epoch = rec["epoch"]
+        self.roster = tuple(rec["roster"])
+        return rec
+
+    def stop(self) -> None:
+        for m in self._hosted_live():
+            self.store.leave(m)
+
+    def kill(self, member: str | int) -> None:
+        """Mark one member dead (the chaos ``worker-kill`` hook): the
+        NEXT ``poll()`` on any survivor sees the tombstone and runs the
+        resize protocol.  A bare integer is a rank index into this
+        process's hosted world (the chaos grammar's ``:RANK`` argument);
+        a string names the member directly."""
+        member = str(member)
+        if member not in self.world and member.isdigit() \
+                and int(member) < len(self.world):
+            member = self.world[int(member)]
+        self.store.mark_dead(member)
+
+    def _hosted_live(self) -> list[str]:
+        dead = set(self.store.dead())
+        return [m for m in self.world if m not in dead]
+
+    # -- the step-boundary hook -----------------------------------------
+
+    def poll(self) -> ResizeDecision | None:
+        """Heartbeat, then detect and agree on membership drift.
+
+        Returns None while membership matches the current epoch's roster.
+        On drift: every hosted surviving member acks the next epoch, the
+        transition runs (this process proposes iff it hosts the smallest
+        survivor), and the agreed decision is returned — the caller then
+        rebuilds mesh/state/data for ``decision.new_size``.
+        """
+        hosted = self._hosted_live()
+        for m in hosted:
+            self.store.heartbeat(m)
+        if not hosted:
+            raise RuntimeError(
+                "every member hosted by this process is dead — nothing "
+                "left to resize around (supervised restart territory)"
+            )
+        alive = self.store.alive()
+        if set(alive) == set(self.roster):
+            return None
+        if len(alive) < self.min_size:
+            raise RuntimeError(
+                f"surviving roster {alive} is below --min-procs "
+                f"{self.min_size}; falling back to gang restart"
+            )
+        nxt = self.store.epoch()["epoch"] + 1
+        for m in hosted:
+            self.store.ack(nxt, m)
+        rec = self.store.transition(
+            hosted[0], timeout_s=self.transition_timeout_s
+        )
+        prev = self.roster or tuple(rec.get("prev_roster", ()))
+        decision = ResizeDecision(
+            epoch=rec["epoch"],
+            roster=tuple(rec["roster"]),
+            prev_roster=tuple(prev),
+            left=tuple(m for m in prev if m not in set(rec["roster"])),
+            joined=tuple(m for m in rec["roster"] if m not in set(prev)),
+        )
+        self.epoch = decision.epoch
+        self.roster = decision.roster
+        self._emit_epoch(rec)
+        if self.events is not None:
+            self.events.emit(
+                "gang_resize",
+                epoch=decision.epoch,
+                old_size=decision.old_size,
+                new_size=decision.new_size,
+                left=list(decision.left),
+                joined=list(decision.joined),
+            )
+        return decision
+
+    def _emit_epoch(self, rec: dict) -> None:
+        if self.events is not None:
+            self.events.emit(
+                "membership_epoch",
+                epoch=rec["epoch"],
+                roster=list(rec["roster"]),
+                size=len(rec["roster"]),
+            )
+
+
+# -- in-memory (checkpoint-free) state reshard ---------------------------
+
+
+def _flat_geometry(state, old_mesh, data_axis: str):
+    """(n_old, true, padded_old) for a ZeRO-1 flat layout, or None for a
+    layout with no data-axis flats (plain replicated DP)."""
+    import jax
+
+    from distributeddataparallel_tpu.parallel.zero import flat_size
+
+    n_old = old_mesh.shape[data_axis]
+    true = sum(l.size for l in jax.tree.leaves(state.params))
+    padded_old, _ = flat_size(state.params, n_old)
+    return n_old, true, padded_old
+
+
+def reshard_live_state(state, old_mesh, new_mesh, *, zero: int = 0,
+                       data_axis: str = "data"):
+    """Checkpoint-free reshard: live train state at N devices -> the same
+    logical state placed on ``new_mesh`` (M devices), via a host round
+    trip of the live arrays.
+
+    This runs exactly ``training.elastic``'s positional flat-reshard math
+    (``content || tail-padding`` flats truncated to true content and
+    re-padded for the new shard count — ``elastic.repad_flat``), but on
+    device_get'd live arrays instead of an orbax restore, so a shrink
+    never touches the checkpoint directory.  Supported layouts match the
+    ``--elastic`` gate in dpp.py: replicated DP and ZeRO-1 over the data
+    axis only (no model axes, no FSDP, no quantized moments).
+
+    Transient host memory: one full host copy of the state exists between
+    the device_get and the device_put (see MEMFIT.md "Elastic resize").
+    """
+    import jax
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from distributeddataparallel_tpu.training.elastic import repad_flat
+    from distributeddataparallel_tpu.parallel.zero import flat_size
+
+    if zero not in (0, 1):
+        raise ValueError(
+            f"reshard_live_state supports replicated DP and ZeRO-1 "
+            f"(got zero={zero}); ZeRO-2/3 resident shards go through "
+            f"elastic_restore"
+        )
+    true = padded_old = padded_new = None
+    if zero:
+        _, true, padded_old = _flat_geometry(state, old_mesh, data_axis)
+        padded_new, _ = flat_size(state.params, new_mesh.shape[data_axis])
+
+    def move(leaf):
+        arr = np.asarray(jax.device_get(leaf))
+        spec = (
+            leaf.sharding.spec
+            if isinstance(getattr(leaf, "sharding", None), NamedSharding)
+            else P()
+        )
+        if (
+            zero
+            and arr.ndim == 1
+            and arr.shape[0] == padded_old
+            and tuple(spec) and spec[0] == data_axis
+        ):
+            arr = repad_flat(arr, true, padded_new)
+        return jax.device_put(arr, NamedSharding(new_mesh, spec))
+
+    return jax.tree.map(move, state)
+
+
+# -- templates for topology-portable warm start --------------------------
+
+
+def state_template_for(state, old_mesh, new_mesh, *, zero: int = 0,
+                       data_axis: str = "data"):
+    """ShapeDtypeStruct pytree describing ``state`` as it would exist on
+    ``new_mesh`` — what ``reshard_live_state`` would produce, without
+    materializing anything.  Feeds the N±1 background pre-compile
+    (``warm_start.BackgroundPrecompiler``): lowering against these
+    templates compiles the resize-target executable before any resize
+    happens."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    true = padded_old = padded_new = None
+    if zero:
+        from distributeddataparallel_tpu.parallel.zero import flat_size
+
+        _, true, padded_old = _flat_geometry(state, old_mesh, data_axis)
+        padded_new, _ = flat_size(state.params, new_mesh.shape[data_axis])
+
+    def tmpl(leaf):
+        spec = (
+            leaf.sharding.spec
+            if isinstance(getattr(leaf, "sharding", None), NamedSharding)
+            else P()
+        )
+        shape = tuple(leaf.shape)
+        if (
+            zero
+            and len(shape) == 1
+            and shape[0] == padded_old
+            and tuple(spec) and spec[0] == data_axis
+        ):
+            shape = (padded_new,)
+        return jax.ShapeDtypeStruct(
+            shape, leaf.dtype, sharding=NamedSharding(new_mesh, spec)
+        )
+
+    return jax.tree.map(tmpl, state)
+
+
+def batch_template_for(batch, old_mesh, new_mesh, *,
+                       data_axis: str = "data"):
+    """ShapeDtypeStruct pytree for a global batch on ``new_mesh``: the
+    leading (data-sharded) dim scales by the replica ratio, trailing dims
+    and shardings carry over."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    n_old = old_mesh.shape[data_axis]
+    n_new = new_mesh.shape[data_axis]
+
+    def tmpl(leaf):
+        spec = (
+            leaf.sharding.spec
+            if isinstance(getattr(leaf, "sharding", None), NamedSharding)
+            else P(data_axis)
+        )
+        rows = leaf.shape[0] // n_old * n_new
+        return jax.ShapeDtypeStruct(
+            (rows,) + tuple(leaf.shape[1:]), leaf.dtype,
+            sharding=NamedSharding(new_mesh, spec),
+        )
+
+    return jax.tree.map(tmpl, batch)
+
+
+def measure_downtime(t_start: float) -> float:
+    """Seconds since ``t_start`` (perf_counter domain) — the number that
+    lands in the ``resize_downtime`` event and the goodput ``resize``
+    bucket."""
+    return time.perf_counter() - t_start

@@ -118,6 +118,32 @@ def _join_gang(procs) -> list[tuple[int, int]]:
     return failed
 
 
+def _elastic_survivors(elastic_store: str):
+    """Roster state from an elastic rendezvous store: ``(store, epoch,
+    roster, survivors)``, or None when the store has no epoch yet.
+
+    Survivorship is decided by TOMBSTONES only (``mark_dead`` /
+    ``leave``), never by heartbeat freshness: when a supervised gang dies
+    seconds ago, every member's heartbeat file still looks fresh — the
+    tombstone a chaos kill (or a peer's failure detector) wrote is the
+    one signal that distinguishes "this member was removed from the
+    gang" from "the whole process just went down".  Import-light: the
+    rendezvous store is stdlib-only, safe in the supervisor.
+    """
+    from distributeddataparallel_tpu.runtime.rendezvous import (
+        RendezvousStore,
+    )
+
+    store = RendezvousStore(elastic_store)
+    cur = store.epoch()
+    if cur["epoch"] < 0:
+        return None
+    dead = store.dead()
+    roster = list(cur["roster"])
+    survivors = [m for m in roster if m not in dead]
+    return store, cur["epoch"], roster, survivors
+
+
 def spawn(
     fn: Callable[..., Any],
     args: Sequence[Any] = (),
@@ -129,6 +155,8 @@ def spawn(
     restart_backoff_s: float = 1.0,
     events_dir: str | None = None,
     runs_dir: str | None = None,
+    elastic_store: str | None = None,
+    min_procs: int = 1,
 ):
     """Run ``fn(i, *args)`` for i in range(nprocs).
 
@@ -159,6 +187,17 @@ def spawn(
     runs store (``observability.baseline``) — the supervisor writes it
     because only its view spans every incarnation plus the restart gaps
     between them.  Workers inherit the directory via ``DDP_RUNS_DIR``.
+
+    ``elastic_store`` (a ``runtime.rendezvous`` root, with supervision)
+    switches the death path from restart to RESIZE when the gang's
+    membership shrank: if the store's tombstones show the dead gang had
+    already lost members (a chaos worker-kill, a peer failure detector),
+    the supervisor respawns at the surviving size via
+    ``DDP_ELASTIC_WORLD`` — consuming NO restart budget and emitting
+    ``gang_resize``/``resize_downtime`` instead of ``restart_attempt``.
+    A death with an intact roster still takes the normal restart path.
+    ``min_procs`` floors the resize: fewer survivors than that is a
+    failure, not a smaller gang.
     """
     if nprocs < 1:
         raise ValueError(f"nprocs must be >= 1, got {nprocs}")
@@ -183,12 +222,16 @@ def spawn(
                 "supervisor",
             )
         try:
-            for attempt in range(max_restarts + 1):
+            attempt = 0
+            world_override: int | None = None
+            while True:
                 # The worker can surface its incarnation
                 # (FaultCounters.restarts, log lines) without any side
                 # channel back from the supervisor.
                 gang_env = dict(env or {})
                 gang_env["DDP_RESTART_ATTEMPT"] = str(attempt)
+                if world_override is not None:
+                    gang_env["DDP_ELASTIC_WORLD"] = str(world_override)
                 if events_dir:
                     gang_env.setdefault("DDP_EVENTS_DIR", events_dir)
                 if runs_dir:
@@ -197,6 +240,51 @@ def spawn(
                 failed = _join_gang(procs)
                 if not failed:
                     return None
+                t_died = time.perf_counter()
+                info = (
+                    _elastic_survivors(elastic_store)
+                    if elastic_store is not None else None
+                )
+                if info is not None:
+                    store, epoch, roster, survivors = info
+                    if (
+                        set(survivors) != set(roster)
+                        and len(survivors) >= max(min_procs, 1)
+                    ):
+                        # Resize, not restart: the gang lost members
+                        # before it died, so respawn at the surviving
+                        # size.  Tombstone the WHOLE old roster first —
+                        # the process is dead, so every heartbeat in the
+                        # store is a ghost; the respawned coordinator
+                        # re-joins its members (clearing their
+                        # tombstones) and proposes the next epoch over
+                        # exactly the members that actually came back.
+                        world_override = len(survivors)
+                        for m in roster:
+                            store.leave(m)
+                        if sup_events is not None:
+                            sup_events.emit(
+                                "gang_resize",
+                                epoch=epoch + 1,
+                                old_size=len(roster),
+                                new_size=len(survivors),
+                                left=sorted(set(roster) - set(survivors)),
+                            )
+                            sup_events.emit(
+                                "resize_downtime",
+                                epoch=epoch + 1,
+                                seconds=round(
+                                    time.perf_counter() - t_died, 3
+                                ),
+                            )
+                        get_logger().warning(
+                            "[supervisor] gang died with a shrunk roster "
+                            "(%d -> %d members) — elastic resize-respawn, "
+                            "restart budget untouched (%d/%d used)",
+                            len(roster), len(survivors),
+                            attempt, max_restarts,
+                        )
+                        continue
                 if attempt >= max_restarts:
                     if sup_events is not None:
                         sup_events.emit(
@@ -221,7 +309,7 @@ def spawn(
                     restart_backoff_s * (attempt + 1),
                 )
                 time.sleep(restart_backoff_s * (attempt + 1))
-            return None  # unreachable
+                attempt += 1
         finally:
             if sup_events is not None:
                 sup_events.close()

@@ -1,0 +1,381 @@
+"""File/TCP rendezvous with membership epochs for the elastic gang runtime.
+
+The store is the gang's source of truth about *who is in the gang*: every
+member maintains a heartbeat file, deliberate departures (clean leave,
+chaos kill, supervisor-observed death) leave a tombstone, and the agreed
+roster lives in a versioned ``epoch.json`` — membership epoch k is the
+k-th roster the gang has ever agreed on.  A resize is exactly one epoch
+transition: survivors observe the drift, barrier on the new epoch number,
+one deterministic proposer (the lexicographically-smallest survivor)
+writes the epoch-(k+1) roster atomically, and everyone else waits for the
+file to advance.  There is no leader state to lose — any survivor can
+propose, and ``os.replace`` makes the last write win atomically.
+
+Two transports share the protocol:
+
+- ``RendezvousStore`` — a directory on a filesystem every member can see
+  (the single-host / NFS case).  All mutations are tmp-write + atomic
+  rename; reads tolerate concurrent writers.
+- ``TCPRendezvousServer`` / ``TCPRendezvousClient`` — a thin JSON-lines
+  socket front-end over one server-side ``RendezvousStore``, for gangs
+  whose members don't share a filesystem.  One request per line, one
+  JSON reply per line; the op names mirror the store methods.
+
+Module-import rule: stdlib only.  The launcher supervisor and the chaos
+injector import this in fresh interpreters; jax must not load here.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import socketserver
+import threading
+import time
+import uuid
+
+# A heartbeat older than this many seconds marks its member suspect; the
+# coordinator treats suspects like tombstoned members when computing the
+# next roster.  Generous by default — CPU-simulation steps are slow.
+DEFAULT_HEARTBEAT_TIMEOUT_S = 60.0
+
+
+def _atomic_write(path: str, payload: str) -> None:
+    tmp = f"{path}.tmp.{uuid.uuid4().hex[:8]}"
+    with open(tmp, "w") as fh:
+        fh.write(payload)
+    os.replace(tmp, path)
+
+
+class RendezvousStore:
+    """Directory-backed membership store with atomic epoch transitions.
+
+    Layout under ``root``::
+
+        members/<name>.json   heartbeat file; mtime = last beat
+        dead/<name>           tombstone (clean leave or observed death)
+        epoch.json            {"epoch": k, "roster": [...], "ts": ...}
+        epochs.jsonl          append-only transition log (one line/epoch)
+        acks/<epoch>/<name>   barrier acknowledgements for epoch k
+    """
+
+    def __init__(
+        self,
+        root: str,
+        *,
+        heartbeat_timeout_s: float = DEFAULT_HEARTBEAT_TIMEOUT_S,
+    ):
+        self.root = str(root)
+        self.heartbeat_timeout_s = float(heartbeat_timeout_s)
+        for sub in ("members", "dead", "acks"):
+            os.makedirs(os.path.join(self.root, sub), exist_ok=True)
+
+    # -- membership -----------------------------------------------------
+
+    def _member_path(self, name: str) -> str:
+        name = str(name)
+        if not name or "/" in name or name.startswith("."):
+            raise ValueError(f"bad member name {name!r}")
+        return os.path.join(self.root, "members", f"{name}.json")
+
+    def join(self, name: str, **info) -> None:
+        """Register ``name`` as a live member (clears any tombstone, so a
+        respawned worker can rejoin under its old name)."""
+        tomb = os.path.join(self.root, "dead", str(name))
+        if os.path.exists(tomb):
+            os.remove(tomb)
+        _atomic_write(
+            self._member_path(name),
+            json.dumps({"name": str(name), "ts": time.time(), **info}),
+        )
+
+    def heartbeat(self, name: str) -> None:
+        path = self._member_path(name)
+        if os.path.exists(path):
+            os.utime(path)
+        else:  # first beat doubles as a join
+            self.join(name)
+
+    def leave(self, name: str) -> None:
+        """Clean departure: tombstone + heartbeat removal."""
+        self.mark_dead(name)
+        try:
+            os.remove(self._member_path(name))
+        except FileNotFoundError:
+            pass
+
+    def mark_dead(self, name: str) -> None:
+        """Tombstone ``name`` without touching its heartbeat file — the
+        form used by the chaos injector and by a supervisor that watched
+        the process die (the member itself never gets to call leave)."""
+        _atomic_write(os.path.join(self.root, "dead", str(name)), "")
+
+    def dead(self) -> list[str]:
+        return sorted(os.listdir(os.path.join(self.root, "dead")))
+
+    def alive(self) -> list[str]:
+        """Members with a fresh heartbeat and no tombstone, sorted — this
+        IS the deterministic next-roster every survivor computes."""
+        now = time.time()
+        dead = set(self.dead())
+        out = []
+        for fname in os.listdir(os.path.join(self.root, "members")):
+            if not fname.endswith(".json"):
+                continue
+            name = fname[: -len(".json")]
+            if name in dead:
+                continue
+            try:
+                age = now - os.stat(
+                    os.path.join(self.root, "members", fname)
+                ).st_mtime
+            except FileNotFoundError:
+                continue  # concurrent leave()
+            if age <= self.heartbeat_timeout_s:
+                out.append(name)
+        return sorted(out)
+
+    # -- epochs ---------------------------------------------------------
+
+    def epoch(self) -> dict:
+        """Current agreed epoch record ({"epoch": -1, "roster": []} before
+        the first transition)."""
+        try:
+            with open(os.path.join(self.root, "epoch.json")) as fh:
+                return json.loads(fh.read())
+        except (FileNotFoundError, json.JSONDecodeError):
+            return {"epoch": -1, "roster": []}
+
+    def roster(self) -> list[str]:
+        return list(self.epoch().get("roster", []))
+
+    def propose(self, roster: list[str], *, epoch: int | None = None) -> dict:
+        """Write the next epoch record atomically and append it to the
+        transition log.  ``epoch`` defaults to current+1; a concurrent
+        duplicate proposal for the same epoch is harmless (same roster by
+        construction — every proposer computed it from ``alive()``)."""
+        cur = self.epoch()
+        nxt = cur["epoch"] + 1 if epoch is None else int(epoch)
+        rec = {
+            "epoch": nxt,
+            "roster": sorted(str(r) for r in roster),
+            "prev_roster": cur.get("roster", []),
+            "ts": time.time(),
+        }
+        _atomic_write(os.path.join(self.root, "epoch.json"), json.dumps(rec))
+        with open(os.path.join(self.root, "epochs.jsonl"), "a") as fh:
+            fh.write(json.dumps(rec) + "\n")
+        return rec
+
+    def history(self) -> list[dict]:
+        """All epoch transitions, oldest first."""
+        out = []
+        try:
+            with open(os.path.join(self.root, "epochs.jsonl")) as fh:
+                for line in fh:
+                    line = line.strip()
+                    if line:
+                        out.append(json.loads(line))
+        except FileNotFoundError:
+            pass
+        return out
+
+    # -- barrier + transition -------------------------------------------
+
+    def ack(self, epoch: int, name: str) -> None:
+        d = os.path.join(self.root, "acks", str(int(epoch)))
+        os.makedirs(d, exist_ok=True)
+        _atomic_write(os.path.join(d, str(name)), "")
+
+    def acked(self, epoch: int) -> set[str]:
+        d = os.path.join(self.root, "acks", str(int(epoch)))
+        try:
+            return set(os.listdir(d))
+        except FileNotFoundError:
+            return set()
+
+    def barrier(
+        self,
+        epoch: int,
+        name: str,
+        participants: list[str],
+        *,
+        timeout_s: float = 30.0,
+        poll_s: float = 0.02,
+    ) -> bool:
+        """Ack epoch ``epoch`` and wait until every participant has too.
+        Returns False on timeout (the caller decides whether to re-run the
+        transition with a smaller roster)."""
+        self.ack(epoch, name)
+        want = {str(p) for p in participants}
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if want <= self.acked(epoch):
+                return True
+            time.sleep(poll_s)
+        return want <= self.acked(epoch)
+
+    def transition(
+        self,
+        name: str,
+        *,
+        timeout_s: float = 30.0,
+    ) -> dict:
+        """Run one full epoch transition from ``name``'s point of view:
+        compute survivors, barrier with them on the next epoch number,
+        have the deterministic proposer (smallest survivor name) write the
+        roster, and wait for ``epoch.json`` to advance.  Every survivor
+        calls this and every survivor returns the same record."""
+        name = str(name)
+        cur = self.epoch()
+        nxt = cur["epoch"] + 1
+        survivors = self.alive()
+        if name not in survivors:
+            raise RuntimeError(
+                f"member {name!r} is not in the surviving roster "
+                f"{survivors} (tombstoned or heartbeat expired)"
+            )
+        ok = self.barrier(nxt, name, survivors, timeout_s=timeout_s)
+        if not ok:
+            # Someone died DURING the transition: retry against whoever is
+            # still breathing.  The acked set only grows, so survivors of
+            # the retry still pass the barrier.
+            survivors = [s for s in self.alive() if s in set(survivors)]
+            if name not in survivors:
+                raise RuntimeError(
+                    f"member {name!r} lost during epoch transition"
+                )
+            self.barrier(nxt, name, survivors, timeout_s=timeout_s)
+        if name == survivors[0]:
+            self.propose(survivors, epoch=nxt)
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            rec = self.epoch()
+            if rec["epoch"] >= nxt:
+                return rec
+            time.sleep(0.02)
+        raise TimeoutError(
+            f"epoch {nxt} was never proposed (proposer {survivors[0]!r} "
+            f"died?)"
+        )
+
+
+# -- TCP transport ------------------------------------------------------
+
+_TCP_OPS = (
+    "join", "heartbeat", "leave", "mark_dead", "alive", "dead",
+    "epoch", "roster", "propose", "history", "ack", "transition",
+)
+
+
+class _Handler(socketserver.StreamRequestHandler):
+    def handle(self):
+        store = self.server.store  # type: ignore[attr-defined]
+        for raw in self.rfile:
+            try:
+                req = json.loads(raw.decode())
+                op = req.pop("op")
+                if op not in _TCP_OPS:
+                    raise ValueError(f"unknown op {op!r}")
+                result = getattr(store, op)(**req)
+                if isinstance(result, set):
+                    result = sorted(result)
+                reply = {"ok": True, "result": result}
+            # ddplint: allow[broad-except] — protocol boundary: every
+            # failure becomes a structured error reply, never a dead socket
+            except Exception as exc:  # noqa: BLE001
+                reply = {"ok": False, "error": f"{type(exc).__name__}: {exc}"}
+            self.wfile.write((json.dumps(reply) + "\n").encode())
+            self.wfile.flush()
+
+
+class TCPRendezvousServer:
+    """Serve one ``RendezvousStore`` over a localhost-style TCP socket.
+
+    ``with TCPRendezvousServer(store) as srv: ... srv.address ...`` — the
+    server thread is a daemon; ``close()`` (or the context exit) shuts it
+    down.  Members use ``TCPRendezvousClient(address)``, which exposes the
+    same method names as the store.
+    """
+
+    def __init__(self, store: RendezvousStore, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.store = store
+        self._srv = socketserver.ThreadingTCPServer(
+            (host, port), _Handler, bind_and_activate=True
+        )
+        self._srv.daemon_threads = True
+        self._srv.store = store  # type: ignore[attr-defined]
+        self.address = "%s:%d" % self._srv.server_address[:2]
+        self._thread = threading.Thread(
+            target=self._srv.serve_forever, daemon=True
+        )
+        self._thread.start()
+
+    def close(self) -> None:
+        self._srv.shutdown()
+        self._srv.server_close()
+        self._thread.join(timeout=5.0)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+class TCPRendezvousClient:
+    """JSON-lines client for ``TCPRendezvousServer``; method-per-op facade
+    so call sites are transport-agnostic (duck-typed with the store)."""
+
+    def __init__(self, address: str, *, timeout_s: float = 60.0):
+        host, port = address.rsplit(":", 1)
+        self._sock = socket.create_connection(
+            (host, int(port)), timeout=timeout_s
+        )
+        self._rfile = self._sock.makefile("rb")
+
+    def _call(self, op: str, **kw):
+        self._sock.sendall((json.dumps({"op": op, **kw}) + "\n").encode())
+        raw = self._rfile.readline()
+        if not raw:
+            raise ConnectionError("rendezvous server closed the connection")
+        reply = json.loads(raw.decode())
+        if not reply.get("ok"):
+            raise RuntimeError(f"rendezvous: {reply.get('error')}")
+        return reply.get("result")
+
+    def close(self) -> None:
+        try:
+            self._rfile.close()
+        finally:
+            self._sock.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+def _make_op(op):
+    def call(self, *args, **kw):
+        # Positional args map onto the store's signatures by op.
+        names = {
+            "join": ("name",), "heartbeat": ("name",), "leave": ("name",),
+            "mark_dead": ("name",), "propose": ("roster",),
+            "ack": ("epoch", "name"), "transition": ("name",),
+        }.get(op, ())
+        kw.update(zip(names, args))
+        return self._call(op, **kw)
+
+    call.__name__ = op
+    return call
+
+
+for _op in _TCP_OPS:
+    setattr(TCPRendezvousClient, _op, _make_op(_op))
+del _op

@@ -89,6 +89,16 @@ def _repad(arr: np.ndarray, true: int, padded_new: int) -> np.ndarray:
     return np.pad(kept, pad)
 
 
+def repad_flat(arr, true: int, padded_new: int) -> np.ndarray:
+    """Public seam of the positional flat-reshard rule: truncate a
+    ``content || tail-padding`` flat to its true content and re-pad for a
+    new shard count.  The checkpoint path below uses it via the restore
+    template; the checkpoint-FREE path (``runtime.elastic_gang.
+    reshard_live_state``) applies the same rule to device_get'd live
+    arrays, which is what makes the two resume routes bitwise-identical."""
+    return _repad(np.asarray(arr), true, padded_new)
+
+
 def _zero_model_geometry(
     params: Pytree,
     tp_axis: str | None,

@@ -200,9 +200,34 @@ def executable_key(
     return key
 
 
-def _key_diff(stored: dict, live: dict) -> list[str]:
-    fields = sorted(set(stored) | set(live))
-    return [f for f in fields if stored.get(f) != live.get(f)]
+def _key_diff(stored: dict, live: dict, _prefix: str = "") -> list[str]:
+    """Dotted paths of every leaf where the two key dicts differ.
+
+    Recursive, so a topology mismatch after an elastic resize names the
+    exact component that moved (``topology.n_devices``,
+    ``topology.mesh_shape``) instead of dumping the whole nested
+    sub-dict as one opaque differing field.
+    """
+    out: list[str] = []
+    for f in sorted(set(stored) | set(live)):
+        a, b = stored.get(f), live.get(f)
+        if a == b:
+            continue
+        if isinstance(a, dict) and isinstance(b, dict):
+            out.extend(_key_diff(a, b, _prefix=f"{_prefix}{f}."))
+        else:
+            out.append(f"{_prefix}{f}")
+    return out
+
+
+def _key_get(key: dict, path: str):
+    """Resolve a dotted ``_key_diff`` path against a nested key dict."""
+    node: Any = key
+    for part in path.split("."):
+        if not isinstance(node, dict):
+            return None
+        node = node.get(part)
+    return node
 
 
 class ExecutableStore:
@@ -236,6 +261,24 @@ class ExecutableStore:
                 return json.load(fh)
         except (OSError, ValueError):
             return None
+
+    def index(self) -> dict[str, dict]:
+        """Every stored entry: ``name -> meta``, sorted by name.
+
+        The elastic runtime stores N±1 pre-compiled train steps next to
+        the live one (``train_step@d7``, ``train_step@d8``, ...), so the
+        index is how tools — and the resize path itself — see which
+        topologies already have an AOT hit waiting.
+        """
+        out: dict[str, dict] = {}
+        for fname in sorted(os.listdir(self.root)):
+            if not fname.endswith(_META_SUFFIX):
+                continue
+            name = fname[: -len(_META_SUFFIX)]
+            m = self.meta(name)
+            if m is not None:
+                out[name] = m
+        return out
 
     def save(
         self, name: str, key: dict, compiled, *, metric_keys: Sequence[str]
@@ -295,9 +338,10 @@ class ExecutableStore:
         log = get_logger()
         diff = _key_diff(meta.get("key", {}), key)
         if diff:
+            stored_key = meta.get("key", {})
             detail = "; ".join(
-                f"{f}: stored={meta.get('key', {}).get(f)!r} "
-                f"live={key.get(f)!r}"
+                f"{f}: stored={_key_get(stored_key, f)!r} "
+                f"live={_key_get(key, f)!r}"
                 for f in diff
             )
             msg = (
@@ -345,6 +389,94 @@ def _metric_keys_of(compiled) -> list[str]:
         out_tree, [0] * out_tree.num_leaves
     )
     return sorted(skeleton[1].keys())
+
+
+def precompile_step(
+    store: ExecutableStore,
+    *,
+    name: str,
+    key: dict,
+    step_fn: Callable,
+    example_args: tuple,
+) -> bool:
+    """AOT-compile ``step_fn`` against (abstract) ``example_args`` and
+    persist it under ``name``; returns True when a fresh artifact was
+    written, False when the store already holds this exact key.
+
+    This is the unit of work behind topology-portable warm starts: the
+    elastic runtime calls it for the N±1 meshes so a resize lands on an
+    AOT load instead of a cold compile.  The save honours the same
+    fresh-compile-only rule as ``warm_train_step`` (re-serializing a
+    persistent-cache hit produces broken payloads on this jaxlib).
+    """
+    meta = store.meta(name)
+    if meta is not None and not _key_diff(meta.get("key", {}), key):
+        return False
+    fn = step_fn if hasattr(step_fn, "lower") else jax.jit(step_fn)
+    stats = CompileCacheStats()
+    try:
+        compiled = fn.lower(*example_args).compile()
+    finally:
+        stats.close()
+    if stats.hits == 0 or meta is None:
+        store.save(name, key, compiled, metric_keys=_metric_keys_of(compiled))
+        return True
+    return False
+
+
+class BackgroundPrecompiler:
+    """Run ``precompile_step`` jobs on a daemon thread, serially.
+
+    ``jobs`` is a sequence of ``(name, key, build)`` triples; ``build()``
+    runs ON the worker thread and returns ``(step_fn, example_args)`` —
+    deferring mesh construction and abstract-template building off the
+    training loop's critical path.  Failures are swallowed per-job (a
+    pre-compile is an optimization, never a correctness gate) and land
+    in ``report`` as ``{"name": "saved"|"cached"|"error: ..."}``.
+    """
+
+    def __init__(self, store: ExecutableStore, jobs: Sequence[tuple]):
+        import threading
+
+        self._store = store
+        self._jobs = list(jobs)
+        self.report: dict[str, str] = {}
+        self._thread = threading.Thread(
+            target=self._run, name="ddp-precompile", daemon=True
+        )
+
+    def start(self) -> "BackgroundPrecompiler":
+        self._thread.start()
+        return self
+
+    def join(self, timeout: float | None = None) -> None:
+        self._thread.join(timeout)
+
+    @property
+    def done(self) -> bool:
+        return not self._thread.is_alive()
+
+    def _run(self) -> None:
+        log = get_logger()
+        for name, key, build in self._jobs:
+            try:
+                step_fn, example_args = build()
+                fresh = precompile_step(
+                    self._store,
+                    name=name,
+                    key=key,
+                    step_fn=step_fn,
+                    example_args=example_args,
+                )
+                self.report[name] = "saved" if fresh else "cached"
+            # ddplint: allow[broad-except] — pre-compiles are best-effort
+            except Exception as exc:  # noqa: BLE001
+                self.report[name] = f"error: {type(exc).__name__}: {exc}"
+                log.warning(
+                    "background pre-compile of %r failed (%s: %s) — a "
+                    "resize to that topology will cold-compile instead",
+                    name, type(exc).__name__, exc,
+                )
 
 
 def warm_train_step(

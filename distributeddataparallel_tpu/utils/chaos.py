@@ -23,6 +23,12 @@ Spec grammar (comma-separated entries, all steps 0-based)::
     preempt@S          raise SimulatedPreemption before step S — with
                        launcher supervision (``--max-restarts``) the
                        worker dies and resumes from the last checkpoint
+    worker-kill@S[:R]  mark gang member R (default 1) dead in the elastic
+                       rendezvous store before step S — with ``--elastic``
+                       the survivors resize the mesh and resume in place
+                       instead of restarting (requires a wired gang
+                       coordinator; a no-op with a logged warning
+                       otherwise)
 
 Determinism across restarts: with a ``state_dir`` (defaults to
 ``<checkpoint_dir>/.chaos`` in the CLI), each entry fires AT MOST ONCE
@@ -47,7 +53,7 @@ __all__ = [
     "parse_chaos_spec",
 ]
 
-KINDS = ("ckpt-io", "nan-grad", "slow-step", "preempt")
+KINDS = ("ckpt-io", "nan-grad", "slow-step", "preempt", "worker-kill")
 
 
 class SimulatedPreemption(RuntimeError):
@@ -102,7 +108,7 @@ def parse_chaos_spec(spec: str) -> list[_Entry]:
             raise ValueError(
                 f"bad chaos entry {raw!r}: expected one of "
                 "ckpt-io@N[:K] | nan-grad@S | slow-step@S[:SECONDS] | "
-                "preempt@S (comma-separated)"
+                "preempt@S | worker-kill@S[:RANK] (comma-separated)"
             ) from None
         entries.append(_Entry(kind, step, arg or None))
     return entries
@@ -126,6 +132,10 @@ class FaultInjector:
         # recorded as a ``chaos_inject`` event, so the gang timeline
         # shows cause (injection) next to effect (skip/retry/restart).
         self.events = events
+        # Optional elastic gang coordinator (runtime.elastic_gang): the
+        # worker-kill hook marks a member dead through it.  dpp.py wires
+        # this under --elastic; without it the entry warns and no-ops.
+        self.gang = None
         self._fired_local: set[str] = set()
         # Entries this PROCESS started firing (a multi-attempt ckpt-io
         # entry keeps failing attempts here even after its cross-restart
@@ -190,6 +200,17 @@ class FaultInjector:
         e = self._take("slow-step", step)
         if e is not None:
             time.sleep(float(e.arg or 30.0))
+        e = self._take("worker-kill", step)
+        if e is not None:
+            if self.gang is not None:
+                self.gang.kill(e.arg or "1")
+            else:
+                from distributeddataparallel_tpu.utils.logging import warn0
+
+                warn0(
+                    "chaos %s: no elastic gang coordinator wired "
+                    "(--elastic not set?) — kill not injected", e.key,
+                )
         e = self._take("preempt", step)
         if e is not None:
             raise SimulatedPreemption(

@@ -251,6 +251,28 @@ def parse_args(argv=None):
                         "analog).  Requires --checkpoint-dir; each "
                         "restart resumes from the newest intact "
                         "checkpoint")
+    p.add_argument("--elastic", action="store_true",
+                   help="elastic gang runtime (runtime.elastic_gang): on "
+                        "a member death (chaos worker-kill, peer failure "
+                        "detector) the survivors agree on the next "
+                        "membership epoch, rebuild the mesh one device "
+                        "smaller, and reshard the LIVE train state in "
+                        "memory — no checkpoint restore, no process "
+                        "restart.  Data reshards deterministically "
+                        "(every sample still seen exactly once per "
+                        "pass); with --compile-cache the N±1 step "
+                        "executables are pre-compiled in the background "
+                        "so the resize lands on an AOT hit.  DP and "
+                        "--zero 1 layouts over the data axis only")
+    p.add_argument("--min-procs", type=int, default=1,
+                   help="with --elastic: smallest gang worth resizing "
+                        "down to — fewer survivors than this is a "
+                        "failure (supervised restart territory), not a "
+                        "smaller gang")
+    p.add_argument("--elastic-dir", default=None, metavar="DIR",
+                   help="rendezvous store root for --elastic (env: "
+                        "DDP_ELASTIC_DIR); defaults to EVENTS_DIR/gang "
+                        "or CHECKPOINT_DIR/.gang")
     p.add_argument("--step-timeout", type=float, default=None,
                    help="wall-clock deadline in seconds per train step "
                         "(armed after the first, compile-bearing step): "
@@ -367,6 +389,12 @@ def parse_args(argv=None):
         args.runs_dir = os.environ.get("DDP_RUNS_DIR") or None
     if args.alerts is None and os.environ.get("DDP_ALERTS") is not None:
         args.alerts = os.environ.get("DDP_ALERTS")
+    if args.elastic_dir is None:
+        args.elastic_dir = os.environ.get("DDP_ELASTIC_DIR") or None
+    if args.elastic and os.environ.get("DDP_ELASTIC_WORLD"):
+        # A resize-respawn from the elastic supervisor: the gang comes
+        # back at the surviving size, not the argv's original one.
+        args.fake_devices = int(os.environ["DDP_ELASTIC_WORLD"])
     if args.alerts is not None:
         from distributeddataparallel_tpu.observability.alerts import (
             parse_alert_spec,
@@ -586,6 +614,43 @@ def validate_args(args) -> None:
                              "(restarts resume from the last checkpoint)")
     if args.step_timeout is not None and args.step_timeout <= 0:
         raise SystemExit("--step-timeout must be > 0 seconds")
+    if args.min_procs < 1:
+        raise SystemExit("--min-procs must be >= 1")
+    if args.elastic:
+        bad = [
+            f for f, on in (
+                ("--fsdp", args.fsdp), ("--pp", args.pp > 1),
+                ("--tp", args.tp > 1), ("--ep", args.ep > 1),
+                ("--cp", args.cp > 1),
+            ) if on
+        ]
+        if bad:
+            raise SystemExit(
+                f"--elastic resizes over the data axis only; drop "
+                f"{', '.join(bad)}"
+            )
+        if args.zero >= 2:
+            raise SystemExit(
+                "--elastic supports plain DP and --zero 1; the ZeRO-2/3 "
+                "resident weight shards resize through supervised "
+                "restart + elastic_restore instead"
+            )
+        if args.moment_dtype:
+            raise SystemExit(
+                "--elastic does not compose with --moment-dtype: the "
+                "in-memory reshard has no dequant/requant path for "
+                "low-bit moments"
+            )
+        if args.grad_compress:
+            raise SystemExit(
+                "--elastic does not compose with --grad-compress: the "
+                "hook state layout is replica-count-dependent"
+            )
+        if not (args.elastic_dir or args.events_dir or args.checkpoint_dir):
+            raise SystemExit(
+                "--elastic needs a rendezvous root: --elastic-dir, or "
+                "--events-dir/--checkpoint-dir to derive one"
+            )
     if args.chaos:
         from distributeddataparallel_tpu.utils.chaos import parse_chaos_spec
 
@@ -699,6 +764,41 @@ def validate_args(args) -> None:
             raise SystemExit(
                 "--ep with --cp composes pairwise only (no extra --pp/--tp)"
             )
+
+
+def elastic_store_dir(args) -> str:
+    """The rendezvous root shared by trainer and supervisor (both derive
+    it from the same argv, so a respawn finds the same store)."""
+    if args.elastic_dir:
+        return args.elastic_dir
+    if args.events_dir:
+        return os.path.join(args.events_dir, "gang")
+    return os.path.join(args.checkpoint_dir, ".gang")
+
+
+class _SwappableStream:
+    """Iterator of ``(batch_idx, batch)`` whose underlying loader can be
+    swapped mid-epoch: the elastic resize replaces the remainder of the
+    epoch with a tail loader resharded for the new world, and the batch
+    index keeps counting — the global step stays monotone across the
+    swap."""
+
+    def __init__(self, loader):
+        self._it = iter(loader)
+        self._idx = -1
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        self._idx += 1
+        return self._idx, next(self._it)
+
+    def swap(self, loader) -> None:
+        close = getattr(self._it, "close", None)
+        if close is not None:
+            close()
+        self._it = iter(loader)
 
 
 def build_model(args, num_classes: int = 10, vocab_size: int | None = None):
@@ -1247,26 +1347,30 @@ def train(args) -> float:
         )
     else:
         # One factory for the other compositions: DP × {accum, buckets,
-        # ZeRO} × CP/TP.
-        step_fn = ddp.make_train_step(
-            loss_fn, mesh=mesh, accum_steps=args.accum_steps,
-            bucket_bytes=int(args.bucket_mb * 1024 * 1024) if args.bucket_mb else None,
-            overlap=args.overlap,
-            with_model_state=has_ms, zero=args.zero,
-            buffer_sync=args.buffer_sync,
-            cp_axis="seq" if cp else None,
-            tp_axis="model" if args.tp > 1 else None,
-            ep_axis="expert" if args.ep > 1 else None,
-            grad_clip=args.grad_clip,
-            grad_compress=args.grad_compress,
-            presynced=(
-                (lambda p: p[0] == "layers")
-                if getattr(getattr(model, "cfg", None), "grad_sync_axis",
-                           None)
-                else None
-            ),
-            nonfinite_guard=args.nan_guard,
-        )
+        # ZeRO} × CP/TP.  Factored over the mesh so the elastic resize
+        # can rebuild the identical step for the shrunken world.
+        def build_step_fn(for_mesh):
+            return ddp.make_train_step(
+                loss_fn, mesh=for_mesh, accum_steps=args.accum_steps,
+                bucket_bytes=int(args.bucket_mb * 1024 * 1024) if args.bucket_mb else None,
+                overlap=args.overlap,
+                with_model_state=has_ms, zero=args.zero,
+                buffer_sync=args.buffer_sync,
+                cp_axis="seq" if cp else None,
+                tp_axis="model" if args.tp > 1 else None,
+                ep_axis="expert" if args.ep > 1 else None,
+                grad_clip=args.grad_clip,
+                grad_compress=args.grad_compress,
+                presynced=(
+                    (lambda p: p[0] == "layers")
+                    if getattr(getattr(model, "cfg", None),
+                               "grad_sync_axis", None)
+                    else None
+                ),
+                nonfinite_guard=args.nan_guard,
+            )
+
+        step_fn = build_step_fn(mesh)
 
     # Graph lint wants the RAW factory step: the warm-start wrapper below
     # may swap in a deserialized AOT executable, which cannot be traced.
@@ -1290,13 +1394,13 @@ def train(args) -> float:
             warm_train_step,
         )
 
-        step_fn = warm_train_step(
-            step_fn,
-            store=ExecutableStore(os.path.join(args.compile_cache, "aot")),
-            key=executable_key(
-                mesh=mesh,
+        warm_store = ExecutableStore(os.path.join(args.compile_cache, "aot"))
+
+        def _exec_key(fn, for_mesh):
+            return executable_key(
+                mesh=for_mesh,
                 model_config=getattr(model, "cfg", None),
-                step_signature=getattr(step_fn, "aot_signature", None),
+                step_signature=getattr(fn, "aot_signature", None),
                 extra={
                     "model": args.model,
                     "batch_size": args.batch_size,
@@ -1313,9 +1417,21 @@ def train(args) -> float:
                     "pp_schedule": args.pp_schedule,
                     "pp_virtual": args.pp_virtual,
                 },
-            ),
-            on_ready=lambda rep: warm_report.update(rep),
-        )
+            )
+
+        def _wrap_warm(fn, for_mesh, name="train_step"):
+            # Per-topology store names ("train_step@d7", ...): the
+            # elastic resize re-wraps against the entry the background
+            # pre-compiler saved for exactly that device count.
+            return warm_train_step(
+                fn,
+                store=warm_store,
+                key=_exec_key(fn, for_mesh),
+                name=name,
+                on_ready=lambda rep: warm_report.update(rep),
+            )
+
+        step_fn = _wrap_warm(step_fn, mesh)
 
     def full_params():
         """The replicated param tree for eval/generate: under FSDP the
@@ -1391,6 +1507,64 @@ def train(args) -> float:
     # cause-and-effect pairs.
     injector.events = events
     breaker = NonFiniteBreaker(args.max_bad_steps) if args.nan_guard else None
+
+    # Elastic gang runtime: on this CPU-simulation topology one process
+    # hosts every fake-device rank as a gang member (the per-"proc"
+    # analog used repo-wide), so the coordinator registers them all and
+    # the resize is an in-process mesh rebuild.  On real multi-host TPU
+    # the same coordinator runs one-member-per-process.
+    gang = None
+
+    def _data_mesh(m):
+        return ddp.make_mesh(("data",), devices=jax.devices()[:m])
+
+    if args.elastic:
+        from distributeddataparallel_tpu.runtime.elastic_gang import (
+            ElasticGangCoordinator,
+        )
+
+        gang = ElasticGangCoordinator(
+            elastic_store_dir(args),
+            world=[f"proc{i}" for i in range(n_replicas)],
+            min_size=args.min_procs,
+            events=events,
+        )
+        gang.start()
+        # The chaos worker-kill entry tombstones a member through the
+        # coordinator; the next poll() on the survivors runs the resize.
+        injector.gang = gang
+
+    precompiler = None
+
+    def _launch_precompiler(live_state, live_batch, live_rng):
+        """Background AOT compiles of the N±1 train steps (the
+        topology-portable key family): a later resize re-wraps the step
+        under the per-topology store name and lands on the executable
+        compiled here instead of paying a cold compile mid-resize."""
+        from distributeddataparallel_tpu.runtime.elastic_gang import (
+            batch_template_for,
+            state_template_for,
+        )
+        from distributeddataparallel_tpu.training.warm_start import (
+            BackgroundPrecompiler,
+        )
+
+        rng_t = jax.ShapeDtypeStruct(live_rng.shape, live_rng.dtype)
+        n_now = mesh.shape["data"]
+        jobs = []
+        for m in (n_now - 1, n_now + 1):
+            if m < max(args.min_procs, 1) or m > len(jax.devices()):
+                continue
+            tgt = _data_mesh(m)
+            fn = build_step_fn(tgt)
+            st = state_template_for(live_state, mesh, tgt, zero=args.zero)
+            bt = batch_template_for(live_batch, mesh, tgt)
+            jobs.append((
+                f"train_step@d{m}",
+                _exec_key(fn, tgt),
+                lambda fn=fn, st=st, bt=bt: (fn, (st, bt, rng_t)),
+            ))
+        return BackgroundPrecompiler(warm_store, jobs).start()
 
     ckpt = None
     start_epoch = 0
@@ -1841,7 +2015,8 @@ def train(args) -> float:
                 sync=lambda: state.params,  # resolves to latest state at exit
             ):
                 loader.set_epoch(epoch)                  # ref dpp.py:46
-                for batch_idx, batch in enumerate(loader):  # ref dpp.py:47
+                stream = _SwappableStream(loader)
+                for batch_idx, batch in stream:          # ref dpp.py:47
                     if args.steps_per_epoch \
                             and batch_idx >= args.steps_per_epoch:
                         break
@@ -1988,6 +2163,16 @@ def train(args) -> float:
                         )
                         if goodput is not None:
                             goodput.add("compile", timer.compile_s)
+                        if (
+                            gang is not None
+                            and args.compile_cache
+                            and precompiler is None
+                        ):
+                            # First step done (live avals now known):
+                            # queue the N±1 pre-compiles off-thread.
+                            precompiler = _launch_precompiler(
+                                state, batch, sub
+                            )
                         if events is not None and "pp_phase_counts" in metrics:
                             # Measured-schedule counters: the compiled
                             # scan counted useful (valid) slots per
@@ -2137,6 +2322,111 @@ def train(args) -> float:
                              epoch, epoch + 1)
                         ddp.destroy_process_group()
                         return float(metrics["loss"])
+                    if gang is not None:
+                        decision = gang.poll()
+                        if decision is not None:
+                            # RESIZE, not restart: survivors agreed on
+                            # membership epoch k+1 — rebuild the mesh one
+                            # (or more) members smaller and keep going
+                            # with the LIVE state.  Nothing below reads a
+                            # checkpoint.
+                            t_rs = time.perf_counter()
+                            drain()  # nothing in flight crosses the swap
+                            from distributeddataparallel_tpu.data.sharded import (  # noqa: E501
+                                resize_index_plan,
+                            )
+                            from distributeddataparallel_tpu.runtime.elastic_gang import (  # noqa: E501
+                                measure_downtime,
+                                reshard_live_state,
+                            )
+
+                            old_world = n_replicas
+                            new_world = decision.new_size
+                            old_mesh, mesh = mesh, _data_mesh(new_world)
+                            # Checkpoint-free shrink: host round-trip of
+                            # the live arrays through the positional
+                            # flat-reshard math (training.elastic).
+                            state = reshard_live_state(
+                                state, old_mesh, mesh, zero=args.zero
+                            )
+                            # Exactly-once data: the unconsumed tail of
+                            # this epoch's permutation, reshuffled under
+                            # an epoch-keyed reseed and dealt to the new
+                            # world.
+                            plan = resize_index_plan(
+                                len(dataset),
+                                per_replica_batch=args.batch_size,
+                                old_world=old_world,
+                                new_world=new_world,
+                                consumed_steps=batch_idx + 1,
+                                seed=args.seed, epoch=epoch,
+                                membership_epoch=decision.epoch,
+                            )
+                            tail = DataLoader(
+                                dataset,
+                                per_replica_batch=args.batch_size,
+                                mesh=mesh, shuffle=True, seed=args.seed,
+                                place_fn=place_fn, workers=args.workers,
+                                augment=augment, index_shards=plan,
+                            )
+                            tail.events = events
+                            stream.swap(tail)
+                            step_fn = build_step_fn(mesh)
+                            if args.compile_cache:
+                                # The per-topology store name the
+                                # background pre-compiler saved — a
+                                # resize lands on an AOT load.
+                                step_fn = _wrap_warm(
+                                    step_fn, mesh,
+                                    name=f"train_step@d{new_world}",
+                                )
+                            n_replicas = new_world
+                            items_per_step = (
+                                args.batch_size * n_replicas * args.seq_len
+                                if lm
+                                else args.batch_size * n_replicas
+                            )
+                            if ckpt is not None:
+                                ckpt_meta = topology_meta(
+                                    mesh,
+                                    f"zero{args.zero}" if args.zero
+                                    else "replicated",
+                                )
+                            if eval_step is not None:
+                                eval_step = make_eval_step(
+                                    metric_fn, mesh=mesh,
+                                    with_model_state=has_ms, masked=True,
+                                )
+                                eval_loader = DataLoader(
+                                    build_dataset(args, train=False),
+                                    per_replica_batch=args.batch_size,
+                                    mesh=mesh, shuffle=False,
+                                    seed=args.seed, drop_last=False,
+                                    with_mask=True,
+                                )
+                            if mfu_meter is not None:
+                                mfu_meter = None
+                                warn0(
+                                    "elastic resize: MFU meter disabled "
+                                    "(chip count changed mid-run)"
+                                )
+                            downtime = measure_downtime(t_rs)
+                            if events is not None:
+                                events.emit(
+                                    "resize_downtime",
+                                    epoch=decision.epoch,
+                                    seconds=round(downtime, 3),
+                                )
+                            if goodput is not None:
+                                goodput.add("resize", downtime)
+                            log0(
+                                "elastic resize: %d -> %d replicas "
+                                "(membership epoch %d, left: %s) in "
+                                "%.2fs — no checkpoint read",
+                                old_world, new_world, decision.epoch,
+                                list(decision.left), downtime,
+                            )
+                            timer.reset()  # don't bill the window
             drain()  # epoch edge: eval/checkpoint see fully-synced state
             last_loss = float(metrics["loss"])
             if eval_step is not None:
@@ -2302,6 +2592,14 @@ def train(args) -> float:
 
     if ckpt is not None:
         ckpt.wait()
+    if precompiler is not None:
+        # XLA calls std::terminate if the interpreter tears down while
+        # the background thread is mid-compile — wait the N±1 jobs out.
+        precompiler.join(timeout=300)
+    if gang is not None:
+        # Clean exit: deregister the hosted members so a later run in
+        # the same store starts from an empty gang, not ghost members.
+        gang.stop()
     ddp.destroy_process_group()                          # ref dpp.py:57
     return last_loss
 
@@ -2348,10 +2646,16 @@ def main(argv=None):
         child_argv = list(argv) if argv is not None else sys.argv[1:]
         if "--resume" not in child_argv:
             child_argv.append("--resume")
+        child_env = {"_DDP_SUPERVISED": "1"}
+        if args.elastic:
+            # Same rendezvous root for every incarnation: the supervisor
+            # reads it to tell a shrunk-roster death (resize-respawn)
+            # from a plain crash (restart).
+            child_env["DDP_ELASTIC_DIR"] = elastic_store_dir(args)
         spawn(
             _worker, args=(child_argv,), nprocs=1,
             max_restarts=args.max_restarts,
-            env={"_DDP_SUPERVISED": "1"},
+            env=child_env,
             # Supervisor-side observability: restart attempts land in
             # events-supervisor.jsonl and the per-worker logs merge into
             # one gang timeline.jsonl when supervision ends.
@@ -2359,6 +2663,8 @@ def main(argv=None):
             # The supervisor writes the runs-store summary for supervised
             # runs — its view spans every incarnation + restart gaps.
             runs_dir=args.runs_dir,
+            elastic_store=elastic_store_dir(args) if args.elastic else None,
+            min_procs=args.min_procs,
         )
         return
     select_device(args)

@@ -32,7 +32,11 @@
 #                               events dir yields a schema-valid
 #                               timeline + structurally valid Perfetto
 #                               trace with the request-lifecycle kinds
-#   6. tier-1 pytest            the ROADMAP verify command (CPU, not
+#   6. elastic shrink smoke     4 -> 3 in-process resize on a fake-device
+#                               CPU gang: chaos kills one member mid-run,
+#                               the coordinator must land a gang_resize
+#                               (NOT a restart_attempt) and finish ok
+#   7. tier-1 pytest            the ROADMAP verify command (CPU, not
 #                               slow).  Includes the ZeRO-2/3 bitwise
 #                               dp-parity + low-bit-moment convergence
 #                               tests (tests/test_zero23.py)
@@ -71,6 +75,25 @@ echo "== ddp_serve --smoke =="
 SERVE_SMOKE_DIR="$(mktemp -d)"
 python scripts/ddp_serve.py --smoke --events-dir "${SERVE_SMOKE_DIR}"
 rm -rf "${SERVE_SMOKE_DIR}"
+
+echo "== elastic shrink smoke (4 -> 3) =="
+ELASTIC_SMOKE_DIR="$(mktemp -d)"
+JAX_PLATFORMS=cpu python dpp.py --model mlp --fake-devices 4 \
+    --batch-size 4 --epochs 1 --steps-per-epoch 8 \
+    --elastic --chaos "worker-kill@3:2" \
+    --events-dir "${ELASTIC_SMOKE_DIR}"
+python - "${ELASTIC_SMOKE_DIR}" <<'PY'
+import sys
+from distributeddataparallel_tpu.observability.events import load_timeline
+kinds = [r.get("kind") for r in load_timeline(sys.argv[1])]
+resizes = kinds.count("gang_resize")
+assert resizes == 1, f"expected exactly 1 gang_resize, saw {resizes}"
+assert "restart_attempt" not in kinds, \
+    "elastic shrink fell back to a supervised restart"
+print(f"elastic shrink smoke: 1 gang_resize, 0 restarts "
+      f"({len(kinds)} records)")
+PY
+rm -rf "${ELASTIC_SMOKE_DIR}"
 
 if [[ "${DDP_PERF_GATE:-0}" == "1" ]]; then
     echo "== perf_gate =="

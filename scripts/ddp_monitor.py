@@ -75,6 +75,8 @@ class GangState:
         self.ranks: dict[int, dict] = {}
         self.alerts: list[dict] = []
         self.supervisor: list[dict] = []
+        self.epoch: int | None = None
+        self.roster: set[str] | None = None
 
     def _rank(self, proc: int) -> dict:
         return self.ranks.setdefault(proc, {
@@ -86,8 +88,17 @@ class GangState:
     def ingest(self, rec: dict) -> None:
         proc = rec.get("proc")
         kind = rec.get("kind")
+        if kind == "membership_epoch":
+            # Worker or supervisor; the highest epoch wins.
+            ep = rec.get("epoch")
+            if isinstance(ep, int) and (self.epoch is None
+                                        or ep >= self.epoch):
+                self.epoch = ep
+                self.roster = set(rec.get("roster") or [])
+            return
         if proc == "supervisor":
-            if kind in ("restart_attempt", "restart_exhausted"):
+            if kind in ("restart_attempt", "restart_exhausted",
+                        "gang_resize"):
                 self.supervisor.append(rec)
             return
         if not isinstance(proc, int):
@@ -119,29 +130,50 @@ class GangState:
 
     def table(self, now: float | None = None) -> str:
         now = time.time() if now is None else now
-        lines = [
+        lines = []
+        if self.epoch is not None:
+            lines.append(
+                f"membership epoch {self.epoch} "
+                f"({len(self.roster or ())} member(s))"
+            )
+        lines.append(
             f"{'rank':>4}  {'step':>8}  {'step_s':>9}  {'mfu':>6}  "
-            f"{'idle_s':>7}  {'nan':>4}  {'alerts':>6}  status",
-        ]
+            f"{'idle_s':>7}  {'nan':>4}  {'alerts':>6}  {'epoch':>5}  "
+            "status",
+        )
         def fmt(value, spec: str) -> str:
             return "-" if value is None else format(value, spec)
 
         for proc in sorted(self.ranks):
             r = self.ranks[proc]
             idle = now - r["last_ts"] if r["last_ts"] else None
+            # A rank absent from the current roster left the gang at the
+            # last resize — the elastic runtime runs on without it.
+            member = "-" if self.epoch is None else (
+                str(self.epoch)
+                if self.roster is None or f"proc{proc}" in self.roster
+                else "out"
+            )
             lines.append(
                 f"{proc:>4}  "
                 f"{fmt(r['last_step'], 'd'):>8}  "
                 f"{fmt(r['last_step_s'], '.4f'):>9}  "
                 f"{fmt(r['last_mfu'], '.3f'):>6}  "
                 f"{fmt(idle, '.1f'):>7}  "
-                f"{r['nan_skips']:>4}  {r['alerts']:>6}  {r['status']}"
+                f"{r['nan_skips']:>4}  {r['alerts']:>6}  {member:>5}  "
+                f"{r['status']}"
             )
         for rec in self.supervisor[-3:]:
-            lines.append(
-                f"  supervisor: {rec.get('kind')} attempt "
-                f"{rec.get('attempt')}"
-            )
+            if rec.get("kind") == "gang_resize":
+                lines.append(
+                    f"  supervisor: gang_resize {rec.get('old_size')} -> "
+                    f"{rec.get('new_size')} (epoch {rec.get('epoch')})"
+                )
+            else:
+                lines.append(
+                    f"  supervisor: {rec.get('kind')} attempt "
+                    f"{rec.get('attempt')}"
+                )
         return "\n".join(lines)
 
 

@@ -81,6 +81,7 @@ def analyze(records: list[dict]) -> dict:
         "straggler": None,
         "pipeline": measured_bubble_fraction(records),
         "restarts": [],
+        "elasticity": None,
         "alerts": [],
         "lint": [],
         "run_summary": None,
@@ -124,6 +125,34 @@ def analyze(records: list[dict]) -> dict:
                 "attempt": r.get("attempt"),
                 "failed": r.get("failed"),
             })
+        elif kind in ("membership_epoch", "gang_resize", "resize_downtime"):
+            el = out["elasticity"]
+            if el is None:
+                el = out["elasticity"] = {
+                    "epochs": {}, "resizes": [], "downtimes": {},
+                }
+            if kind == "membership_epoch":
+                # Worker and supervisor may both emit an epoch record;
+                # keyed by epoch so duplicates collapse (last wins).
+                el["epochs"][r.get("epoch")] = {
+                    "epoch": r.get("epoch"),
+                    "size": r.get("size"),
+                    "roster": r.get("roster") or [],
+                }
+            elif kind == "gang_resize":
+                el["resizes"].append({
+                    "epoch": r.get("epoch"),
+                    "old_size": r.get("old_size"),
+                    "new_size": r.get("new_size"),
+                    "left": r.get("left") or [],
+                    "joined": r.get("joined") or [],
+                })
+            else:
+                if isinstance(r.get("seconds"), (int, float)):
+                    ep = r.get("epoch")
+                    el["downtimes"][ep] = max(
+                        el["downtimes"].get(ep, 0.0), r["seconds"]
+                    )
         elif kind == "lint_report":
             out["lint"].append({
                 "layer": r.get("layer"),
@@ -198,6 +227,33 @@ def analyze(records: list[dict]) -> dict:
         ttfts = sorted(s.pop("ttft_s"))
         s["ttft_p50_s"] = _quantile(ttfts, 0.50)
         s["ttft_p99_s"] = _quantile(ttfts, 0.99)
+    if out["elasticity"]:
+        el = out["elasticity"]
+        # dicts keyed by epoch -> sorted lists for the --json face
+        el["epochs"] = [el["epochs"][k]
+                        for k in sorted(el["epochs"], key=lambda e: (e is None, e))]
+        el["downtimes"] = [
+            {"epoch": k, "seconds": v}
+            for k, v in sorted(el["downtimes"].items(),
+                               key=lambda kv: (kv[0] is None, kv[0]))
+        ]
+        el["n_resizes"] = len(el["resizes"])
+        el["resize_downtime_s"] = round(
+            sum(d["seconds"] for d in el["downtimes"]), 3
+        )
+        # Restart-seconds reclaimed: each resize replaced one cold
+        # restart.  With restarts in the SAME timeline the mean restart
+        # gap (goodput restart bucket / count) is the in-run baseline;
+        # without one the comparison lives in bench elastic_resize.
+        el["restart_reclaimed_s"] = None
+        g = out["goodput"]
+        if g and g.get("restarts") and el["downtimes"]:
+            mean_restart = g["buckets"].get("restart", 0.0) / g["restarts"]
+            if mean_restart > 0:
+                el["restart_reclaimed_s"] = round(sum(
+                    max(0.0, mean_restart - d["seconds"])
+                    for d in el["downtimes"]
+                ), 3)
     return out
 
 
@@ -381,6 +437,57 @@ def render_markdown(a: dict, events_dir: str) -> str:
                 f"(failed: {r['failed']})"
             )
         lines.append("")
+
+    # -- Elasticity ---------------------------------------------------
+    lines += ["## Elasticity", ""]
+    el = a["elasticity"]
+    if el is None:
+        lines.append("No membership events — a fixed-size gang (run with "
+                     "`--elastic` to resize the mesh around worker loss "
+                     "instead of restarting).")
+    else:
+        lines += [
+            f"**{el['n_resizes']} resize(s)** across "
+            f"{len(el['epochs'])} membership epoch(s), "
+            f"{el['resize_downtime_s']:.2f}s total resize downtime.",
+            "",
+            "| epoch | size | roster |",
+            "|---:|---:|---|",
+        ]
+        for ep in el["epochs"]:
+            roster = ", ".join(str(m) for m in ep["roster"]) or "—"
+            lines.append(f"| {ep['epoch']} | {ep['size']} | {roster} |")
+        if el["resizes"]:
+            down = {d["epoch"]: d["seconds"] for d in el["downtimes"]}
+            lines += [
+                "",
+                "| epoch | resize | left | joined | downtime |",
+                "|---:|---|---|---|---:|",
+            ]
+            for rz in el["resizes"]:
+                d = down.get(rz["epoch"])
+                lines.append(
+                    f"| {rz['epoch']} | {rz['old_size']} -> "
+                    f"{rz['new_size']} | "
+                    f"{', '.join(rz['left']) or '—'} | "
+                    f"{', '.join(rz['joined']) or '—'} | "
+                    f"{'-' if d is None else f'{d:.2f}s'} |"
+                )
+        if el["restart_reclaimed_s"] is not None:
+            lines += [
+                "",
+                f"Restart-seconds reclaimed: **"
+                f"{el['restart_reclaimed_s']:.2f}s** vs this run's own "
+                "mean restart gap.",
+            ]
+        elif el["downtimes"]:
+            lines += [
+                "",
+                "No cold restarts in this timeline to reclaim against — "
+                "bench.py's `elastic_resize` section measures resize vs "
+                "supervised restart head-to-head.",
+            ]
+    lines.append("")
 
     # -- Alerts -------------------------------------------------------
     lines += ["## Alerts", ""]

@@ -57,8 +57,11 @@ _LOWER_BETTER = re.compile(
 
 #: throughput names that END in a rate suffix (tok_s, img_s, ..._per_s)
 #: would otherwise hit _LOWER_BETTER's ``_s$`` and gate backwards —
-#: a serving tok/s IMPROVEMENT must not read as a regression.
-_HIGHER_BETTER = re.compile(r"(tok_s|img_s|_per_s)$")
+#: a serving tok/s IMPROVEMENT must not read as a regression.  Same for
+#: reclaimed_s: restart seconds the elastic resize path gave BACK
+#: (bench elastic_resize's restart_reclaimed_s) — it ends in _s and
+#: contains "restart", but more of it is better.
+_HIGHER_BETTER = re.compile(r"(tok_s|img_s|_per_s|reclaimed_s)$")
 
 
 def _bench_direction(name: str) -> str:

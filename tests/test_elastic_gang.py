@@ -1,0 +1,439 @@
+"""Elastic gang runtime (runtime.elastic_gang + runtime.rendezvous):
+membership-epoch transitions over the file and TCP transports, bitwise
+parity of the checkpoint-free in-memory shrink against a real
+``elastic_restore``, exactly-once data coverage across a mid-epoch
+resize, and the supervised chaos-kill acceptance run whose timeline must
+show a ``gang_resize`` and no ``restart_attempt``."""
+
+import os
+import pathlib
+import subprocess
+import sys
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import Mesh
+
+import distributeddataparallel_tpu as ddp
+from distributeddataparallel_tpu.data.loader import shard_batch
+from distributeddataparallel_tpu.data.sharded import resize_index_plan
+from distributeddataparallel_tpu.models import TransformerLM, tiny_lm
+from distributeddataparallel_tpu.ops import lm_cross_entropy
+from distributeddataparallel_tpu.runtime.elastic_gang import (
+    ElasticGangCoordinator,
+    reshard_live_state,
+)
+from distributeddataparallel_tpu.runtime.rendezvous import (
+    RendezvousStore,
+    TCPRendezvousClient,
+    TCPRendezvousServer,
+)
+from distributeddataparallel_tpu.training.checkpoint import Checkpointer
+from distributeddataparallel_tpu.training.elastic import (
+    elastic_restore,
+    topology_meta,
+)
+
+
+# -- rendezvous: epoch transitions ---------------------------------------
+
+
+def test_rendezvous_join_leave_epochs(tmp_path):
+    """Joins and leaves move ``alive()``; each agreed roster is one epoch;
+    the transition log stays monotonic."""
+    store = RendezvousStore(str(tmp_path))
+    for m in ("w0", "w1", "w2"):
+        store.join(m)
+    assert store.alive() == ["w0", "w1", "w2"]
+    assert store.epoch() == {"epoch": -1, "roster": []}
+
+    rec0 = store.propose(store.alive(), epoch=0)
+    assert rec0["epoch"] == 0 and rec0["roster"] == ["w0", "w1", "w2"]
+
+    store.leave("w1")
+    assert store.alive() == ["w0", "w2"]
+    assert "w1" in store.dead()
+    store.ack(1, "w2")  # the other survivor's barrier ack (single caller)
+    rec1 = store.transition("w0")
+    assert rec1["epoch"] == 1 and rec1["roster"] == ["w0", "w2"]
+    assert rec1["prev_roster"] == ["w0", "w1", "w2"]
+
+    # A rejoin under the old name clears the tombstone.
+    store.join("w1")
+    assert store.alive() == ["w0", "w1", "w2"]
+    epochs = [r["epoch"] for r in store.history()]
+    assert epochs == sorted(epochs) == [0, 1]
+
+
+def test_rendezvous_simultaneous_death_single_transition(tmp_path):
+    """Two members tombstoned at once: the survivors run ONE transition
+    (epoch k+1 with both gone), not one per death — and every survivor
+    returns the identical record."""
+    store = RendezvousStore(str(tmp_path))
+    world = ["w0", "w1", "w2", "w3"]
+    for m in world:
+        store.join(m)
+    store.propose(world, epoch=0)
+    store.mark_dead("w1")
+    store.mark_dead("w3")
+
+    results = {}
+
+    def run(name):
+        results[name] = store.transition(name, timeout_s=10.0)
+
+    threads = [threading.Thread(target=run, args=(m,)) for m in ("w0", "w2")]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=15.0)
+    assert results["w0"] == results["w2"]
+    assert results["w0"]["epoch"] == 1
+    assert results["w0"]["roster"] == ["w0", "w2"]
+
+
+def test_rendezvous_join_transition(tmp_path):
+    """A grow: a new member joins, every member (incumbents + joiner)
+    transitions concurrently, and epoch k+1 includes the joiner."""
+    store = RendezvousStore(str(tmp_path))
+    for m in ("w0", "w1"):
+        store.join(m)
+    store.propose(["w0", "w1"], epoch=0)
+    store.join("w2")
+
+    results = {}
+
+    def run(name):
+        results[name] = store.transition(name, timeout_s=10.0)
+
+    threads = [
+        threading.Thread(target=run, args=(m,))
+        for m in ("w0", "w1", "w2")
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=15.0)
+    assert len({r["epoch"] for r in results.values()}) == 1
+    assert results["w0"]["epoch"] == 1
+    assert results["w0"]["roster"] == ["w0", "w1", "w2"]
+
+
+def test_rendezvous_tcp_transport(tmp_path):
+    """The socket front-end is duck-typed with the store: members that
+    share no filesystem run the same join/kill/transition protocol, and
+    concurrent client transitions agree."""
+    store = RendezvousStore(str(tmp_path))
+    with TCPRendezvousServer(store) as srv:
+        with TCPRendezvousClient(srv.address) as c:
+            c.join("w0")
+            c.join("w1")
+            c.join("w2")
+            assert c.alive() == ["w0", "w1", "w2"]
+            c.propose(["w0", "w1", "w2"])
+            assert c.epoch()["epoch"] == 0
+            c.mark_dead("w2")
+            assert c.dead() == ["w2"]
+
+        results = {}
+
+        def run(name):
+            with TCPRendezvousClient(srv.address) as cli:
+                results[name] = cli.transition(name)
+
+        threads = [
+            threading.Thread(target=run, args=(m,)) for m in ("w0", "w1")
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=15.0)
+        assert results["w0"] == results["w1"]
+        assert results["w0"]["epoch"] == 1
+        assert results["w0"]["roster"] == ["w0", "w1"]
+
+        # Errors cross the wire as structured replies, not dead sockets.
+        with TCPRendezvousClient(srv.address) as c:
+            with pytest.raises(RuntimeError, match="surviving"):
+                c.transition("w2")
+
+
+def test_coordinator_kill_poll_decision(tmp_path):
+    """The single-process gang: chaos kills a rank index, the next poll
+    agrees on the shrunk roster and reports who left."""
+    world = [f"proc{i}" for i in range(4)]
+    gang = ElasticGangCoordinator(str(tmp_path), world=world, min_size=1)
+    rec = gang.start()
+    assert rec["epoch"] == 0 and rec["roster"] == sorted(world)
+    assert gang.poll() is None  # stable membership: cheap no-op
+
+    gang.kill("2")  # chaos rank-index form, maps to proc2
+    decision = gang.poll()
+    assert decision is not None
+    assert decision.epoch == 1
+    assert decision.left == ("proc2",)
+    assert decision.joined == ()
+    assert decision.old_size == 4 and decision.new_size == 3
+    assert gang.poll() is None  # agreed: nothing further to do
+
+    gang.kill("proc0")  # direct-name form
+    with pytest.raises(RuntimeError, match="below --min-procs"):
+        ElasticGangCoordinator(
+            str(tmp_path), world=["proc1", "proc3"], min_size=3
+        ).poll()
+
+
+# -- checkpoint-free shrink: bitwise parity vs elastic_restore -----------
+
+
+def _cfg(**over):
+    base = dict(
+        num_layers=2, num_heads=2, d_model=32, d_ff=64, max_seq_len=32,
+    )
+    base.update(over)
+    return tiny_lm(**base)
+
+
+def _mesh(n):
+    return Mesh(np.array(jax.devices()[:n]), ("data",))
+
+
+def _batches(k=3, rows=56, vocab=256):
+    # 56 rows: divisible by BOTH 8 and 7, so the same global batch shards
+    # cleanly before and after the shrink.
+    rng = np.random.default_rng(0)
+    return [
+        rng.integers(0, vocab, size=(rows, 17)).astype(np.int32)
+        for _ in range(k)
+    ]
+
+
+def _loss_fn(model):
+    def loss_fn(p, batch, rng):
+        toks = batch["tokens"]
+        logits = model.apply({"params": p}, toks[:, :-1])
+        return lm_cross_entropy(logits, toks[:, 1:]), {}
+
+    return loss_fn
+
+
+def _assert_bitwise(tree_a, tree_b, what):
+    la, lb = jax.tree.leaves(tree_a), jax.tree.leaves(tree_b)
+    assert len(la) == len(lb), what
+    for i, (a, b) in enumerate(zip(la, lb)):
+        a = np.asarray(jax.device_get(a))
+        b = np.asarray(jax.device_get(b))
+        assert a.dtype == b.dtype and a.shape == b.shape, (what, i)
+        assert a.tobytes() == b.tobytes(), f"{what}: leaf {i} differs"
+
+
+@pytest.mark.parametrize("zero", [0, 1], ids=["dp", "zero1"])
+def test_checkpoint_free_shrink_bitwise(tmp_path, devices, zero):
+    """The acceptance invariant: 8 -> 7 via ``reshard_live_state`` (host
+    round-trip of the LIVE arrays, no checkpoint anywhere) is bitwise
+    identical — params, opt state, step counter — to a 7-device
+    ``elastic_restore`` through a real checkpoint of the same state, and
+    the two continuations produce bitwise-equal losses."""
+    # d_model 28 / vocab 251: park the param count off the chunk
+    # alignment so the ZeRO-1 flats' padded sizes differ between 8 and 7.
+    cfg = _cfg(vocab_size=251, d_model=28, d_ff=52, num_layers=3)
+    model = TransformerLM(cfg)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 16), jnp.int32)
+    )["params"]
+    tx = optax.adam(1e-2)
+    batches = _batches(vocab=251)
+    loss_fn = _loss_fn(model)
+
+    def fresh(mesh):
+        if zero:
+            st = ddp.zero_state(
+                apply_fn=model.apply, params=params, tx=tx, mesh=mesh
+            )
+        else:
+            st = ddp.TrainState.create(
+                apply_fn=model.apply, params=params, tx=tx
+            )
+            st = ddp.broadcast_params(st, mesh)
+        step = ddp.make_train_step(
+            loss_fn, mesh=mesh, zero=bool(zero), donate=False
+        )
+        return st, step
+
+    mesh8, mesh7 = _mesh(8), _mesh(7)
+    st8, step8 = fresh(mesh8)
+    for t in batches[:2]:
+        st8, _ = step8(
+            st8, shard_batch({"tokens": t}, mesh8), jax.random.PRNGKey(0)
+        )
+
+    if zero:
+        # Precondition: the flat opt shapes REALLY differ across the two
+        # topologies, or the reshard under test is vacuous.
+        st7_probe = ddp.zero_state(
+            apply_fn=model.apply, params=params, tx=tx, mesh=mesh7
+        )
+        olds = {l.shape for l in jax.tree.leaves(st8.opt_state)
+                if l.ndim == 1}
+        news = {l.shape for l in jax.tree.leaves(st7_probe.opt_state)
+                if l.ndim == 1}
+        assert olds != news, (olds, news)
+        del st7_probe
+
+    # Path A: checkpoint-free — the live state moves host-side.
+    st_live = reshard_live_state(st8, mesh8, mesh7, zero=zero)
+
+    # Path B: the pre-elastic story — save, fresh 7-device state, restore.
+    ckpt = Checkpointer(str(tmp_path))
+    ckpt.save(
+        st8, 0, meta=topology_meta(mesh8, "zero1" if zero else "replicated")
+    )
+    ckpt.wait()
+    st7, step7 = fresh(mesh7)
+    st_ckpt, next_epoch = elastic_restore(
+        ckpt, st7, mesh7, layout="zero1" if zero else "replicated"
+    )
+    assert next_epoch == 1
+
+    _assert_bitwise(st_live.params, st_ckpt.params, "params")
+    _assert_bitwise(st_live.opt_state, st_ckpt.opt_state, "opt_state")
+    assert int(st_live.step) == int(st_ckpt.step) == 2
+
+    # Same executable, bitwise-same inputs -> bitwise-same continuation.
+    t = batches[2]
+    st_live, m_live = step7(
+        st_live, shard_batch({"tokens": t}, mesh7), jax.random.PRNGKey(0)
+    )
+    st_ckpt, m_ckpt = step7(
+        st_ckpt, shard_batch({"tokens": t}, mesh7), jax.random.PRNGKey(0)
+    )
+    assert float(m_live["loss"]) == float(m_ckpt["loss"])
+    _assert_bitwise(st_live.params, st_ckpt.params, "post-step params")
+
+
+def test_reshard_live_state_rejects_zero23(devices):
+    cfg = _cfg()
+    model = TransformerLM(cfg)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 16), jnp.int32)
+    )["params"]
+    mesh8 = _mesh(8)
+    st = ddp.TrainState.create(
+        apply_fn=model.apply, params=params, tx=optax.sgd(0.1)
+    )
+    st = ddp.broadcast_params(st, mesh8)
+    with pytest.raises(ValueError, match="ZeRO-2/3"):
+        reshard_live_state(st, mesh8, _mesh(7), zero=2)
+
+
+# -- exactly-once data coverage across a mid-epoch resize ----------------
+
+
+@pytest.mark.parametrize(
+    "old_world,new_world,consumed_steps",
+    [(8, 7, 3), (8, 7, 0), (4, 3, 5), (4, 6, 2)],
+)
+def test_resize_plan_exactly_once(old_world, new_world, consumed_steps):
+    """The consumed prefix and the resize plan partition the epoch's
+    permutation: disjoint, no duplicates, and together they cover every
+    sample except the (< B * new_world) drop-last remainder."""
+    n, B, seed, epoch = 256, 4, 7, 2
+    plan = resize_index_plan(
+        n, per_replica_batch=B, old_world=old_world, new_world=new_world,
+        consumed_steps=consumed_steps, seed=seed, epoch=epoch,
+        membership_epoch=1,
+    )
+    assert plan.shape[0] == new_world
+    assert plan.shape[1] % B == 0
+
+    perm = np.random.default_rng(seed + epoch).permutation(n)
+    consumed = set(perm[: consumed_steps * B * old_world].tolist())
+    planned = plan.ravel().tolist()
+    assert len(planned) == len(set(planned)), "duplicate sample in plan"
+    assert not (set(planned) & consumed), "resize replays consumed samples"
+    remaining = n - len(consumed)
+    dropped = remaining - len(planned)
+    assert 0 <= dropped < B * new_world, (remaining, len(planned))
+    assert set(planned) | consumed <= set(range(n))
+
+
+def test_resize_plan_membership_epoch_reshuffles():
+    """A second resize in the same data epoch must not replay the first
+    resize's order: the tail permutation is keyed on the MEMBERSHIP
+    epoch.  Both plans draw only from the unconsumed remainder (which
+    samples fall to drop-last shifts with the order — the per-pass
+    exactly-once contract is plan ∪ dropped, tested above)."""
+    kw = dict(per_replica_batch=4, old_world=8, new_world=7,
+              consumed_steps=2, seed=0, epoch=0)
+    a = resize_index_plan(256, membership_epoch=1, **kw)
+    b = resize_index_plan(256, membership_epoch=2, **kw)
+    assert a.shape == b.shape
+    assert a.ravel().tolist() != b.ravel().tolist()
+    perm = np.random.default_rng(0).permutation(256)
+    remaining = set(perm[2 * 4 * 8:].tolist())
+    assert set(a.ravel().tolist()) <= remaining
+    assert set(b.ravel().tolist()) <= remaining
+    # ... and every survivor computes the same plan (pure function).
+    assert np.array_equal(a, resize_index_plan(256, membership_epoch=1, **kw))
+
+
+# -- supervised chaos-kill acceptance run --------------------------------
+
+
+def test_supervised_worker_kill_resizes_without_restart(tmp_path):
+    """The end-to-end acceptance bar: a supervised 8-member CPU gang
+    loses one worker to chaos mid-run; the supervisor must RESIZE-respawn
+    at 7 (no restart budget burned), the run must finish, and the merged
+    timeline must show ``gang_resize`` with no ``restart_attempt`` and
+    no checkpoint restore anywhere."""
+    from distributeddataparallel_tpu.observability.events import (
+        load_timeline,
+    )
+
+    repo = str(pathlib.Path(__file__).resolve().parents[1])
+    ev = str(tmp_path / "ev")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("_DDP_SUPERVISED", None)
+    env.pop("DDP_ELASTIC_WORLD", None)
+    r = subprocess.run(
+        [
+            sys.executable, str(pathlib.Path(repo) / "dpp.py"),
+            "--device", "cpu", "--model", "mlp",
+            "--fake-devices", "8", "--batch-size", "4",
+            "--epochs", "1", "--steps-per-epoch", "10",
+            "--elastic",
+            # worker-kill tombstones rank 2, preempt kills the gang at
+            # the same step: the supervisor sees a shrunk roster and must
+            # take the resize path, not the restart path.
+            "--chaos", "worker-kill@4:2,preempt@4",
+            "--max-restarts", "1",
+            "--checkpoint-dir", str(tmp_path / "ck"),
+            "--events-dir", ev,
+        ],
+        capture_output=True, text=True, timeout=300, env=env, cwd=repo,
+    )
+    assert r.returncode == 0, (r.stdout + r.stderr)[-2000:]
+    log = r.stdout + r.stderr
+    assert "7 device(s), 7-way DP" in log, log[-2000:]
+
+    records = load_timeline(ev)
+    kinds = [rec.get("kind") for rec in records]
+    assert kinds.count("gang_resize") == 1, kinds
+    assert "resize_downtime" in kinds
+    assert "restart_attempt" not in kinds, kinds
+    # Checkpoint-free: nothing durable existed for the respawn to read —
+    # no ckpt activity anywhere before the resize landed (the epoch-edge
+    # save AFTER the resize is normal).
+    t_resize = next(rec["ts"] for rec in records
+                    if rec.get("kind") == "gang_resize")
+    assert not any(
+        rec.get("kind") == "span" and "ckpt" in str(rec.get("name"))
+        and rec["ts"] <= t_resize
+        for rec in records
+    ), kinds
+    resize = next(rec for rec in records if rec.get("kind") == "gang_resize")
+    assert resize["old_size"] == 8 and resize["new_size"] == 7
