@@ -162,6 +162,33 @@ class RestartStorm(AlertRule):
         )
 
 
+class SdcStorm(AlertRule):
+    """Silent-data-corruption detections (``training.integrity``)
+    reached ``max_detects`` — one flip is a cosmic ray, a stream of them
+    is failing hardware that eviction alone will not outrun (or a
+    misconfigured digest domain flagging legitimate divergence).
+    Detection count is monotone, so the alert fires at most once."""
+
+    name = "sdc_storm"
+
+    def __init__(self, max_detects: int = 2):
+        if max_detects < 1:
+            raise ValueError(
+                f"sdc_storm threshold must be >= 1, got {max_detects}"
+            )
+        self.max_detects = max_detects
+
+    def evaluate(self, signals):
+        detects = signals.get("sdc_detects")
+        if detects is None:
+            return None
+        return (
+            detects >= self.max_detects,
+            False,  # monotone: never clears, never re-fires
+            {"value": int(detects), "threshold": self.max_detects},
+        )
+
+
 class LoaderStarvation(AlertRule):
     """Prefetch queue empty at ``windows`` consecutive boundaries: the
     input pipeline is gating the step loop (the live counterpart of the
@@ -233,7 +260,7 @@ class MemoryGrowth(AlertRule):
 RULE_CLASSES = {
     cls.name: cls
     for cls in (StepTimeSpike, MfuFloor, GoodputFloor, RestartStorm,
-                LoaderStarvation, MemoryGrowth)
+                SdcStorm, LoaderStarvation, MemoryGrowth)
 }
 
 
@@ -273,7 +300,9 @@ def parse_alert_spec(spec: str | None) -> list[AlertRule]:
     for name, cls in RULE_CLASSES.items():
         if name in overrides:
             v = overrides[name]
-            rules.append(cls(int(v) if name == "restart_storm" else v))
+            rules.append(
+                cls(int(v) if name in ("restart_storm", "sdc_storm") else v)
+            )
         else:
             rules.append(cls())
     return rules

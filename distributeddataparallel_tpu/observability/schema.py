@@ -45,6 +45,14 @@ EVENT_KINDS: dict[str, tuple[str, ...]] = {
     "chaos_inject": ("entry", "step"),
     "restart_attempt": ("attempt",),
     "restart_exhausted": ("attempt",),
+    # Silent-data-corruption defense (training.integrity): the periodic
+    # replica-digest check, a detection (rank = corrupt rank by majority
+    # vote / replay tiebreak, or -1 for an unattributed shadow-mode
+    # transient), and the checkpoint-free eviction of the corrupt rank
+    # through the elastic gang.
+    "sdc_check": ("step", "ok"),
+    "sdc_detect": ("step", "rank"),
+    "sdc_evict": ("step", "rank"),
     # Elastic gang runtime (runtime.elastic_gang / rendezvous):
     "membership_epoch": ("epoch", "roster", "size"),
     "gang_resize": ("epoch", "old_size", "new_size"),

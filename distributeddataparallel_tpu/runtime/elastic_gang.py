@@ -215,7 +215,7 @@ def _flat_geometry(state, old_mesh, data_axis: str):
 
 
 def reshard_live_state(state, old_mesh, new_mesh, *, zero: int = 0,
-                       data_axis: str = "data"):
+                       data_axis: str = "data", source: int | None = None):
     """Checkpoint-free reshard: live train state at N devices -> the same
     logical state placed on ``new_mesh`` (M devices), via a host round
     trip of the live arrays.
@@ -230,6 +230,13 @@ def reshard_live_state(state, old_mesh, new_mesh, *, zero: int = 0,
 
     Transient host memory: one full host copy of the state exists between
     the device_get and the device_put (see MEMFIT.md "Elastic resize").
+
+    ``source`` (optional) names the old mesh's data-axis position whose
+    buffer re-replicates the replicated leaves.  ``jax.device_get`` of a
+    replicated array reads device 0's shard — fine after a worker kill,
+    WRONG after an SDC eviction when rank 0 is the corrupt one: its
+    physically divergent buffer would silently become the new truth.
+    The integrity loop passes a voted-healthy rank here.
     """
     import jax
     import numpy as np
@@ -249,13 +256,31 @@ def reshard_live_state(state, old_mesh, new_mesh, *, zero: int = 0,
         _, true, padded_old = _flat_geometry(state, old_mesh, data_axis)
         padded_new, _ = flat_size(state.params, new_mesh.shape[data_axis])
 
+    src_device = None
+    if source is not None:
+        old_devs = old_mesh.devices.reshape(-1)
+        if not (0 <= source < old_devs.size):
+            raise ValueError(
+                f"reshard source rank {source} out of range for the "
+                f"{old_devs.size}-device old mesh"
+            )
+        src_device = old_devs[source]
+
     def move(leaf):
-        arr = np.asarray(jax.device_get(leaf))
         spec = (
             leaf.sharding.spec
             if isinstance(getattr(leaf, "sharding", None), NamedSharding)
             else P()
         )
+        if src_device is not None and not tuple(p for p in spec if p):
+            # Replicated leaf: read the chosen healthy rank's physical
+            # buffer, not whatever shard device_get happens to pick.
+            arr = next(
+                np.asarray(s.data) for s in leaf.addressable_shards
+                if s.device == src_device
+            )
+        else:
+            arr = np.asarray(jax.device_get(leaf))
         if (
             zero
             and arr.ndim == 1

@@ -140,12 +140,31 @@ class RendezvousStore:
 
     def epoch(self) -> dict:
         """Current agreed epoch record ({"epoch": -1, "roster": []} before
-        the first transition)."""
-        try:
-            with open(os.path.join(self.root, "epoch.json")) as fh:
-                return json.loads(fh.read())
-        except (FileNotFoundError, json.JSONDecodeError):
-            return {"epoch": -1, "roster": []}
+        the first transition).
+
+        A missing file genuinely means "no transition yet".  A file that
+        EXISTS but fails to decode is a torn read — e.g. a non-atomic
+        overwrite from an out-of-tree writer, or a filesystem whose
+        rename is not atomic under the reader (NFS) — and defaulting
+        there would silently reset the epoch to -1 and fork the gang's
+        membership history.  Retry briefly (writers replace the file in
+        well under a second) and raise if the corruption persists.
+        """
+        path = os.path.join(self.root, "epoch.json")
+        last_err = None
+        for _ in range(5):
+            try:
+                with open(path) as fh:
+                    return json.loads(fh.read())
+            except FileNotFoundError:
+                return {"epoch": -1, "roster": []}
+            except json.JSONDecodeError as exc:
+                last_err = exc
+                time.sleep(0.05)
+        raise RuntimeError(
+            f"rendezvous epoch.json at {path!r} is persistently "
+            f"unparseable ({last_err}) — torn or corrupt epoch record"
+        )
 
     def roster(self) -> list[str]:
         return list(self.epoch().get("roster", []))

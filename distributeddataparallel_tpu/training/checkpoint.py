@@ -9,6 +9,7 @@ single logical write).
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 from typing import Any
@@ -17,6 +18,32 @@ import jax
 import orbax.checkpoint as ocp
 
 Pytree = Any
+
+
+def state_content_hash(state: Pytree) -> str:
+    """sha256 over the state's leaf CONTENTS, in flatten-with-path order.
+
+    Covers name + dtype + shape + raw bytes per leaf, so two states hash
+    equal iff they are structurally identical and bitwise identical —
+    the checkpoint-integrity analog of the in-step replica digest
+    (``training.integrity``), but collision-resistant: this one defends
+    the restore path, where an adversarially unlucky corruption must
+    not slip through.  Device arrays are read through ``device_get``
+    (shard 0 of a replicated array — the same bytes orbax serializes).
+    """
+    import numpy as np
+
+    h = hashlib.sha256()
+    flat, _ = jax.tree.flatten_with_path(state)
+    for path, leaf in flat:
+        arr = np.asarray(jax.device_get(leaf))
+        name = "/".join(
+            str(getattr(k, "key", getattr(k, "idx", getattr(k, "name", k))))
+            for k in path
+        )
+        h.update(f"{name}|{arr.dtype}|{arr.shape}|".encode())
+        h.update(np.ascontiguousarray(arr).tobytes())
+    return h.hexdigest()
 
 
 class Checkpointer:
@@ -39,6 +66,18 @@ class Checkpointer:
         device count reshard the flat layouts (sidecar, not part of the
         pytree: orbax owns the step dir's contents and atomicity)."""
         self._mgr.save(epoch, args=ocp.args.StandardSave(_arrays_only(state)))
+        if jax.process_index() == 0:
+            # Content-hash sidecar: sha256 of the serialized leaves,
+            # verified on restore BEFORE the state is trusted — orbax
+            # catches truncated/unparseable steps, but a corrupted-yet-
+            # parseable array file restores silently without this.
+            # Computed from the live state (async orbax snapshots the
+            # same values at save-call time) and written tmp+replace
+            # like the meta sidecar.
+            tmp = os.path.join(self._dir, f".hash_{epoch}.tmp")
+            with open(tmp, "w") as fh:
+                json.dump({"sha256": state_content_hash(state)}, fh)
+            os.replace(tmp, os.path.join(self._dir, f"hash_{epoch}.json"))
         if meta is not None and jax.process_index() == 0:
             # Multi-host note: only process 0 writes sidecars, so
             # read_meta on other hosts assumes the checkpoint directory
@@ -49,7 +88,7 @@ class Checkpointer:
             with open(tmp, "w") as fh:
                 json.dump(meta, fh)
             os.replace(tmp, os.path.join(self._dir, f"meta_{epoch}.json"))
-            self._prune_sidecars(keep={epoch})
+        self._prune_sidecars(keep={epoch})
 
     def _prune_sidecars(self, keep: set | None = None) -> None:
         """Remove meta sidecars for steps the manager no longer tracks.
@@ -65,16 +104,17 @@ class Checkpointer:
         # keep: a step mid-async-save may not appear in all_steps() yet —
         # never sweep its just-written sidecar.
         live = set(self._mgr.all_steps()) | (keep or set())
-        for p in glob.glob(os.path.join(self._dir, "meta_*.json")):
-            try:
-                s = int(os.path.basename(p)[5:-5])
-            except ValueError:
-                continue
-            if s not in live:
+        for prefix in ("meta_", "hash_"):
+            for p in glob.glob(os.path.join(self._dir, f"{prefix}*.json")):
                 try:
-                    os.remove(p)
-                except OSError:
-                    pass
+                    s = int(os.path.basename(p)[len(prefix):-5])
+                except ValueError:
+                    continue
+                if s not in live:
+                    try:
+                        os.remove(p)
+                    except OSError:
+                        pass
 
     def latest_step(self) -> int | None:
         return self._mgr.latest_step()
@@ -86,6 +126,15 @@ class Checkpointer:
         with open(path) as fh:
             return json.load(fh)
 
+    def read_hash(self, step: int) -> str | None:
+        """The saved content hash for ``step`` (None = pre-hash-sidecar
+        checkpoint, verified as legacy: structure checks only)."""
+        path = os.path.join(self._dir, f"hash_{step}.json")
+        if not os.path.exists(path):
+            return None
+        with open(path) as fh:
+            return json.load(fh).get("sha256")
+
     def restore_latest(
         self, state: Pytree, *, template: Pytree | None = None
     ) -> tuple[Pytree, int]:
@@ -95,6 +144,14 @@ class Checkpointer:
         ``template`` overrides the restore target (same treedef, possibly
         different leaf shapes/placements — the elastic-reshard hook);
         the restored tree is then returned RAW for the caller to re-place.
+
+        Same-topology restores verify the content-hash sidecar before
+        the state is trusted: a corrupted-but-parseable checkpoint
+        raises ValueError here, which ``ResilientCheckpointer`` treats
+        like any other corrupt step (quarantine + fall back to the next
+        older one).  The elastic-reshard path skips verification — the
+        restored leaves are repartitioned for a different device count,
+        so they legitimately no longer hash to the saved value.
         """
         step = self._mgr.latest_step()
         if step is None:
@@ -103,6 +160,15 @@ class Checkpointer:
             restored = self._restore(step, template)
             return restored, step + 1
         restored = self._restore(step, _arrays_only(state))
+        saved = self.read_hash(step)
+        if saved is not None:
+            actual = state_content_hash(restored)
+            if actual != saved:
+                raise ValueError(
+                    f"checkpoint step {step} failed content-hash "
+                    f"verification (saved sha256 {saved[:12]}…, restored "
+                    f"{actual[:12]}…) — corrupted-but-parseable state"
+                )
         state = _merge_arrays(state, restored)
         return state, step + 1
 

@@ -1,0 +1,386 @@
+"""Silent-data-corruption (SDC) defense: replica fingerprints, corrupt-rank
+voting, and checkpoint-free eviction glue.
+
+Threat model.  The reliability stack already catches faults that ANNOUNCE
+themselves — NaN gradients, hung steps, dead workers, failed checkpoint
+I/O.  What it cannot catch is a rank that computes finite-but-WRONG
+values: an HBM or datapath bit flip leaves that replica's "replicated"
+train state silently diverged, its polluted gradients spread to every
+survivor through the next all-reduce, and every subsequent checkpoint is
+poisoned.  Fleet reports put this among the dominant failure modes at
+TPU scale (arXiv:2204.06514 §5).
+
+Defense, in four parts:
+
+1. **Fingerprint** (this module + the digest plumbing in
+   ``make_train_step(integrity_every=N)``): after a synchronized update,
+   DP replicas must agree BITWISE — same averaged grads applied to the
+   same params.  So a per-rank digest of the state's bit patterns is a
+   perfect replica-consistency probe.  ``tree_digest`` sums each leaf's
+   bits viewed as uint32 (mod 2**32 — integer addition is associative,
+   so the reduction order XLA picks cannot change the answer, unlike a
+   float checksum) and stacks one scalar per leaf.  The train step
+   computes it on its INPUT state every N steps under ``lax.cond`` and
+   ``all_gather``s the (n_ranks, n_leaves) matrix so every rank holds
+   every rank's digest: one extra sub-kilobyte collective on cadence,
+   zero extra host syncs off cadence, no resident state between steps.
+
+2. **Attribution** (``vote``): rows of the gathered matrix are compared
+   host-side.  The strict-majority row is ground truth (corruption on a
+   majority of ranks in one cadence window is out of model); minority
+   rows name the corrupt rank(s) and the differing columns name the
+   leaves.  A 2-rank gang has no majority — ``ShadowArbiter`` breaks the
+   tie by replaying the held steps from the last clean snapshot and
+   matching live rows against the recomputed digest.
+
+3. **Containment**: the step that DETECTS a mismatch also DISCARDS its
+   own update (nonfinite-guard-style whole-state select on the verdict,
+   step counter still advances), because the corrupt rank's gradients
+   already entered that step's all-reduce.  Survivors therefore still
+   hold a verified-clean state at eviction time.
+
+4. **Eviction** (wired in dpp.py): the corrupt rank is tombstoned in the
+   rendezvous store and the elastic coordinator shrinks the mesh exactly
+   as for a worker kill.  The survivors' live state IS the repair — no
+   rollback, no checkpoint read, restart budget untouched.
+   ``reshard_live_state(..., source=healthy_rank)`` re-replicates from
+   an explicitly healthy device, never from the evicted one.
+
+``--integrity-shadow`` covers the DP=1 hole (no replica to vote
+against): on cadence the host re-runs the step on a copy of the same
+inputs and compares digests — a disagreement between two runs of one
+deterministic program on one device is transient compute SDC.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import Counter
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+Pytree = Any
+
+
+# -- digests -------------------------------------------------------------
+
+
+def leaf_digest(x: jax.Array) -> jax.Array:
+    """Scalar uint32 fingerprint of one leaf's BIT PATTERN.
+
+    Floats are bitcast (never value-converted: -0.0 vs 0.0 and NaN
+    payloads must stay distinguishable — value semantics would hide
+    exactly the flips this exists to catch), then summed as uint32 with
+    mod-2**32 wraparound.  Integer summation is order-independent, so
+    the digest is deterministic across XLA reduction strategies.
+    """
+    if jnp.issubdtype(x.dtype, jnp.floating):
+        n = x.dtype.itemsize
+        if n == 2:
+            v = lax.bitcast_convert_type(x, jnp.uint16).astype(jnp.uint32)
+        elif n == 1:
+            v = lax.bitcast_convert_type(x, jnp.uint8).astype(jnp.uint32)
+        else:
+            # f32 -> uint32 1:1; f64 -> trailing dim of two uint32 halves.
+            v = lax.bitcast_convert_type(x, jnp.uint32)
+    elif x.dtype == jnp.bool_:
+        v = x.astype(jnp.uint32)
+    else:
+        v = x.astype(jnp.uint32)
+    return jnp.sum(v, dtype=jnp.uint32)
+
+
+def digest_parts(state, zero_level: int = 0) -> dict:
+    """The sub-pytrees of ``state`` that must be bitwise-replicated
+    across DP ranks after a synchronized update — the digest domain.
+
+    ZeRO-1 keeps full replicated params but shards the optimizer flats,
+    so only params (+ model buffers) are comparable there.  comm_state
+    (PowerSGD error feedback) is per-replica divergent BY DESIGN and is
+    never digested.
+    """
+    parts = {"params": state.params}
+    if zero_level == 0:
+        parts["opt_state"] = state.opt_state
+    if state.model_state:
+        parts["model_state"] = state.model_state
+    return parts
+
+
+def tree_digest(tree: Pytree) -> jax.Array:
+    """(n_leaves,) uint32 vector — one ``leaf_digest`` per leaf, in
+    flatten order (matches ``digest_leaf_names``)."""
+    return jnp.stack([leaf_digest(l) for l in jax.tree.leaves(tree)])
+
+
+def digest_leaf_names(tree: Pytree) -> list[str]:
+    """Human-readable names for the digest vector's columns."""
+    flat, _ = jax.tree.flatten_with_path(tree)
+    names = []
+    for path, _leaf in flat:
+        parts = [
+            str(getattr(k, "key", getattr(k, "idx", getattr(k, "name", k))))
+            for k in path
+        ]
+        names.append("/".join(parts))
+    return names
+
+
+def make_digest_fn(mesh, axis_name: str = "data",
+                   zero_level: int = 0) -> Callable:
+    """Standalone jitted ``fn(state) -> (n_ranks, n_leaves) uint32``
+    digest matrix — the same fingerprint the train step computes
+    in-program, for host-driven checks (shadow verification, the 2-rank
+    replay tiebreak) that run OUTSIDE the step.
+
+    check_vma=False so each mesh position digests ITS OWN buffer of a
+    "replicated" array — physical divergence is the entire signal.
+    """
+    def _digest(state):
+        d = tree_digest(digest_parts(state, zero_level))
+        return lax.all_gather(d, axis_name)
+
+    return jax.jit(jax.shard_map(
+        _digest, mesh=mesh, in_specs=(P(),), out_specs=P(),
+        check_vma=False,
+    ))
+
+
+def copy_tree(tree: Pytree) -> Pytree:
+    """Independent device-side copy of a (possibly donated-soon) pytree.
+
+    ``jnp.copy`` runs per-device, so a physically divergent replicated
+    buffer stays divergent in the copy — an identity jit could alias the
+    input via input-output forwarding and would not survive donation.
+    """
+    return jax.tree.map(jnp.copy, tree)
+
+
+# -- fault injection (chaos `bitflip` backend) ---------------------------
+
+
+def apply_bitflip(state, *, rank: int, mesh, leaf: str | None = None,
+                  bit: int = 1, axis_name: str = "data"):
+    """XOR one bit of one param leaf on ONE mesh position — the HBM
+    single-event-upset model.  Returns the state with the flipped
+    params; every other position's buffer is bit-identical, so the
+    array is still "replicated" as far as JAX knows.
+
+    ``leaf`` selects by substring of the flatten-path name (first match;
+    None = first leaf).  ``bit`` defaults to a low mantissa bit so the
+    value stays finite and the corruption is invisible to the
+    non-finite guard — the hard case this subsystem exists for.
+    """
+    names = digest_leaf_names({"params": state.params})
+    names = [n.removeprefix("params/") for n in names]
+    if leaf is None:
+        target = 0
+    else:
+        matches = [i for i, n in enumerate(names) if leaf in n]
+        if not matches:
+            raise ValueError(
+                f"bitflip: no param leaf matching {leaf!r} "
+                f"(leaves: {names})"
+            )
+        target = matches[0]
+    n_ranks = mesh.shape[axis_name]
+    if not (0 <= rank < n_ranks):
+        raise ValueError(
+            f"bitflip: rank {rank} out of range for {n_ranks}-way "
+            f"{axis_name!r} axis"
+        )
+
+    def _flip(params):
+        leaves, treedef = jax.tree.flatten(params)
+        x = leaves[target]
+        if not jnp.issubdtype(x.dtype, jnp.floating):
+            raise ValueError(
+                f"bitflip targets float leaves; {names[target]!r} is "
+                f"{x.dtype}"
+            )
+        n = x.dtype.itemsize
+        ut = {4: jnp.uint32, 2: jnp.uint16, 1: jnp.uint8}.get(n, jnp.uint32)
+        u = lax.bitcast_convert_type(x, ut)
+        mask = jnp.zeros(u.shape, ut).at[(0,) * u.ndim].set(
+            ut(1 << (bit % (8 * min(n, 4))))
+        )
+        armed = (lax.axis_index(axis_name) == rank).astype(ut)
+        leaves[target] = lax.bitcast_convert_type(u ^ (mask * armed), x.dtype)
+        return jax.tree.unflatten(treedef, leaves)
+
+    flipped = jax.jit(jax.shard_map(
+        _flip, mesh=mesh, in_specs=(P(),), out_specs=P(), check_vma=False,
+    ))(state.params)
+    return state.replace(params=flipped)
+
+
+# -- attribution ---------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class SdcVerdict:
+    """Outcome of one on-cadence integrity check."""
+
+    ok: bool
+    corrupt: tuple[int, ...] = ()   # rank indices voted out
+    leaves: tuple[str, ...] = ()    # digest columns that disagreed
+    tie: bool = False               # no strict majority (unresolved)
+    method: str = "vote"            # "vote" | "replay" | "shadow"
+
+
+def vote(matrix: np.ndarray,
+         leaf_names: Sequence[str] | None = None) -> SdcVerdict:
+    """Majority-vote attribution over a (n_ranks, n_leaves) digest
+    matrix.  The strict-majority row is ground truth; every other row's
+    rank is corrupt.  No strict majority (the 2-rank split, or >=3 ranks
+    all disagreeing) -> ``tie=True`` and the caller escalates to the
+    replay tiebreak."""
+    rows = [tuple(int(v) for v in r) for r in np.asarray(matrix)]
+    ref, count = Counter(rows).most_common(1)[0]
+    if count == len(rows):
+        return SdcVerdict(ok=True)
+    if 2 * count <= len(rows):
+        return SdcVerdict(ok=False, tie=True)
+    corrupt = tuple(i for i, r in enumerate(rows) if r != ref)
+    bad_cols = sorted({
+        j for i in corrupt for j in range(len(ref))
+        if rows[i][j] != ref[j]
+    })
+    leaves = tuple(
+        leaf_names[j] if leaf_names else str(j) for j in bad_cols
+    )
+    return SdcVerdict(ok=False, corrupt=corrupt, leaves=leaves)
+
+
+class ShadowArbiter:
+    """2-rank (no-majority) tiebreak: recompute the digest by REPLAY.
+
+    At every clean on-cadence check the host snapshots the step's input
+    state (replicas agree bitwise there, so the host copy is trustworthy)
+    and starts holding the (batch, rng) pairs it feeds the step.  On a
+    tied mismatch, the held steps are replayed from the snapshot — the
+    flip was a one-time event, so the replay is clean — and each live
+    rank's digest row is matched against the recomputed reference: the
+    rank that matches is healthy, the other is corrupt.
+
+    Cost: one state copy per cadence window plus held batch references
+    (at most ``every`` of them); the replay itself only runs on the
+    already-failed path.
+    """
+
+    def __init__(self, step_fn, digest_fn):
+        self._step_fn = step_fn
+        self._digest_fn = digest_fn
+        self._snapshot = None
+        self._held: list[tuple[Pytree, jax.Array]] = []
+
+    def commit(self, snapshot) -> None:
+        """Adopt ``snapshot`` (a ``copy_tree`` of a verified-clean step
+        input) as the new replay base; forget the held steps before it."""
+        self._snapshot = snapshot
+        self._held = []
+
+    def hold(self, batch, rng) -> None:
+        """Record one consumed (batch, rng) pair for potential replay."""
+        self._held.append((batch, rng))
+
+    def resolve(self, live_matrix: np.ndarray) -> SdcVerdict:
+        """Replay held steps from the snapshot and name the corrupt rank."""
+        if self._snapshot is None:
+            return SdcVerdict(ok=False, tie=True, method="replay")
+        state = copy_tree(self._snapshot)
+        for batch, rng in self._held:
+            state, _ = self._step_fn(state, batch, rng)
+        ref = np.asarray(jax.device_get(self._digest_fn(state)))
+        if not (ref == ref[0:1]).all():
+            # The replay itself diverged -> persistent fault, cannot
+            # arbitrate from here; report the unresolved tie.
+            return SdcVerdict(ok=False, tie=True, method="replay")
+        live = np.asarray(live_matrix)
+        corrupt = tuple(
+            i for i in range(live.shape[0])
+            if not (live[i] == ref[0]).all()
+        )
+        if not corrupt or len(corrupt) == live.shape[0]:
+            return SdcVerdict(ok=False, tie=True, method="replay")
+        bad_cols = sorted({
+            int(j) for i in corrupt
+            for j in np.nonzero(live[i] != ref[0])[0]
+        })
+        return SdcVerdict(
+            ok=False, corrupt=corrupt,
+            leaves=tuple(str(j) for j in bad_cols), method="replay",
+        )
+
+
+# -- host orchestration --------------------------------------------------
+
+
+class IntegrityChecker:
+    """Host-side driver of the detect->attribute loop.
+
+    Owns the vote, the optional replay arbiter, and all telemetry
+    (events + counters), so the train loop only asks: "given this step's
+    digest matrix, who is corrupt?".  Eviction stays with the caller —
+    it needs the gang coordinator — and is reported back through
+    ``note_eviction`` so the sdc_* event stream is written in one place.
+    """
+
+    def __init__(self, *, every: int, leaf_names: Sequence[str] = (),
+                 events=None, counters=None, arbiter=None):
+        if every < 1:
+            raise ValueError(f"integrity cadence must be >= 1, got {every}")
+        self.every = every
+        self.leaf_names = list(leaf_names)
+        self.events = events
+        self.counters = counters
+        self.arbiter = arbiter
+
+    def due(self, state_step: int) -> bool:
+        """Host mirror of the in-program ``state.step % every == 0``
+        gate — decides when metrics carry a real digest matrix."""
+        return state_step % self.every == 0
+
+    def check(self, matrix: np.ndarray, *, step: int) -> SdcVerdict:
+        """Vote on one on-cadence digest matrix; escalate ties to the
+        replay arbiter; emit sdc_check / sdc_detect."""
+        if self.counters is not None:
+            self.counters.sdc_checks += 1
+        verdict = vote(matrix, self.leaf_names)
+        if verdict.tie and self.arbiter is not None:
+            verdict = self.arbiter.resolve(matrix)
+        if self.events is not None:
+            self.events.emit("sdc_check", step=step, ok=verdict.ok)
+        if not verdict.ok:
+            if self.counters is not None:
+                self.counters.sdc_detects += 1
+            if self.events is not None:
+                self.events.emit(
+                    "sdc_detect", step=step,
+                    rank=(verdict.corrupt[0] if verdict.corrupt else -1),
+                    ranks=list(verdict.corrupt), leaves=list(verdict.leaves),
+                    method=verdict.method, tie=verdict.tie,
+                )
+        return verdict
+
+    def note_shadow_mismatch(self, *, step: int) -> None:
+        """Transient SDC caught by ``--integrity-shadow`` double
+        execution: no rank to attribute (rank=-1), no eviction."""
+        if self.counters is not None:
+            self.counters.sdc_detects += 1
+        if self.events is not None:
+            self.events.emit(
+                "sdc_detect", step=step, rank=-1, ranks=[], leaves=[],
+                method="shadow", tie=False,
+            )
+
+    def note_eviction(self, rank: int, *, step: int) -> None:
+        if self.counters is not None:
+            self.counters.sdc_evictions += 1
+        if self.events is not None:
+            self.events.emit("sdc_evict", step=step, rank=rank)

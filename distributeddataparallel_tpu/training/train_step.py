@@ -66,6 +66,7 @@ def make_train_step(
     presynced: Callable[[tuple], bool] | None = None,
     grad_compress: str | None = None,
     nonfinite_guard: bool = False,
+    integrity_every: int | None = None,
 ):
     """Build the jit'd DP train step.
 
@@ -210,6 +211,17 @@ def make_train_step(
     into a hard stop.  This is the torch ``GradScaler.step``-skip analog
     for bf16/f32 training, where there is no loss scale to shrink.
 
+    ``integrity_every=N`` arms the silent-data-corruption probe
+    (``training.integrity``): every N steps the program digests the bit
+    patterns of its INPUT state (params + optimizer moments + buffers;
+    params only under ZeRO-1) and all_gathers the per-rank digests —
+    one sub-kilobyte collective on cadence, nothing off cadence.  On a
+    row mismatch the update is discarded nonfinite-guard-style (the
+    corrupt rank's gradients already entered the all-reduce) and the
+    step reports ``metrics['sdc_mismatch']`` (0.0/1.0) plus the
+    ``metrics['sdc_digest']`` matrix for host-side majority-vote
+    attribution and eviction (dpp.py --integrity-every).
+
     ``ep_axis`` adds expert parallelism for MoE configs
     (``parallel.expert_parallel``): expert weight stacks shard over the
     axis, the batch replicates, and — as with TP — the MoE module's
@@ -283,6 +295,37 @@ def make_train_step(
         )
     if accum_steps < 1:
         raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
+    if integrity_every is not None:
+        # SDC replica fingerprint (training.integrity): digest the INPUT
+        # state every N steps and all_gather the per-rank digests.  The
+        # probe's premise is that post-allreduce state is bitwise-
+        # replicated across the data axis, so it only composes with
+        # layouts that keep it that way: synced grads, replicated or
+        # ZeRO-1 params (levels 2/3 shard the comparable state away),
+        # no model axes (TP/EP-sharded leaves differ per position by
+        # construction, and CP's second axis would give each data rank
+        # cp_size distinct digest buffers).
+        if integrity_every < 1:
+            raise ValueError(
+                f"integrity_every must be >= 1, got {integrity_every}"
+            )
+        if not grad_sync:
+            raise ValueError(
+                "integrity_every requires grad_sync=True: unsynced "
+                "replicas legitimately diverge, so a digest mismatch "
+                "means nothing"
+            )
+        if zero_level >= 2:
+            raise ValueError(
+                "integrity_every needs bitwise-replicated state to "
+                "compare; zero=2/3 shard it — use zero<=1"
+            )
+        if tp_axis is not None or ep_axis is not None or cp_axis is not None:
+            raise ValueError(
+                "integrity_every composes with the data axis only; "
+                "tp/ep/cp-sharded layouts have no replicated digest "
+                "domain over 'data' alone"
+            )
 
     # Compilation-affecting factory flags, attached to the returned step
     # as ``aot_signature`` — the warm-start store (training.warm_start)
@@ -308,6 +351,7 @@ def make_train_step(
         "presynced": presynced is not None,
         "grad_compress": grad_compress,
         "nonfinite_guard": nonfinite_guard,
+        "integrity_every": integrity_every,
     }
 
     # FLOP-accounting handoff for the MFU meter (observability.cost_model).
@@ -354,6 +398,18 @@ def make_train_step(
         _reduce = {axis_name: {"psum": (0, None)}}
     else:
         _reduce = {axis_name: {"psum": (1, None)}}
+    if integrity_every is not None:
+        # The SDC digest adds exactly one data-axis all_gather (the
+        # stacked per-leaf digest vector, inside the cadence cond — the
+        # linter walks cond branches, so it is statically visible every
+        # build).  Declared here so GL001 stays EXACT: on the plain-DP
+        # path the bound is (1, 1) — a duplicated digest gather is a
+        # finding, same as a duplicated grad sync; ZeRO-1 already
+        # gathers its updated params, so its floor moves up by one.
+        if zero_level:
+            _reduce[axis_name]["all_gather"] = (2, None)
+        else:
+            _reduce[axis_name]["all_gather"] = (1, 1)
     for ax in (cp_axis, tp_axis, ep_axis):
         if ax is not None:
             _reduce.setdefault(ax, dict(_any_coll))
@@ -684,14 +740,54 @@ def make_train_step(
 
                 new_ms = jax.tree.map(_bcast, new_ms)
             new_state = new_state.replace(model_state=new_ms)
-        if nonfinite_guard:
+        if integrity_every is not None:
+            # Replica fingerprint of the INPUT state, taken before this
+            # step's all-reduce could spread a corrupt rank's gradients.
+            # Off cadence the cond's zero branch runs — no collective
+            # executes, no host sync is implied, and the all-zero matrix
+            # trivially satisfies the row-equality verdict below.
+            # check_vma=False means each position digests ITS OWN buffer
+            # of the "replicated" state — physical divergence is the
+            # signal; the gathered matrix is identical on every rank, so
+            # the verdict is mesh-uniform without further reduction.
+            from distributeddataparallel_tpu.training.integrity import (
+                digest_parts,
+                tree_digest,
+            )
+
+            _dg_parts = digest_parts(orig_state, zero_level)
+            _n_rows = mesh.shape[axis_name]
+            _n_leaves = len(jax.tree.leaves(_dg_parts))
+            sdc_digests = lax.cond(
+                orig_state.step % integrity_every == 0,
+                lambda _: lax.all_gather(tree_digest(_dg_parts), axis_name),
+                lambda _: jnp.zeros((_n_rows, _n_leaves), jnp.uint32),
+                operand=None,
+            )
+            sdc_ok = jnp.all(sdc_digests == sdc_digests[0:1])
+        if nonfinite_guard or integrity_every is not None:
             # Skip-step semantics: zeroed grads still advance Adam's
             # moments and weight decay, so masking grads alone is not a
             # skip — discard the WHOLE update (params, optimizer moments,
             # buffers, comm hook state) and let only the step counter
-            # advance, mirroring torch GradScaler's skipped step.
+            # advance, mirroring torch GradScaler's skipped step.  The
+            # digest verdict rides the SAME select (a mismatching rank's
+            # gradients already entered this step's reduction, so
+            # applying the update would bake the corruption into every
+            # replica; the host-side voter evicts the liar before the
+            # next update lands).  Folding both verdicts into one
+            # whole-state select — keep = finite AND replicas-agree —
+            # means arming integrity on top of the nonfinite guard adds
+            # only the cadence-gated digest, not a second state-sized
+            # select: the select fuses with the update's final write,
+            # and its cost is paid once however many guards are on.
+            keep = jnp.bool_(True)
+            if nonfinite_guard:
+                keep = jnp.logical_and(keep, ok)
+            if integrity_every is not None:
+                keep = jnp.logical_and(keep, sdc_ok)
             new_state = jax.tree.map(
-                lambda n, o: jnp.where(ok, n, o), new_state, orig_state
+                lambda n, o: jnp.where(keep, n, o), new_state, orig_state
             )
             new_state = new_state.replace(step=orig_state.step + 1)
         metrics = {"loss": lax.pmean(loss, axis_name)}
@@ -701,6 +797,13 @@ def make_train_step(
         if nonfinite_guard:
             # Already mesh-uniform (pmin above): no further reduction.
             metrics["nonfinite_grad"] = 1.0 - fin
+        if integrity_every is not None:
+            # sdc_mismatch: 0.0/1.0 verdict (mesh-uniform).  sdc_digest:
+            # the full (n_ranks, n_leaves) matrix for host-side majority
+            # vote — only fetched on cadence AND mismatch, so it costs
+            # no host sync on the happy path.
+            metrics["sdc_mismatch"] = 1.0 - sdc_ok.astype(jnp.float32)
+            metrics["sdc_digest"] = sdc_digests
         return new_state, metrics
 
     # Params/opt-state replicated (P()), batch sharded on the data axis

@@ -29,6 +29,13 @@ Spec grammar (comma-separated entries, all steps 0-based)::
                        instead of restarting (requires a wired gang
                        coordinator; a no-op with a logged warning
                        otherwise)
+    bitflip@S[:R][:leaf]
+                       XOR one low mantissa bit of one param leaf on data
+                       rank R (default 1) before step S — a silent HBM
+                       bit flip: values stay finite, so only the replica
+                       digest (``--integrity-every``) can catch it.
+                       ``leaf`` (optional) selects the target leaf by
+                       name substring; default is the first param leaf
 
 Determinism across restarts: with a ``state_dir`` (defaults to
 ``<checkpoint_dir>/.chaos`` in the CLI), each entry fires AT MOST ONCE
@@ -53,7 +60,9 @@ __all__ = [
     "parse_chaos_spec",
 ]
 
-KINDS = ("ckpt-io", "nan-grad", "slow-step", "preempt", "worker-kill")
+KINDS = (
+    "ckpt-io", "nan-grad", "slow-step", "preempt", "worker-kill", "bitflip"
+)
 
 
 class SimulatedPreemption(RuntimeError):
@@ -99,7 +108,17 @@ def parse_chaos_spec(spec: str) -> list[_Entry]:
             if arg:
                 # Validate eagerly: a typo'd argument must fail at parse,
                 # not at fire time deep into a run.
-                float(arg) if kind == "slow-step" else int(arg)
+                if kind == "slow-step":
+                    float(arg)
+                elif kind == "bitflip":
+                    # R or R:leaf — the rank must be a non-negative int;
+                    # the leaf selector is free-form (resolved at fire
+                    # time against the live param tree).
+                    rank_s, _, _leaf = arg.partition(":")
+                    if int(rank_s) < 0:
+                        raise ValueError
+                else:
+                    int(arg)
             elif kind in ("slow-step", "ckpt-io"):
                 arg = ""
             if kind in ("nan-grad", "preempt") and arg:
@@ -108,7 +127,8 @@ def parse_chaos_spec(spec: str) -> list[_Entry]:
             raise ValueError(
                 f"bad chaos entry {raw!r}: expected one of "
                 "ckpt-io@N[:K] | nan-grad@S | slow-step@S[:SECONDS] | "
-                "preempt@S | worker-kill@S[:RANK] (comma-separated)"
+                "preempt@S | worker-kill@S[:RANK] | "
+                "bitflip@S[:R][:leaf] (comma-separated)"
             ) from None
         entries.append(_Entry(kind, step, arg or None))
     return entries
@@ -238,6 +258,35 @@ class FaultInjector:
         raise ValueError(
             "chaos nan-grad needs a float leaf in the batch to poison "
             "(integer-token LM batches cannot carry a NaN input)"
+        )
+
+    def corrupt_state(self, state, step: int, *, mesh=None,
+                      axis_name: str = "data"):
+        """Return ``state`` with one bit XOR'd in one param leaf on one
+        data rank when a ``bitflip`` entry fires at ``step`` (identity
+        otherwise) — the silent-HBM-corruption injection behind the
+        ``--integrity-every`` closed loop.  Needs the live mesh to
+        address the target rank's buffer; without one the entry warns
+        and no-ops (single-device eager state has no rank to corrupt)."""
+        e = self._take("bitflip", step)
+        if e is None:
+            return state
+        if mesh is None:
+            from distributeddataparallel_tpu.utils.logging import warn0
+
+            warn0(
+                "chaos %s: no device mesh wired — bit flip not injected",
+                e.key,
+            )
+            return state
+        rank_s, _, leaf = (e.arg or "1").partition(":")
+        from distributeddataparallel_tpu.training.integrity import (
+            apply_bitflip,
+        )
+
+        return apply_bitflip(
+            state, rank=int(rank_s), mesh=mesh, leaf=leaf or None,
+            axis_name=axis_name,
         )
 
     def fail_io(self, ordinal: int, attempt: int) -> None:

@@ -129,6 +129,12 @@ class FaultCounters:
         self.ckpt_fallbacks = 0
         self.watchdog_fires = 0
         self.restarts = 0
+        # Silent-data-corruption defense (training.integrity): checks
+        # are routine probes (not faults — excluded from ``total`` like
+        # warm-start accounting); detections and evictions are faults.
+        self.sdc_checks = 0
+        self.sdc_detects = 0
+        self.sdc_evictions = 0
         # Warm-start accounting (training.warm_start): how this
         # incarnation got its train step — "aot" (loaded executable),
         # "cache-hit" (persistent compile cache), "cold" (full compile),
@@ -143,6 +149,7 @@ class FaultCounters:
         return (
             self.nonfinite_steps + self.io_retries + self.ckpt_fallbacks
             + self.watchdog_fires + self.restarts
+            + self.sdc_detects + self.sdc_evictions
         )
 
     def summary(self) -> dict:
@@ -153,6 +160,10 @@ class FaultCounters:
             "watchdog_fires": self.watchdog_fires,
             "restarts": self.restarts,
         }
+        if self.sdc_checks or self.sdc_detects or self.sdc_evictions:
+            out["sdc_checks"] = self.sdc_checks
+            out["sdc_detects"] = self.sdc_detects
+            out["sdc_evictions"] = self.sdc_evictions
         if self.warm_start_mode is not None:
             out["warm_start"] = self.warm_start_mode
         if self.compile_s is not None:

@@ -285,7 +285,8 @@ def parse_args(argv=None):
                         "recovery paths (utils.chaos; also via the "
                         "DDP_CHAOS env var): comma-separated "
                         "ckpt-io@N[:K] | nan-grad@S | slow-step@S[:SEC] "
-                        "| preempt@S")
+                        "| preempt@S | worker-kill@S[:R] | "
+                        "bitflip@S[:R][:leaf]")
     p.add_argument("--nan-guard", action="store_true",
                    help="skip-step numerical guard: a step whose "
                         "gradients contain NaN/Inf applies NO update "
@@ -297,6 +298,28 @@ def parse_args(argv=None):
                    help="with --nan-guard: consecutive non-finite-grad "
                         "steps tolerated before the run aborts as "
                         "diverged")
+    p.add_argument("--integrity-every", type=int, default=0, metavar="N",
+                   help="silent-data-corruption defense "
+                        "(training.integrity): every N steps the train "
+                        "step digests its input state's bit patterns "
+                        "per data rank and all_gathers the digests — "
+                        "one extra sub-KB collective on cadence, zero "
+                        "extra host syncs off cadence.  A mismatch "
+                        "skips that step's update, names the corrupt "
+                        "rank by majority vote (2-rank gangs fall back "
+                        "to a shadow-replay tiebreak), and with "
+                        "--elastic evicts it through the gang resize "
+                        "path: no restart, no checkpoint read.  0 "
+                        "disables.  Plain DP and --zero 1 only")
+    p.add_argument("--integrity-shadow", action="store_true",
+                   help="with --integrity-every: on cadence, re-run the "
+                        "step on a copy of the same inputs and compare "
+                        "result digests — catches TRANSIENT compute SDC "
+                        "even at DP=1 (two runs of one deterministic "
+                        "program must agree bitwise).  Roughly doubles "
+                        "the cost of cadence steps; detections are "
+                        "reported (sdc_detect, rank=-1) but nothing is "
+                        "evicted")
     p.add_argument("--eval", action="store_true", help="run eval after each epoch")
     p.add_argument("--decode-quant", choices=["int8"], default=None,
                    help="serve --generate with int8-quantized matrices "
@@ -345,7 +368,8 @@ def parse_args(argv=None):
                         "defaults; SPEC overrides thresholds, e.g. "
                         "--alerts mfu_floor=0.3,step_spike=2.5 "
                         "(rules: step_spike, mfu_floor, goodput_floor, "
-                        "restart_storm, loader_starved, mem_growth).  "
+                        "restart_storm, sdc_storm, loader_starved, "
+                        "mem_growth).  "
                         "Watch live with scripts/ddp_monitor.py")
     p.add_argument("--runs-dir", default=None, metavar="DIR",
                    help="longitudinal run store: append this run's "
@@ -666,6 +690,33 @@ def validate_args(args) -> None:
                              "step; drop --fsdp/--pp")
         if args.max_bad_steps < 1:
             raise SystemExit("--max-bad-steps must be >= 1")
+    if args.integrity_every:
+        if args.integrity_every < 0:
+            raise SystemExit("--integrity-every must be >= 0")
+        # The digest compares state that must be bitwise-replicated over
+        # the data axis — sharded/model-parallel layouts have no such
+        # replicated domain (mirrors the make_train_step gate).
+        bad = [
+            f for f, on in (
+                ("--fsdp", args.fsdp), ("--pp", args.pp > 1),
+                ("--tp", args.tp > 1), ("--ep", args.ep > 1),
+                ("--cp", args.cp > 1),
+            ) if on
+        ]
+        if bad:
+            raise SystemExit(
+                f"--integrity-every compares replicated data-axis state; "
+                f"drop {', '.join(bad)}"
+            )
+        if args.zero >= 2:
+            raise SystemExit(
+                "--integrity-every supports plain DP and --zero 1; "
+                "ZeRO-2/3 shard the comparable state away"
+            )
+    elif args.integrity_shadow:
+        raise SystemExit(
+            "--integrity-shadow needs a cadence: set --integrity-every N"
+        )
     if args.zero >= 2:
         # Levels 2/3 shard the update over the data axis only; the
         # model-axis compositions ride ZeRO-1's flat layouts.
@@ -1312,6 +1363,10 @@ def train(args) -> float:
             loss = cross_entropy_loss(logits, batch["label"])  # ref dpp.py:40
             return loss, {"accuracy": accuracy(logits, batch["label"])}
 
+    # Off-cadence twin for --integrity-every (built in the generic
+    # branch below; the layouts the other branches build are rejected
+    # by the integrity CLI gate above).
+    step_fn_off = None
     if args.fsdp:
         # FSDP: the step factory takes the model CONFIG (it decomposes
         # the transformer into embed / layer scan / head around the
@@ -1349,7 +1404,7 @@ def train(args) -> float:
         # One factory for the other compositions: DP × {accum, buckets,
         # ZeRO} × CP/TP.  Factored over the mesh so the elastic resize
         # can rebuild the identical step for the shrunken world.
-        def build_step_fn(for_mesh):
+        def build_step_fn(for_mesh, integrity=True):
             return ddp.make_train_step(
                 loss_fn, mesh=for_mesh, accum_steps=args.accum_steps,
                 bucket_bytes=int(args.bucket_mb * 1024 * 1024) if args.bucket_mb else None,
@@ -1368,9 +1423,22 @@ def train(args) -> float:
                     else None
                 ),
                 nonfinite_guard=args.nan_guard,
+                integrity_every=(
+                    (args.integrity_every or None) if integrity else None
+                ),
             )
 
         step_fn = build_step_fn(mesh)
+        if args.integrity_every:
+            # Off-cadence twin: the digest-armed program carries an
+            # in-graph cadence cond, and routing the state past that
+            # conditional has a measurable per-step cost even on the
+            # cond's zero branch.  The host loop already mirrors the
+            # cadence gate (IntegrityChecker.due on a host counter — no
+            # sync), so off-cadence steps dispatch this bit-identical
+            # plain program instead and pay exactly nothing; the digest
+            # program runs only on the 1-in-N cadence steps.
+            step_fn_off = build_step_fn(mesh, integrity=False)
 
     # Graph lint wants the RAW factory step: the warm-start wrapper below
     # may swap in a deserialized AOT executable, which cannot be traced.
@@ -1432,6 +1500,12 @@ def train(args) -> float:
             )
 
         step_fn = _wrap_warm(step_fn, mesh)
+        if step_fn_off is not None:
+            # Distinct store entry: the twin's aot_signature differs
+            # only in integrity_every=None.
+            step_fn_off = _wrap_warm(
+                step_fn_off, mesh, name="train_step_off"
+            )
 
     def full_params():
         """The replicated param tree for eval/generate: under FSDP the
@@ -1999,6 +2073,53 @@ def train(args) -> float:
 
     last_loss = float("nan")
     warm_logged = False
+
+    # Silent-data-corruption defense (training.integrity): the compiled
+    # step already carries the cadence-gated digest (integrity_every was
+    # passed to the factory); this host side mirrors the cadence gate —
+    # ONE device sync pre-loop, then pure host arithmetic — votes on the
+    # gathered digest matrix when a check lands, and evicts the corrupt
+    # rank through the elastic gang.
+    integrity = None
+    integrity_shadow_fn = None
+    integrity_step = 0
+    sdc_source = None  # voted-healthy rank to re-replicate from on evict
+    if args.integrity_every:
+        from distributeddataparallel_tpu.training import (
+            integrity as integrity_mod,
+        )
+
+        integrity = integrity_mod.IntegrityChecker(
+            every=args.integrity_every,
+            leaf_names=integrity_mod.digest_leaf_names(
+                integrity_mod.digest_parts(state, args.zero)
+            ),
+            events=events, counters=counters,
+        )
+
+        def _integrity_rearm(for_step_fn, for_mesh, world):
+            # The replay tiebreak only exists where the vote cannot
+            # decide (exactly 2 ranks); shadow mode replaces it (the
+            # double-execution check needs the pre-step copy for
+            # itself).  Rebuilt on every topology change.
+            nonlocal integrity_shadow_fn
+            integrity.arbiter = (
+                integrity_mod.ShadowArbiter(
+                    for_step_fn,
+                    integrity_mod.make_digest_fn(
+                        for_mesh, zero_level=args.zero
+                    ),
+                )
+                if world == 2 and not args.integrity_shadow else None
+            )
+            integrity_shadow_fn = (
+                integrity_mod.make_digest_fn(for_mesh, zero_level=args.zero)
+                if args.integrity_shadow else None
+            )
+
+        _integrity_rearm(step_fn, mesh, n_replicas)
+        integrity_step = int(jax.device_get(state.step))
+
     # Per-step RNG is a pure function of (seed, epoch, batch): a --resume'd
     # run continues the exact stochastic stream (dropout etc.) the
     # uninterrupted run would have used, instead of replaying epoch-0 keys.
@@ -2025,7 +2146,22 @@ def train(args) -> float:
                         prof.on_step_start(gstep)
                     injector.before_step(gstep)   # slow-step / preempt
                     batch = injector.corrupt_batch(batch, gstep)
+                    # Silent HBM corruption: XOR one bit of one param
+                    # leaf on one rank (chaos bitflip; a no-op without a
+                    # matching entry).
+                    state = injector.corrupt_state(state, gstep, mesh=mesh)
                     sub = jax.random.fold_in(epoch_rng, batch_idx)
+                    sdc_pend = None
+                    if (
+                        integrity is not None
+                        and integrity.due(integrity_step)
+                        and (integrity.arbiter is not None
+                             or integrity_shadow_fn is not None)
+                    ):
+                        # The replay tiebreak / shadow re-execution needs
+                        # this step's input state, and the step donates
+                        # it — copy before dispatch, only on cadence.
+                        sdc_pend = integrity_mod.copy_tree(state)
                     if lint_target is not None:
                         # First batch: everything the step consumes is
                         # now concrete, and nothing is compiled yet —
@@ -2126,7 +2262,18 @@ def train(args) -> float:
                     # number for an async loop; device wall time lands
                     # in the readings at drain boundaries.
                     with _span("step", step=gstep):
-                        state, metrics = step_fn(state, batch, sub)
+                        # Off cadence the plain twin runs — bit-identical
+                        # update, no digest machinery in the program at
+                        # all (the host counter mirrors the in-graph
+                        # cadence gate, so the two never disagree).
+                        use_fn = (
+                            step_fn_off
+                            if step_fn_off is not None
+                            and integrity is not None
+                            and not integrity.due(integrity_step)
+                            else step_fn
+                        )
+                        state, metrics = use_fn(state, batch, sub)
                         # Bounded async dispatch: enqueue this step's
                         # guard handle and settle only what falls out of
                         # the K-deep window (the old pattern blocked
@@ -2138,6 +2285,85 @@ def train(args) -> float:
                         )
                         for h, w in dispatch.push(guard, (epoch, batch_idx)):
                             settle(h, w)
+                    if integrity is not None:
+                        on_cadence = integrity.due(integrity_step)
+                        integrity_step += 1
+                        if on_cadence:
+                            import numpy as np
+
+                            # The ONLY integrity host sync, and only on
+                            # cadence: fetch the (n_ranks, n_leaves)
+                            # digest matrix the step just gathered.
+                            mat = np.asarray(
+                                jax.device_get(metrics["sdc_digest"])
+                            )
+                            verdict = integrity.check(mat, step=gstep)
+                            if verdict.ok:
+                                if integrity.arbiter is not None:
+                                    integrity.arbiter.commit(sdc_pend)
+                                if (
+                                    integrity_shadow_fn is not None
+                                    and sdc_pend is not None
+                                ):
+                                    # Transient-SDC probe: same program,
+                                    # same inputs, second execution —
+                                    # any digest disagreement is compute
+                                    # corruption, catchable even at DP=1.
+                                    shadow_state, _ = step_fn(
+                                        sdc_pend, batch, sub
+                                    )
+                                    live_d = np.asarray(jax.device_get(
+                                        integrity_shadow_fn(state)
+                                    ))
+                                    shad_d = np.asarray(jax.device_get(
+                                        integrity_shadow_fn(shadow_state)
+                                    ))
+                                    if not (live_d == shad_d).all():
+                                        integrity.note_shadow_mismatch(
+                                            step=gstep
+                                        )
+                            elif verdict.corrupt and gang is not None:
+                                # Closed loop: tombstone the corrupt
+                                # rank(s); this iteration's gang.poll()
+                                # below lands the resize, resharding the
+                                # survivors' verified live state from a
+                                # voted-healthy source rank.  The step
+                                # that detected the mismatch already
+                                # discarded its own update, so nothing
+                                # the liar sent ever reached the
+                                # surviving params.  No restart budget,
+                                # no checkpoint read.
+                                sdc_source = next(
+                                    r for r in range(n_replicas)
+                                    if r not in verdict.corrupt
+                                )
+                                for bad in verdict.corrupt:
+                                    gang.kill(str(bad))
+                                    integrity.note_eviction(bad, step=gstep)
+                                log0(
+                                    "integrity: digest mismatch at step "
+                                    "%d — rank(s) %s corrupt (%s, leaves "
+                                    "%s); evicting via elastic resize",
+                                    gstep, list(verdict.corrupt),
+                                    verdict.method, list(verdict.leaves),
+                                )
+                            else:
+                                # Detection without an eviction path (no
+                                # --elastic, or an unresolved tie): the
+                                # update was discarded in-program, so
+                                # state is still clean — stop loudly
+                                # rather than train on with known-bad
+                                # hardware.
+                                raise SystemExit(
+                                    f"integrity: replica digest mismatch "
+                                    f"at step {gstep} "
+                                    f"(corrupt={list(verdict.corrupt)}, "
+                                    f"tie={verdict.tie}) and no eviction "
+                                    f"path — rerun with --elastic, or "
+                                    f"restore from a verified checkpoint"
+                                )
+                        if integrity.arbiter is not None:
+                            integrity.arbiter.hold(batch, sub)
                     if steps_total is not None:
                         steps_total.inc()  # host int increment, no sync
                     if prof is not None:
@@ -2287,6 +2513,7 @@ def train(args) -> float:
                                     if args.workers > 0 else None
                                 ),
                                 restarts=counters.restarts,
+                                sdc_detects=counters.sdc_detects,
                             )
                         log0(
                             "throughput: %.0f %s/s (%.1f %s/s/chip)",
@@ -2346,9 +2573,16 @@ def train(args) -> float:
                             # Checkpoint-free shrink: host round-trip of
                             # the live arrays through the positional
                             # flat-reshard math (training.elastic).
+                            # After an SDC eviction the replicated
+                            # leaves re-replicate from the voted-healthy
+                            # rank — device_get's default (device 0's
+                            # buffer) would resurrect the corruption
+                            # when rank 0 was the liar.
                             state = reshard_live_state(
-                                state, old_mesh, mesh, zero=args.zero
+                                state, old_mesh, mesh, zero=args.zero,
+                                source=sdc_source,
                             )
+                            sdc_source = None
                             # Exactly-once data: the unconsumed tail of
                             # this epoch's permutation, reshuffled under
                             # an epoch-keyed reseed and dealt to the new
@@ -2372,6 +2606,10 @@ def train(args) -> float:
                             tail.events = events
                             stream.swap(tail)
                             step_fn = build_step_fn(mesh)
+                            if step_fn_off is not None:
+                                step_fn_off = build_step_fn(
+                                    mesh, integrity=False
+                                )
                             if args.compile_cache:
                                 # The per-topology store name the
                                 # background pre-compiler saved — a
@@ -2380,7 +2618,17 @@ def train(args) -> float:
                                     step_fn, mesh,
                                     name=f"train_step@d{new_world}",
                                 )
+                                if step_fn_off is not None:
+                                    step_fn_off = _wrap_warm(
+                                        step_fn_off, mesh,
+                                        name=f"train_step_off@d{new_world}",
+                                    )
                             n_replicas = new_world
+                            if integrity is not None:
+                                # New mesh, new step: rebuild the shadow
+                                # digest fn and (de)arm the 2-rank
+                                # replay tiebreak for the new world.
+                                _integrity_rearm(step_fn, mesh, new_world)
                             items_per_step = (
                                 args.batch_size * n_replicas * args.seq_len
                                 if lm

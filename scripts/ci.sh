@@ -36,7 +36,13 @@
 #                               CPU gang: chaos kills one member mid-run,
 #                               the coordinator must land a gang_resize
 #                               (NOT a restart_attempt) and finish ok
-#   7. tier-1 pytest            the ROADMAP verify command (CPU, not
+#   7. integrity smoke          silent bit flip on one rank of a 4-way
+#                               CPU gang: the replica digest must detect
+#                               it on cadence, the vote must name the
+#                               rank, and the gang must EVICT via resize
+#                               (sdc_detect + sdc_evict + gang_resize,
+#                               no restart_attempt)
+#   8. tier-1 pytest            the ROADMAP verify command (CPU, not
 #                               slow).  Includes the ZeRO-2/3 bitwise
 #                               dp-parity + low-bit-moment convergence
 #                               tests (tests/test_zero23.py)
@@ -55,7 +61,10 @@
 #                              per-device live-HWM and step time) — the
 #                              *_bytes/*_s suffixes make them
 #                              lower-is-better, so a sharded-update
-#                              memory regression fails this stage
+#                              memory regression fails this stage.
+#                              integrity_overhead_frac (the --integrity-
+#                              every digest's step-time cost, pinned
+#                              <= 1%) gates the same way via _frac
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -94,6 +103,30 @@ print(f"elastic shrink smoke: 1 gang_resize, 0 restarts "
       f"({len(kinds)} records)")
 PY
 rm -rf "${ELASTIC_SMOKE_DIR}"
+
+echo "== integrity smoke (bitflip -> detect -> evict) =="
+INTEGRITY_SMOKE_DIR="$(mktemp -d)"
+JAX_PLATFORMS=cpu python dpp.py --model mlp --fake-devices 4 \
+    --batch-size 4 --epochs 1 --steps-per-epoch 8 \
+    --elastic --integrity-every 2 --chaos "bitflip@4:1" \
+    --events-dir "${INTEGRITY_SMOKE_DIR}"
+python - "${INTEGRITY_SMOKE_DIR}" <<'PY'
+import sys
+from distributeddataparallel_tpu.observability.events import load_timeline
+recs = load_timeline(sys.argv[1])
+kinds = [r.get("kind") for r in recs]
+detect = next((r for r in recs if r.get("kind") == "sdc_detect"), None)
+assert detect is not None, f"no sdc_detect in {sorted(set(kinds))}"
+assert detect["rank"] == 1, f"vote named rank {detect['rank']}, not 1"
+evict = next((r for r in recs if r.get("kind") == "sdc_evict"), None)
+assert evict is not None and evict["rank"] == 1, evict
+assert kinds.count("gang_resize") == 1, kinds
+assert "restart_attempt" not in kinds, \
+    "SDC eviction fell back to a supervised restart"
+print(f"integrity smoke: sdc_detect rank 1 -> evict -> 1 gang_resize, "
+      f"0 restarts ({len(kinds)} records)")
+PY
+rm -rf "${INTEGRITY_SMOKE_DIR}"
 
 if [[ "${DDP_PERF_GATE:-0}" == "1" ]]; then
     echo "== perf_gate =="

@@ -82,6 +82,7 @@ def analyze(records: list[dict]) -> dict:
         "pipeline": measured_bubble_fraction(records),
         "restarts": [],
         "elasticity": None,
+        "integrity": None,
         "alerts": [],
         "lint": [],
         "run_summary": None,
@@ -153,6 +154,28 @@ def analyze(records: list[dict]) -> dict:
                     el["downtimes"][ep] = max(
                         el["downtimes"].get(ep, 0.0), r["seconds"]
                     )
+        elif kind in ("sdc_check", "sdc_detect", "sdc_evict"):
+            ig = out["integrity"]
+            if ig is None:
+                ig = out["integrity"] = {
+                    "checks": 0, "detects": [], "evictions": [],
+                }
+            if kind == "sdc_check":
+                ig["checks"] += 1
+            elif kind == "sdc_detect":
+                ig["detects"].append({
+                    "step": r.get("step"),
+                    "rank": r.get("rank"),
+                    "ranks": r.get("ranks") or [],
+                    "leaves": r.get("leaves") or [],
+                    "method": r.get("method"),
+                    "tie": r.get("tie"),
+                })
+            else:
+                ig["evictions"].append({
+                    "step": r.get("step"),
+                    "rank": r.get("rank"),
+                })
         elif kind == "lint_report":
             out["lint"].append({
                 "layer": r.get("layer"),
@@ -488,6 +511,37 @@ def render_markdown(a: dict, events_dir: str) -> str:
                 "supervised restart head-to-head.",
             ]
     lines.append("")
+
+    # -- Integrity ----------------------------------------------------
+    ig = a["integrity"]
+    if ig is not None:
+        lines += ["## Integrity", ""]
+        lines.append(
+            f"**{ig['checks']} digest check(s)**, "
+            f"{len(ig['detects'])} mismatch(es), "
+            f"{len(ig['evictions'])} eviction(s)."
+        )
+        if ig["detects"]:
+            lines += [
+                "",
+                "| step | rank(s) | method | leaves |",
+                "|---:|---|---|---|",
+            ]
+            for d in ig["detects"]:
+                ranks = ", ".join(str(x) for x in d["ranks"]) or (
+                    "transient" if d["rank"] == -1 else str(d["rank"])
+                )
+                lines.append(
+                    f"| {d['step']} | {ranks} | {d['method']} | "
+                    f"{', '.join(d['leaves']) or '—'} |"
+                )
+        if ig["evictions"]:
+            ev = ", ".join(
+                f"rank {e['rank']} @ step {e['step']}"
+                for e in ig["evictions"]
+            )
+            lines += ["", f"Evicted via elastic resize: {ev}."]
+        lines.append("")
 
     # -- Alerts -------------------------------------------------------
     lines += ["## Alerts", ""]

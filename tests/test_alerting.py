@@ -152,7 +152,8 @@ def test_memory_growth_fires_on_hwm_above_settled_baseline():
 def test_parse_alert_spec_defaults_and_overrides():
     rules = {r.name: r for r in parse_alert_spec("")}
     assert set(rules) == {"step_spike", "mfu_floor", "goodput_floor",
-                          "restart_storm", "loader_starved", "mem_growth"}
+                          "restart_storm", "loader_starved", "mem_growth",
+                          "sdc_storm"}
     rules = {r.name: r for r in parse_alert_spec(
         "mfu_floor=0.3, step_spike=2.5, restart_storm=5"
     )}

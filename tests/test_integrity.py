@@ -1,0 +1,596 @@
+"""SDC defense (training.integrity + the --integrity-every plumbing):
+bit-pattern digests, majority-vote attribution, the 2-rank replay
+tiebreak, chaos ``bitflip`` injection, checkpoint content-hash sidecars,
+the torn-epoch rendezvous reader, and the closed-loop acceptance run —
+a bit flip on rank 2 must be detected, voted out, evicted via elastic
+resize (no restart budget, no checkpoint read), with the survivors'
+final state bitwise-equal to an uncorrupted reference run."""
+
+import json
+import os
+import pathlib
+import subprocess
+import sys
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import distributeddataparallel_tpu as ddp
+from distributeddataparallel_tpu.observability.alerts import (
+    SdcStorm,
+    parse_alert_spec,
+)
+from distributeddataparallel_tpu.observability.events import (
+    EventLog,
+    events_path,
+    load_timeline,
+)
+from distributeddataparallel_tpu.runtime.elastic_gang import (
+    reshard_live_state,
+)
+from distributeddataparallel_tpu.runtime.rendezvous import RendezvousStore
+from distributeddataparallel_tpu.training import integrity as integ
+from distributeddataparallel_tpu.training.checkpoint import (
+    Checkpointer,
+    state_content_hash,
+)
+from distributeddataparallel_tpu.training.state import TrainState
+from distributeddataparallel_tpu.training.train_step import make_train_step
+from distributeddataparallel_tpu.utils import chaos
+from distributeddataparallel_tpu.utils.metrics import FaultCounters
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+
+
+def _loss(params, batch, rng):
+    pred = batch["x"] @ params["w"] + params["b"]
+    return jnp.mean((pred - batch["y"]) ** 2), {}
+
+
+def _mk_state(mesh):
+    params = {
+        "w": jnp.arange(6, dtype=jnp.float32).reshape(3, 2) / 7.0,
+        "b": jnp.zeros((2,), jnp.float32),
+    }
+    state = TrainState.create(
+        apply_fn=None, params=params, tx=optax.adam(1e-2)
+    )
+    rep = NamedSharding(mesh, P())
+    return jax.tree.map(lambda x: jax.device_put(x, rep), state)
+
+
+def _mk_batch(mesh, rows=8):
+    batch = {
+        "x": jnp.ones((rows, 3), jnp.float32),
+        "y": jnp.ones((rows, 2), jnp.float32),
+    }
+    sh = NamedSharding(mesh, P("data"))
+    return jax.tree.map(lambda x: jax.device_put(x, sh), batch)
+
+
+# -- digests -------------------------------------------------------------
+
+
+def test_leaf_digest_bit_pattern_semantics():
+    """The digest fingerprints BITS, not values: a single low-mantissa
+    flip, a sign-of-zero change, or a different NaN payload all change
+    it — exactly the corruptions value-level comparison would hide."""
+    x = jnp.arange(16, dtype=jnp.float32) / 3.0
+    d = integ.leaf_digest(x)
+    assert d.dtype == jnp.uint32
+
+    u = np.asarray(x).view(np.uint32).copy()
+    u[5] ^= 1  # lowest mantissa bit
+    flipped = jnp.asarray(u.view(np.float32))
+    assert int(integ.leaf_digest(flipped)) != int(d)
+    assert bool(jnp.all(jnp.isfinite(flipped)))  # invisible to nan-guard
+
+    # odd count: an even number of sign bits cancels mod 2**32
+    zeros = jnp.zeros((3,), jnp.float32)
+    negzeros = -zeros
+    assert np.array_equal(np.asarray(zeros), np.asarray(negzeros))
+    assert int(integ.leaf_digest(zeros)) != int(integ.leaf_digest(negzeros))
+
+    # bf16 and int leaves digest too (the opt-state count leaf is int).
+    assert integ.leaf_digest(x.astype(jnp.bfloat16)).dtype == jnp.uint32
+    assert int(integ.leaf_digest(jnp.asarray(7, jnp.int32))) == 7
+
+
+def test_digest_parts_zero_levels():
+    """ZeRO-1 shards the optimizer flats, so only params stay in the
+    digest domain there; plain DP digests opt state too."""
+    state = _mk_state(jax.make_mesh((2,), ("data",),
+                                    devices=jax.devices()[:2]))
+    full = integ.digest_parts(state, 0)
+    z1 = integ.digest_parts(state, 1)
+    assert "opt_state" in full and "opt_state" not in z1
+    names = integ.digest_leaf_names(full)
+    assert len(names) == len(jax.tree.leaves(full))
+    assert any(n.startswith("params/") for n in names)
+
+
+# -- attribution ---------------------------------------------------------
+
+
+def test_vote_majority_and_ties():
+    m = np.asarray([[1, 2], [1, 2], [1, 2], [1, 2]], np.uint32)
+    assert integ.vote(m).ok
+
+    bad = m.copy()
+    bad[2, 1] = 99
+    v = integ.vote(bad, ["params/w", "params/b"])
+    assert (v.ok, v.corrupt, v.leaves, v.tie) == (
+        False, (2,), ("params/b",), False
+    )
+
+    # rank 0 corrupt: the majority is rows 1..3, not "whatever row 0 says"
+    bad0 = m.copy()
+    bad0[0, 0] = 99
+    assert integ.vote(bad0).corrupt == (0,)
+
+    # 2-rank split and all-rows-distinct: no strict majority
+    assert integ.vote(np.asarray([[1], [2]], np.uint32)).tie
+    assert integ.vote(
+        np.asarray([[1], [2], [3], [4]], np.uint32)
+    ).tie
+
+
+def test_apply_bitflip_diverges_exactly_one_rank():
+    mesh = jax.make_mesh((4,), ("data",), devices=jax.devices()[:4])
+    state = _mk_state(mesh)
+    digest = integ.make_digest_fn(mesh)
+
+    clean = np.asarray(jax.device_get(digest(state)))
+    assert (clean == clean[0:1]).all()
+
+    flipped = integ.apply_bitflip(state, rank=2, mesh=mesh, leaf="w")
+    mat = np.asarray(jax.device_get(digest(flipped)))
+    names = integ.digest_leaf_names(integ.digest_parts(state, 0))
+    v = integ.vote(mat, names)
+    assert v.corrupt == (2,)
+    assert v.leaves == ("params/w",)
+    # the flip is value-preservingly finite AND invisible off-rank
+    others = [r for r in range(4) if r != 2]
+    assert (mat[others] == clean[0]).all()
+
+    with pytest.raises(ValueError, match="out of range"):
+        integ.apply_bitflip(state, rank=9, mesh=mesh)
+    with pytest.raises(ValueError, match="no param leaf"):
+        integ.apply_bitflip(state, rank=1, mesh=mesh, leaf="nope")
+
+
+def test_copy_tree_preserves_per_rank_divergence():
+    """The arbiter's snapshots ride through ``copy_tree``; a copy that
+    collapsed a divergent "replicated" buffer to shard 0 would make the
+    replay tiebreak vacuous."""
+    mesh = jax.make_mesh((4,), ("data",), devices=jax.devices()[:4])
+    state = integ.apply_bitflip(_mk_state(mesh), rank=3, mesh=mesh)
+    digest = integ.make_digest_fn(mesh)
+    a = np.asarray(jax.device_get(digest(state)))
+    b = np.asarray(jax.device_get(digest(integ.copy_tree(state))))
+    assert np.array_equal(a, b)
+    assert integ.vote(a).corrupt == (3,)
+
+
+# -- the in-step digest + skip plumbing ----------------------------------
+
+
+def test_train_step_detects_on_cadence_and_skips_update():
+    mesh = jax.make_mesh((4,), ("data",), devices=jax.devices()[:4])
+    state = _mk_state(mesh)
+    batch = _mk_batch(mesh)
+    rng = jax.random.PRNGKey(0)
+    step = make_train_step(_loss, mesh=mesh, integrity_every=2)
+    assert step.aot_signature["integrity_every"] == 2
+
+    state, m = step(state, batch, rng)  # step 0: on cadence, clean
+    assert float(m["sdc_mismatch"]) == 0.0
+    state, m = step(state, batch, rng)  # step 1: off cadence
+    assert float(m["sdc_mismatch"]) == 0.0
+    assert not np.asarray(jax.device_get(m["sdc_digest"])).any()
+
+    state = integ.apply_bitflip(state, rank=3, mesh=mesh, leaf="w")
+    before = jax.device_get(state.params)
+    state, m = step(state, batch, rng)  # step 2: on cadence, corrupt
+    assert float(m["sdc_mismatch"]) == 1.0
+    mat = np.asarray(jax.device_get(m["sdc_digest"]))
+    names = integ.digest_leaf_names(integ.digest_parts(state, 0))
+    assert integ.vote(mat, names).corrupt == (3,)
+    # containment: the polluted update is discarded wholesale, only the
+    # step counter advances (nonfinite-guard skip semantics)
+    after = jax.device_get(state.params)
+    assert all(
+        np.array_equal(x, y)
+        for x, y in zip(jax.tree.leaves(before), jax.tree.leaves(after))
+    )
+    assert int(jax.device_get(state.step)) == 3
+
+
+def test_integrity_step_lints_clean():
+    """GL001 stays exact: the digest all_gather is declared in the step's
+    collective manifest, so the graph linter finds nothing."""
+    from distributeddataparallel_tpu.analysis.graph_lint import (
+        lint_train_step,
+    )
+
+    mesh = jax.make_mesh((4,), ("data",), devices=jax.devices()[:4])
+    state = _mk_state(mesh)
+    step = make_train_step(_loss, mesh=mesh, integrity_every=2)
+    rep = lint_train_step(
+        state=state,
+        batch={"x": jnp.ones((8, 3)), "y": jnp.ones((8, 2))},
+        rng=jax.random.PRNGKey(0),
+        step=step,
+    )
+    assert rep.ok, rep.findings
+    assert rep.collective_counts.get("data:all_gather") == 1
+
+
+def test_train_step_rejects_bad_integrity_configs():
+    mesh = jax.make_mesh((4,), ("data",), devices=jax.devices()[:4])
+    with pytest.raises(ValueError, match="integrity"):
+        make_train_step(_loss, mesh=mesh, integrity_every=0)
+    with pytest.raises(ValueError, match="integrity"):
+        make_train_step(_loss, mesh=mesh, integrity_every=2,
+                        grad_sync=False)
+    with pytest.raises(ValueError, match="integrity"):
+        make_train_step(_loss, mesh=mesh, integrity_every=2, zero=2)
+
+
+# -- 2-rank replay tiebreak ----------------------------------------------
+
+
+def test_shadow_arbiter_breaks_two_rank_tie():
+    mesh = jax.make_mesh((2,), ("data",), devices=jax.devices()[:2])
+    state = _mk_state(mesh)
+    batch = _mk_batch(mesh, rows=4)
+    rng = jax.random.PRNGKey(1)
+    step = make_train_step(_loss, mesh=mesh, donate=False)
+    digest = integ.make_digest_fn(mesh)
+
+    arb = integ.ShadowArbiter(step, digest)
+    arb.commit(integ.copy_tree(state))
+    arb.hold(batch, rng)
+
+    live, _ = step(state, batch, rng)
+    live = integ.apply_bitflip(live, rank=1, mesh=mesh, leaf="b")
+    mat = np.asarray(jax.device_get(digest(live)))
+    assert integ.vote(mat).tie  # 2 ranks: voting alone cannot attribute
+
+    v = arb.resolve(mat)
+    assert (v.ok, v.corrupt, v.method) == (False, (1,), "replay")
+
+    # no snapshot committed yet -> stays an unresolved tie
+    assert integ.ShadowArbiter(step, digest).resolve(mat).tie
+
+
+def test_integrity_checker_events_and_counters(tmp_path):
+    counters = FaultCounters()
+    events = EventLog(events_path(str(tmp_path), 0), proc=0)
+    chk = integ.IntegrityChecker(
+        every=2, leaf_names=["params/w"], events=events, counters=counters
+    )
+    assert chk.due(0) and not chk.due(1) and chk.due(4)
+    with pytest.raises(ValueError, match="cadence"):
+        integ.IntegrityChecker(every=0)
+
+    clean = np.asarray([[1], [1], [1]], np.uint32)
+    assert chk.check(clean, step=0).ok
+    bad = np.asarray([[1], [9], [1]], np.uint32)
+    v = chk.check(bad, step=2)
+    assert v.corrupt == (1,)
+    chk.note_eviction(1, step=2)
+    chk.note_shadow_mismatch(step=4)
+    events.close()
+
+    assert (counters.sdc_checks, counters.sdc_detects,
+            counters.sdc_evictions) == (2, 2, 1)
+    s = counters.summary()
+    assert s["sdc_detects"] == 2 and s["sdc_evictions"] == 1
+
+    recs = load_timeline(str(tmp_path))
+    kinds = [r["kind"] for r in recs]
+    assert kinds.count("sdc_check") == 2
+    detects = [r for r in recs if r["kind"] == "sdc_detect"]
+    assert [d["rank"] for d in detects] == [1, -1]
+    assert detects[0]["leaves"] == ["params/w"]
+    assert detects[1]["method"] == "shadow"
+    evict = next(r for r in recs if r["kind"] == "sdc_evict")
+    assert (evict["rank"], evict["step"]) == (1, 2)
+
+
+# -- eviction repair path ------------------------------------------------
+
+
+def test_reshard_live_state_source_avoids_corrupt_device():
+    """``source=`` is the repair guarantee: after evicting rank 0, the
+    survivors must re-replicate from a device voted healthy — the
+    default (device 0) would copy the corruption forward."""
+    old = jax.make_mesh((4,), ("data",), devices=jax.devices()[:4])
+    new = jax.make_mesh((3,), ("data",),
+                        devices=jax.devices()[1:4])
+    clean = _mk_state(old)
+    ref = np.asarray(jax.device_get(clean.params["w"]))
+    corrupt = integ.apply_bitflip(clean, rank=0, mesh=old, leaf="w")
+
+    healed = reshard_live_state(corrupt, old, new, source=2)
+    assert np.array_equal(
+        np.asarray(jax.device_get(healed.params["w"])), ref
+    )
+    # without source, device_get reads device 0 — the corrupt bytes
+    poisoned = reshard_live_state(corrupt, old, new)
+    assert not np.array_equal(
+        np.asarray(jax.device_get(poisoned.params["w"])), ref
+    )
+    with pytest.raises(ValueError, match="source"):
+        reshard_live_state(corrupt, old, new, source=7)
+
+
+# -- chaos grammar (satellite: doc table + parse-time rejection) ---------
+
+
+def test_chaos_bitflip_parse_accept():
+    for spec, arg in (
+        ("bitflip@6", None),
+        ("bitflip@6:2", "2"),
+        ("bitflip@6:2:Dense_0/kernel", "2:Dense_0/kernel"),
+    ):
+        (e,) = chaos.parse_chaos_spec(spec)
+        assert (e.kind, e.step, e.arg) == ("bitflip", 6, arg)
+
+
+@pytest.mark.parametrize("bad", [
+    "bitflip@6:-1",     # negative rank
+    "bitflip@6:r2",     # non-integer rank
+    "bitflip@-1",       # negative step
+    "bitflip@",         # missing step
+    "bitflips@6",       # unknown kind
+])
+def test_chaos_bitflip_parse_reject_names_grammar(bad):
+    """Every rejection must print the FULL grammar, bitflip row
+    included — the error message is the spec's discoverability."""
+    with pytest.raises(ValueError) as ei:
+        chaos.parse_chaos_spec(bad)
+    msg = str(ei.value)
+    assert "bitflip@S[:R][:leaf]" in msg
+    for kind in chaos.KINDS:
+        assert kind in msg
+
+
+def test_chaos_doc_table_lists_every_kind():
+    """The module docstring's grammar table and the README chaos spec
+    both enumerate KINDS exactly — a kind added to the parser but not
+    the docs (or vice versa) fails here, not in a user's terminal."""
+    doc = chaos.__doc__
+    readme = (REPO / "README.md").read_text()
+    for kind in chaos.KINDS:
+        assert f"{kind}@" in doc, f"{kind} missing from chaos docstring"
+        assert f"{kind}@" in readme, f"{kind} missing from README"
+    assert "bitflip@S[:R][:leaf]" in doc
+
+
+def test_chaos_corrupt_state_without_mesh_warns_not_crashes():
+    inj = chaos.FaultInjector("bitflip@0")
+    state = object()
+    assert inj.corrupt_state(state, 0, mesh=None) is state
+
+
+# -- alerting ------------------------------------------------------------
+
+
+def test_sdc_storm_rule():
+    rule = SdcStorm(max_detects=2)
+    assert rule.evaluate({}) is None  # integrity not wired: no signal
+    fired, _, detail = rule.evaluate({"sdc_detects": 1})
+    assert not fired
+    fired, refires, detail = rule.evaluate({"sdc_detects": 2})
+    assert fired and not refires and detail["threshold"] == 2
+    with pytest.raises(ValueError, match=">= 1"):
+        SdcStorm(0)
+    rules = parse_alert_spec("sdc_storm=3")
+    storm = next(r for r in rules if r.name == "sdc_storm")
+    assert storm.max_detects == 3
+
+
+# -- checkpoint content-hash sidecar (satellite) -------------------------
+
+
+def test_checkpoint_hash_sidecar_roundtrip_and_corruption(tmp_path):
+    mesh = jax.make_mesh((2,), ("data",), devices=jax.devices()[:2])
+    state = _mk_state(mesh)
+
+    ck = Checkpointer(str(tmp_path))
+    ck.save(state, 0)
+    ck.wait()
+    assert (tmp_path / "hash_0.json").exists()
+    saved = ck.read_hash(0)
+    assert saved == state_content_hash(state)
+
+    # clean roundtrip verifies
+    restored, nxt = ck.restore_latest(state)
+    assert nxt == 1
+
+    # corrupted-but-parseable bytes: flip the recorded hash (equivalent
+    # to flipping array bytes — the comparison is symmetric) and the
+    # same restore becomes a loud ValueError
+    with open(tmp_path / "hash_0.json", "w") as fh:
+        json.dump({"sha256": "0" * 64}, fh)
+    with pytest.raises(ValueError, match="content-hash"):
+        ck.restore_latest(state)
+
+    # legacy checkpoint (no sidecar): restores unverified
+    os.remove(tmp_path / "hash_0.json")
+    assert ck.read_hash(0) is None
+    _, nxt = ck.restore_latest(state)
+    assert nxt == 1
+
+
+def test_resilient_restore_quarantines_hash_mismatch(tmp_path):
+    """A hash-mismatched step behaves like any corrupt checkpoint:
+    quarantined, and the next older verified step wins."""
+    from distributeddataparallel_tpu.training.fault_tolerance import (
+        ResilientCheckpointer,
+    )
+
+    mesh = jax.make_mesh((2,), ("data",), devices=jax.devices()[:2])
+    s0 = _mk_state(mesh)
+    s1 = s0.replace(params=jax.tree.map(lambda x: x + 1.0, s0.params))
+
+    ck = ResilientCheckpointer(str(tmp_path))
+    ck.save(s0, 0)
+    ck.save(s1, 1)
+    ck.wait()
+    with open(tmp_path / "hash_1.json", "w") as fh:
+        json.dump({"sha256": "f" * 64}, fh)
+
+    restored, nxt = ck.restore_latest(s0)
+    assert nxt == 1  # fell back to step 0
+    assert np.array_equal(
+        np.asarray(jax.device_get(restored.params["b"])),
+        np.asarray(jax.device_get(s0.params["b"])),
+    )
+    assert any(p.name.endswith(".corrupt") for p in tmp_path.iterdir())
+
+
+# -- rendezvous torn-write reader (satellite) ----------------------------
+
+
+def test_rendezvous_epoch_missing_vs_torn(tmp_path):
+    store = RendezvousStore(str(tmp_path))
+    # missing file genuinely means "no transition yet"
+    assert store.epoch() == {"epoch": -1, "roster": []}
+
+    # transiently torn record: a concurrent atomic replace lands while
+    # the reader is retrying — the reader must return the fixed record
+    path = tmp_path / "epoch.json"
+    path.write_text('{"epoch": 3, "roster": ["w0"')  # truncated write
+
+    def fix():
+        rec = {"epoch": 3, "roster": ["w0"]}
+        tmp = tmp_path / ".epoch.tmp"
+        tmp.write_text(json.dumps(rec))
+        os.replace(tmp, path)
+
+    t = threading.Timer(0.08, fix)
+    t.start()
+    try:
+        assert store.epoch()["epoch"] == 3
+    finally:
+        t.join()
+
+    # persistently torn: a bounded retry, then a LOUD error — never a
+    # silent reset to epoch -1 (that forks membership history)
+    path.write_text('{"epoch": 4, "roster": ["w0"')
+    with pytest.raises(RuntimeError, match="torn or corrupt"):
+        store.epoch()
+
+
+# -- CLI validation ------------------------------------------------------
+
+
+def _run_dpp(args, timeout=300):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("_DDP_SUPERVISED", None)
+    env.pop("DDP_ELASTIC_WORLD", None)
+    return subprocess.run(
+        [sys.executable, str(REPO / "dpp.py"), *args],
+        capture_output=True, text=True, timeout=timeout, env=env,
+        cwd=str(REPO),
+    )
+
+
+def test_cli_shadow_requires_cadence():
+    r = _run_dpp(["--model", "mlp", "--integrity-shadow"])
+    assert r.returncode != 0
+    assert "--integrity-every" in (r.stdout + r.stderr)
+
+
+def test_cli_integrity_rejects_sharded_state():
+    r = _run_dpp(["--model", "mlp", "--integrity-every", "2",
+                  "--zero", "2"])
+    assert r.returncode != 0
+    assert "--zero 1" in (r.stdout + r.stderr)
+
+
+# -- the closed-loop acceptance run --------------------------------------
+
+
+def test_bitflip_detect_evict_matches_clean_run(tmp_path):
+    """ISSUE 14's acceptance bar, end to end: run A takes a silent bit
+    flip on rank 2 at step 6; the digest (cadence 2) catches it at the
+    very next check, the vote names rank 2, the gang resizes 8 -> 7 with
+    no restart budget and no checkpoint read, and training finishes.
+
+    Run B is the uncorrupted control at the same shrunk size: the SAME
+    program (same flags, so identical compiled step) skips step 6 via
+    the nan-guard and loses rank 2 to a plain worker-kill at the same
+    poll.  Both runs therefore execute identical updates on identical
+    data — so their final checkpoints must be BITWISE equal, which the
+    content-hash sidecars prove without touching an array file.
+    """
+    common = [
+        "--model", "mlp", "--fake-devices", "8", "--batch-size", "4",
+        "--epochs", "1", "--steps-per-epoch", "10",
+        "--elastic", "--integrity-every", "2", "--nan-guard",
+    ]
+    out = {}
+    for name, spec in (
+        ("flip", "bitflip@6:2"),
+        ("clean", "nan-grad@6,worker-kill@6:2"),
+    ):
+        ev = tmp_path / f"ev_{name}"
+        ck = tmp_path / f"ck_{name}"
+        r = _run_dpp(common + [
+            "--chaos", spec,
+            "--events-dir", str(ev), "--checkpoint-dir", str(ck),
+        ])
+        assert r.returncode == 0, (r.stdout + r.stderr)[-2000:]
+        out[name] = (r.stdout + r.stderr, load_timeline(str(ev)), ck)
+
+    log, recs, _ = out["flip"]
+    kinds = [r.get("kind") for r in recs]
+    # detection within one cadence window, attribution names rank 2
+    detect = next(r for r in recs if r.get("kind") == "sdc_detect")
+    assert detect["rank"] == 2 and detect["step"] == 6
+    assert detect["method"] == "vote" and not detect["tie"]
+    evict = next(r for r in recs if r.get("kind") == "sdc_evict")
+    assert evict["rank"] == 2
+    # repair is an elastic resize, not a restart — and no checkpoint
+    # was read before it landed
+    assert kinds.count("gang_resize") == 1, kinds
+    assert "restart_attempt" not in kinds, kinds
+    resize = next(r for r in recs if r.get("kind") == "gang_resize")
+    assert (resize["old_size"], resize["new_size"]) == (8, 7)
+    assert resize["left"] == ["proc2"]
+    t_resize = resize["ts"]
+    assert not any(
+        r.get("kind") == "span" and "ckpt" in str(r.get("name"))
+        and r["ts"] <= t_resize for r in recs
+    )
+    assert "no checkpoint read" in log
+
+    # bitwise parity with the uncorrupted control at the shrunk size
+    def final_hash(ck):
+        steps = sorted(
+            int(p.name[len("hash_"):-5])
+            for p in ck.iterdir() if p.name.startswith("hash_")
+        )
+        assert steps, f"no hash sidecar in {ck}"
+        with open(ck / f"hash_{steps[-1]}.json") as fh:
+            return json.load(fh)["sha256"]
+
+    assert final_hash(out["flip"][2]) == final_hash(out["clean"][2])
+    # the control really did shrink the same way (same survivors)
+    clean_recs = out["clean"][1]
+    c_resize = next(
+        r for r in clean_recs if r.get("kind") == "gang_resize"
+    )
+    assert c_resize["left"] == ["proc2"]
+    assert not any(
+        r.get("kind") == "sdc_detect" for r in clean_recs
+    )
