@@ -185,6 +185,46 @@ def _build_case(model: str, mode: str, mesh, batch_per_chip: int,
     raise ValueError(f"unknown simulation mode {mode!r} (have {MODES})")
 
 
+def analytic_memory_fit(
+    *,
+    params_bytes: int,
+    params_count: int,
+    n_devices: int,
+    zero_level: int = 0,
+    moment_bytes_per_param: float = 8.0,
+    act_bytes: int = 0,
+    batch_bytes: int = 0,
+    budget_bytes: int,
+) -> dict:
+    """Per-chip memory-fit verdict WITHOUT compiling — the ``--no-compile``
+    analytic counterpart of the ``executable_memory_analysis`` fit.
+
+    Residency follows the ZeRO ladder (parallel.zero): optimizer moments
+    shard 1/N at level >= 1, gradients at level >= 2, params at level
+    >= 3.  ``moment_bytes_per_param`` defaults to adam's two f32 moments
+    (8 B); low-bit moment storage (``--moment-dtype``) passes 4 (bf16)
+    or 2 (int8).  ``act_bytes``/``batch_bytes`` are the caller's
+    per-chip activation / input estimates.  Deliberately coarse — the
+    compiled path stays the ground truth — but directionally right,
+    which is all analytic pruning (the autotuner's first stage) needs.
+    """
+    n = max(1, int(n_devices))
+    required = (
+        params_bytes / (n if zero_level >= 3 else 1)      # resident params
+        + params_bytes / (n if zero_level >= 2 else 1)    # gradients
+        + params_count * moment_bytes_per_param
+        / (n if zero_level >= 1 else 1)                   # optimizer moments
+        + act_bytes
+        + batch_bytes
+    )
+    return {
+        "required_bytes": int(required),
+        "budget_bytes": int(budget_bytes),
+        "fits": bool(required <= budget_bytes),
+        "analytic": True,
+    }
+
+
 def _lowered(step, state, batch, rng):
     """AOT-lower on abstract args.  ``make_train_step`` steps expose
     ``.lower``; wrapper factories (fsdp/pp) populate ``.jitted`` when
@@ -323,6 +363,25 @@ def simulate(
                 "sim_temp_bytes": int(mem.get("temp_bytes", 0)),
                 "sim_argument_bytes": int(mem.get("argument_bytes", 0)),
             }
+    else:
+        # No-compile path: the analytic ladder still yields a fit
+        # verdict, so `--no-compile` sweeps (and the autotuner's pruning
+        # stage, which reuses this helper) reject infeasible configs
+        # without paying a single compile.
+        params_bytes = sum(int(l.size) * l.dtype.itemsize for l in leaves)
+        params_count = sum(int(l.size) for l in leaves)
+        batch_bytes = sum(
+            int(l.size) * l.dtype.itemsize
+            for l in jax.tree.leaves(batch)
+        ) // n
+        record["fit"] = analytic_memory_fit(
+            params_bytes=params_bytes,
+            params_count=params_count,
+            n_devices=n,
+            zero_level=3 if mode == "fsdp" else ZERO_LEVELS.get(mode, 0),
+            batch_bytes=batch_bytes,
+            budget_bytes=budget,
+        )
     return record
 
 

@@ -166,6 +166,34 @@ def train_step_flops(
     }
 
 
+#: assumed achievable fraction of peak for analytic step-time
+#: prediction — deliberately a single scalar, not a tuned model: the
+#: autotuner uses predictions only to RANK candidates (a shared
+#: efficiency factor cancels in the ranking), and ddp_report's
+#: predicted-vs-measured drift table shows how wrong it was.
+DEFAULT_EFFICIENCY = 0.35
+
+
+def predict_step_s(
+    hardware_flops: float,
+    *,
+    n_chips: int,
+    peak_flops_per_chip: float | None,
+    efficiency: float = DEFAULT_EFFICIENCY,
+) -> float | None:
+    """Analytic step-time prediction: hardware FLOPs over assumed
+    achieved throughput.  None when the peak is unknown (better no
+    prediction than one against a made-up denominator — same policy as
+    ``peak_flops_for``).  This is the autotuner's pruning/ranking
+    signal; measured windows are the ground truth it drifts against.
+    """
+    if not peak_flops_per_chip or hardware_flops <= 0:
+        return None
+    return float(hardware_flops) / (
+        peak_flops_per_chip * max(1, n_chips) * efficiency
+    )
+
+
 def xla_cost_analysis(lowered) -> dict | None:
     """Normalize ``jax.stages.Lowered.cost_analysis()`` across jax
     versions (dict vs one-element list of dicts) into

@@ -78,6 +78,11 @@ EVENT_KINDS: dict[str, tuple[str, ...]] = {
     "decode_step": ("step", "n_active"),
     "request_done": ("req", "ttft_s", "tokens"),
     "kv_evict": ("blocks",),
+    # Autotuner (tuning/): one record per candidate config (status =
+    # pruned-memory / pruned-cost / baseline / measured / error: ...)
+    # and one per search or apply outcome (winner = trial label or None).
+    "tune_trial": ("trial", "status"),
+    "tune_result": ("mode", "winner"),
 }
 
 

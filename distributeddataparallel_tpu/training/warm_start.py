@@ -53,6 +53,15 @@ STORE_VERSION = 1
 _AOT_SUFFIX = ".aotx"
 _META_SUFFIX = ".json"
 
+#: reserved store entry holding store-LEVEL metadata (capability probe
+#: results), as opposed to the per-executable ``<name>.json`` metas
+_STORE_META_NAME = "_store"
+
+# probe_reserialize_capability result per runtime-versions fingerprint —
+# the probe compiles a (trivial) program, so one round per process is
+# plenty even when many stores are opened.
+_RESERIALIZE_PROBE: dict[str, bool] = {}
+
 
 class WarmStartMismatch(RuntimeError):
     """A stored executable's key does not match the live run (strict mode)."""
@@ -162,6 +171,52 @@ def runtime_versions() -> dict:
     return versions
 
 
+def probe_reserialize_capability() -> bool:
+    """Can this jaxlib re-serialize an executable it LOADED?
+
+    The deploy-critical limitation (CHANGES PR 2): on some jaxlib
+    versions, serializing an executable that the persistent compile
+    cache handed back (rather than one freshly compiled) produces an
+    incomplete payload that fails on the next load ("Symbols not
+    found").  This probes the actual behaviour once per process with a
+    trivial program — serialize, load, serialize the LOADED executable
+    again, load that, and run it.  ``ExecutableStore`` records the
+    verdict in its store metadata at open, so save-path decisions are
+    explicit and inspectable instead of a hardcoded skip.
+    """
+    fingerprint = json.dumps(runtime_versions(), sort_keys=True)
+    cached = _RESERIALIZE_PROBE.get(fingerprint)
+    if cached is not None:
+        return cached
+    ok = False
+    try:
+        import jax.numpy as jnp
+        import numpy as np
+        from jax.experimental import serialize_executable
+
+        x = jnp.arange(4, dtype=jnp.float32)
+        compiled = jax.jit(lambda v: v * 2.0 + 1.0).lower(x).compile()
+        in_tree = jax.tree_util.tree_flatten(((x,), {}))[1]
+        out_tree = jax.tree_util.tree_flatten(x)[1]
+        payload, _, _ = serialize_executable.serialize(compiled)
+        loaded = serialize_executable.deserialize_and_load(
+            payload, in_tree, out_tree
+        )
+        payload2, _, _ = serialize_executable.serialize(loaded)
+        loaded2 = serialize_executable.deserialize_and_load(
+            payload2, in_tree, out_tree
+        )
+        ok = bool(
+            np.allclose(np.asarray(loaded2(x)), np.asarray(x) * 2.0 + 1.0)
+        )
+    # ddplint: allow[broad-except] — any probe fault means "cannot":
+    # the capability record must always be writable, never a crash
+    except Exception:  # noqa: BLE001
+        ok = False
+    _RESERIALIZE_PROBE[fingerprint] = ok
+    return ok
+
+
 def executable_key(
     *,
     mesh=None,
@@ -244,11 +299,54 @@ class ExecutableStore:
     the differing fields and returns None (or raises, ``strict=True``)
     — the caller falls back to JIT, loudly, never silently runs a stale
     binary.
+
+    Store-level metadata lives in the reserved ``_store.json`` entry:
+    opening the store probes whether this jaxlib can re-serialize a
+    cache-returned executable (``probe_reserialize_capability``) and
+    records ``reserialize_ok``, which the save paths consult instead of
+    unconditionally skipping cache-hit saves.  The record is keyed to
+    the runtime versions, so a toolchain upgrade re-probes.
     """
 
-    def __init__(self, root: str):
+    def __init__(self, root: str, *, probe: bool = True):
         self.root = os.path.abspath(os.path.expanduser(root))
         os.makedirs(self.root, exist_ok=True)
+        self.reserialize_ok = self._open_capability(probe)
+
+    def _open_capability(self, probe: bool) -> bool:
+        """Read ``_store.json``'s capability record, probing (and
+        writing it) when absent or stale; ``probe=False`` skips the
+        probe compile and conservatively reports False."""
+        path = os.path.join(self.root, _STORE_META_NAME + _META_SUFFIX)
+        versions = runtime_versions()
+        try:
+            with open(path) as fh:
+                meta = json.load(fh)
+        except (OSError, ValueError):
+            meta = None
+        if (
+            isinstance(meta, dict)
+            and meta.get("versions") == versions
+            and isinstance(meta.get("reserialize_ok"), bool)
+        ):
+            return meta["reserialize_ok"]
+        if not probe:
+            return False
+        ok = probe_reserialize_capability()
+        record = {
+            "version": STORE_VERSION,
+            "versions": versions,
+            "reserialize_ok": ok,
+        }
+        tmp = path + ".tmp"
+        with open(tmp, "w") as fh:
+            fh.write(json.dumps(record, indent=1, sort_keys=True))
+        os.replace(tmp, path)
+        return ok
+
+    def store_meta(self) -> dict | None:
+        """The store-level metadata record (capability probe results)."""
+        return self.meta(_STORE_META_NAME)
 
     def _paths(self, name: str) -> tuple[str, str]:
         base = os.path.join(self.root, name)
@@ -275,6 +373,8 @@ class ExecutableStore:
             if not fname.endswith(_META_SUFFIX):
                 continue
             name = fname[: -len(_META_SUFFIX)]
+            if name == _STORE_META_NAME:  # store-level record, not an entry
+                continue
             m = self.meta(name)
             if m is not None:
                 out[name] = m
@@ -383,12 +483,30 @@ class ExecutableStore:
 def _metric_keys_of(compiled) -> list[str]:
     """Metric names from a compiled step's output treedef: unflattening
     with dummy leaves yields the (state, metrics) skeleton — the dict
-    keys are structural aux data, no execution needed."""
+    keys are structural aux data, no execution needed.  Programs that
+    are not (state, metrics)-shaped (the precompiler takes arbitrary
+    jobs) simply have no metric keys."""
     out_tree = compiled.out_tree
     skeleton = jax.tree_util.tree_unflatten(
         out_tree, [0] * out_tree.num_leaves
     )
-    return sorted(skeleton[1].keys())
+    try:
+        return sorted(skeleton[1].keys())
+    except (TypeError, IndexError, AttributeError):
+        return []
+
+
+def _save_allowed(store: ExecutableStore, cache_hits: int, meta) -> bool:
+    """May this compile result be serialized into the store?
+
+    A fresh compile (no persistent-cache hit) or a first-ever artifact
+    always saves.  A cache-HIT compile re-serializes only when the
+    store's open-time capability probe (``reserialize_ok`` in
+    ``_store.json``) says this jaxlib round-trips cache-returned
+    executables soundly — otherwise the payload would be incomplete
+    ("Symbols not found" on the next load).
+    """
+    return cache_hits == 0 or meta is None or store.reserialize_ok
 
 
 def precompile_step(
@@ -405,9 +523,10 @@ def precompile_step(
 
     This is the unit of work behind topology-portable warm starts: the
     elastic runtime calls it for the N±1 meshes so a resize lands on an
-    AOT load instead of a cold compile.  The save honours the same
-    fresh-compile-only rule as ``warm_train_step`` (re-serializing a
-    persistent-cache hit produces broken payloads on this jaxlib).
+    AOT load instead of a cold compile, and the autotuner calls it to
+    hide each candidate's compile behind the previous candidate's
+    measurement.  The save honours the store's ``reserialize_ok``
+    capability record (``_save_allowed``).
     """
     meta = store.meta(name)
     if meta is not None and not _key_diff(meta.get("key", {}), key):
@@ -418,47 +537,100 @@ def precompile_step(
         compiled = fn.lower(*example_args).compile()
     finally:
         stats.close()
-    if stats.hits == 0 or meta is None:
+    if _save_allowed(store, stats.hits, meta):
         store.save(name, key, compiled, metric_keys=_metric_keys_of(compiled))
         return True
+    get_logger().info(
+        "not re-serializing cache-hit compile of %r: reserialize_ok=False "
+        "in store metadata for this jaxlib", name,
+    )
     return False
 
 
 class BackgroundPrecompiler:
     """Run ``precompile_step`` jobs on a daemon thread, serially.
 
-    ``jobs`` is a sequence of ``(name, key, build)`` triples; ``build()``
-    runs ON the worker thread and returns ``(step_fn, example_args)`` —
+    Jobs are arbitrary ``(name, key, build)`` triples; ``build()`` runs
+    ON the worker thread and returns ``(step_fn, example_args)`` —
     deferring mesh construction and abstract-template building off the
-    training loop's critical path.  Failures are swallowed per-job (a
-    pre-compile is an optimization, never a correctness gate) and land
-    in ``report`` as ``{"name": "saved"|"cached"|"error: ..."}``.
+    caller's critical path.  Two producers share this one
+    background-compile path:
+
+    - the elastic runtime seeds the constructor with the N±1 world-size
+      steps so a resize lands on an AOT load instead of a cold compile;
+    - the autotuner ``submit()``s the NEXT candidate's step while the
+      current candidate is being measured, hiding compile behind
+      measurement.
+
+    Failures are swallowed per-job (a pre-compile is an optimization,
+    never a correctness gate) and land in ``report`` as
+    ``{"name": "saved"|"cached"|"error: ..."}``.  ``join()`` MUST run
+    before interpreter teardown (a live XLA compile at shutdown
+    std::terminates); it closes the queue — a later ``submit`` raises —
+    and waits for the worker to drain.
     """
 
-    def __init__(self, store: ExecutableStore, jobs: Sequence[tuple]):
+    def __init__(self, store: ExecutableStore, jobs: Sequence[tuple] = ()):
+        import queue
         import threading
 
         self._store = store
-        self._jobs = list(jobs)
+        self._q: Any = queue.SimpleQueue()
+        self._lock = threading.Lock()
+        self._pending = 0
+        self._closed = False
+        self._idle = threading.Event()
+        self._idle.set()
         self.report: dict[str, str] = {}
         self._thread = threading.Thread(
             target=self._run, name="ddp-precompile", daemon=True
         )
+        for job in jobs:
+            self.submit(*job)
+
+    def submit(self, name: str, key: dict, build: Callable) -> None:
+        """Enqueue one pre-compile job; raises once ``join()`` has
+        closed the queue (the shutdown guard must stay authoritative)."""
+        with self._lock:
+            if self._closed:
+                raise RuntimeError(
+                    "BackgroundPrecompiler.submit after join()"
+                )
+            self._pending += 1
+            self._idle.clear()
+        self._q.put((name, key, build))
 
     def start(self) -> "BackgroundPrecompiler":
         self._thread.start()
         return self
 
     def join(self, timeout: float | None = None) -> None:
-        self._thread.join(timeout)
+        with self._lock:
+            if not self._closed:
+                self._closed = True
+                self._q.put(None)  # wake the worker to exit
+        if self._thread.ident is not None:  # never-started: nothing runs
+            self._thread.join(timeout)
+
+    def wait(self, timeout: float | None = None) -> bool:
+        """Block until every submitted job has completed (queue drained);
+        True on drain, False on timeout.  Unlike ``join`` this keeps the
+        queue open — the caller can submit more work after."""
+        return self._idle.wait(timeout)
 
     @property
     def done(self) -> bool:
-        return not self._thread.is_alive()
+        """Every job submitted so far has completed."""
+        with self._lock:
+            return self._pending == 0
 
     def _run(self) -> None:
         log = get_logger()
-        for name, key, build in self._jobs:
+        while True:
+            job = self._q.get()
+            if job is None:
+                return
+            name, key, build = job
             try:
                 step_fn, example_args = build()
                 fresh = precompile_step(
@@ -473,10 +645,15 @@ class BackgroundPrecompiler:
             except Exception as exc:  # noqa: BLE001
                 self.report[name] = f"error: {type(exc).__name__}: {exc}"
                 log.warning(
-                    "background pre-compile of %r failed (%s: %s) — a "
-                    "resize to that topology will cold-compile instead",
+                    "background pre-compile of %r failed (%s: %s) — that "
+                    "config will cold-compile when first used instead",
                     name, type(exc).__name__, exc,
                 )
+            finally:
+                with self._lock:
+                    self._pending -= 1
+                    if self._pending == 0:
+                        self._idle.set()
 
 
 def warm_train_step(
@@ -558,13 +735,18 @@ def warm_train_step(
             cache_hits=stats.hits,
         )
         try:
-            # Save only a FRESH compile: re-serializing an executable the
-            # persistent cache handed back produced incomplete payloads
-            # ("Symbols not found" on the next load) on this jaxlib.
-            if stats.hits == 0 or store.meta(name) is None:
+            # Cache-hit compiles re-serialize only when the store's
+            # capability record says this jaxlib round-trips them
+            # soundly (_save_allowed / probe_reserialize_capability).
+            if _save_allowed(store, stats.hits, store.meta(name)):
                 store.save(
                     name, key, compiled,
                     metric_keys=_metric_keys_of(compiled),
+                )
+            else:
+                log.info(
+                    "not re-serializing cache-hit compile of %r: "
+                    "reserialize_ok=False in store metadata", name,
                 )
         # ddplint: allow[broad-except] — saving is best-effort
         except Exception as exc:  # noqa: BLE001 — saving is best-effort
@@ -672,9 +854,10 @@ def warm_program(
             cache_hits=stats.hits,
         )
         try:
-            # Fresh compiles only (see warm_train_step: re-serializing a
-            # cache-returned executable produced incomplete payloads).
-            if stats.hits == 0 or store.meta(name) is None:
+            # Same save policy as warm_train_step: cache-hit compiles
+            # re-serialize only when the store's capability record
+            # allows it (_save_allowed).
+            if _save_allowed(store, stats.hits, store.meta(name)):
                 store.save(name, key, compiled, metric_keys=())
         # ddplint: allow[broad-except] — saving is best-effort
         except Exception as exc:  # noqa: BLE001

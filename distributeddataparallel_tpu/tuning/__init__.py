@@ -1,0 +1,37 @@
+"""Attribution-driven autotuner.
+
+Turns the performance-attribution layer's numbers (cost model, memory
+budget, MFU gauge) into decisions: enumerate a typed config space,
+prune analytically, measure the top-K with short windows (compiles
+hidden behind measurement via the warm-start background precompiler),
+and persist the winner keyed by the same topology/model fingerprint the
+executable store uses — so ``dpp.py --autotune apply`` reaches its
+first step on a previously-tuned host with zero search.
+
+Entry points: ``scripts/ddp_tune.py`` (search/apply/report CLI),
+``dpp.py --autotune``, and ``search_model`` for programmatic use.
+"""
+
+from distributeddataparallel_tpu.tuning.autotuner import (  # noqa: F401
+    Autotuner,
+    TrialRecord,
+)
+from distributeddataparallel_tpu.tuning.harness import (  # noqa: F401
+    TUNE_MODELS,
+    build_trial_case,
+    canonical_model,
+    default_space_for,
+    default_tuned_key,
+    measure_trial,
+    model_statics,
+    search_model,
+    trial_key,
+)
+from distributeddataparallel_tpu.tuning.space import (  # noqa: F401
+    SearchSpace,
+    TrialConfig,
+)
+from distributeddataparallel_tpu.tuning.store import (  # noqa: F401
+    TuningStore,
+    tuned_key,
+)

@@ -1,0 +1,622 @@
+"""Model harness + end-to-end search entry point for the autotuner.
+
+``build_trial_case`` is the knob-parameterized sibling of
+``analysis.mesh_sim._build_case``: same model registry, but each trial's
+``TrialConfig`` reaches every layer it tunes — remat into the
+transformer config, accumulation/bucket/ZeRO level into the train-step
+factory, moment dtype into ``zero_state`` — and the case can be built
+either concrete (for measurement) or abstract (``jax.eval_shape`` /
+``ShapeDtypeStruct``, for background AOT compiles).
+
+``search_model`` is the orchestrator the CLI, dpp.py, and the bench all
+call: statics → predictions → ``Autotuner.search`` (with the next
+candidate background-compiled through ``BackgroundPrecompiler`` while
+the current one is measured) → winner persisted in the ``TuningStore``
+→ ``tune_result`` event.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any
+
+from distributeddataparallel_tpu.tuning.autotuner import Autotuner
+from distributeddataparallel_tpu.tuning.space import SearchSpace, TrialConfig
+from distributeddataparallel_tpu.tuning.store import TuningStore, tuned_key
+from distributeddataparallel_tpu.utils.logging import get_logger
+
+#: models the tuner can search (the mesh_sim registry)
+TUNE_MODELS = ("mlp", "cnn", "tiny-lm", "gpt2-small")
+
+#: dpp.py model names -> registry names
+_ALIASES = {"gpt2": "gpt2-small"}
+
+#: optimizer moment bytes per param for the analytic memory ladder
+#: (adam: two moments; see parallel.zero.low_bit_moments)
+_MOMENT_BYTES = {"f32": 8.0, "bf16": 4.0, "int8": 2.0}
+
+
+def canonical_model(name: str) -> str:
+    name = _ALIASES.get(name, name)
+    if name not in TUNE_MODELS:
+        raise ValueError(
+            f"autotuner does not support model {name!r} (have {TUNE_MODELS})"
+        )
+    return name
+
+
+def model_config_for(model: str, *, seq: int = 128, remat: bool = False):
+    """The transformer config for LM models (with the trial's remat
+    policy applied), or None for cnn/mlp."""
+    if model in ("cnn", "mlp"):
+        return None
+    import dataclasses
+
+    from distributeddataparallel_tpu.models.transformer import (
+        gpt2_124m,
+        tiny_lm,
+    )
+
+    cfg = gpt2_124m(scan_layers=True) if model == "gpt2-small" \
+        else tiny_lm(scan_layers=True, num_layers=4)
+    return dataclasses.replace(cfg, remat=remat)
+
+
+def model_statics(model: str, *, seq: int = 128) -> dict:
+    """Trial-independent facts the analytic pruning stage prices with:
+    parameter count/bytes (abstract init — nothing allocates) and
+    closures for forward FLOPs and per-chip activation/batch bytes as a
+    function of the trial.  Coarse by design — ranking fuel, not ground
+    truth."""
+    import jax
+    import jax.numpy as jnp
+
+    model = canonical_model(model)
+    if model in ("cnn", "mlp"):
+        from distributeddataparallel_tpu.models import SimpleCNN, TinyMLP
+        from distributeddataparallel_tpu.observability.cost_model import (
+            mlp_fwd_flops,
+            simple_cnn_fwd_flops,
+        )
+
+        net = SimpleCNN() if model == "cnn" else TinyMLP()
+        x_init = jnp.zeros((1, 8, 8, 1), jnp.float32) if model == "cnn" \
+            else jnp.zeros((1, 64), jnp.float32)
+        params_shape = jax.eval_shape(
+            lambda k: net.init(k, x_init)["params"], jax.random.PRNGKey(0)
+        )
+        if model == "cnn":
+            def fwd_flops(rows):
+                return simple_cnn_fwd_flops(
+                    batch=rows, image_shape=(8, 8, 1)
+                )
+
+            row_bytes = 4 * (8 * 8 * 1 + 4)  # image + label + slack
+            act_row_bytes = 4 * 3 * (8 * 8 * 32 + 4 * 4 * 64 + 10)
+        else:
+            def fwd_flops(rows):
+                return mlp_fwd_flops(batch=rows, in_features=64)
+
+            row_bytes = 4 * (64 + 4)
+            act_row_bytes = 4 * 3 * (64 + 128 + 128 + 10)
+        seq = 0
+
+        def act_row_bytes_for(trial, _b=act_row_bytes):
+            return _b
+    else:
+        from distributeddataparallel_tpu.models import TransformerLM
+        from distributeddataparallel_tpu.observability.cost_model import (
+            transformer_fwd_flops,
+        )
+
+        cfg = model_config_for(model, seq=seq)
+        seq = min(seq, cfg.max_seq_len)
+        net = TransformerLM(cfg)
+        params_shape = jax.eval_shape(
+            lambda k: net.init(k, jnp.zeros((1, 8), jnp.int32))["params"],
+            jax.random.PRNGKey(0),
+        )
+
+        def fwd_flops(rows, _cfg=cfg, _seq=seq):
+            return transformer_fwd_flops(_cfg, batch=rows, seq_len=_seq)
+
+        row_bytes = 4 * (seq + 1)
+        # Residual-stream activations (~14 f32 copies of S*d per layer
+        # per row; remat keeps layer BOUNDARIES only and replays the
+        # rest, so one layer's working set + boundaries) plus the
+        # logits + softmax-grad buffers, which dominate small models
+        # (S*vocab).
+        d, layers, vocab = cfg.d_model, cfg.num_layers, cfg.vocab_size
+        act_row_remat = 4 * seq * d * (14 + layers) + 8 * seq * vocab
+        act_row_full = 4 * seq * d * 14 * layers + 8 * seq * vocab
+
+        def act_row_bytes_for(trial):
+            return act_row_remat if trial.remat else act_row_full
+
+    leaves = jax.tree_util.tree_leaves(params_shape)
+    return {
+        "model": model,
+        "seq": seq,
+        "params_count": sum(int(l.size) for l in leaves),
+        "params_bytes": sum(int(l.size) * l.dtype.itemsize for l in leaves),
+        "fwd_flops": fwd_flops,
+        # Microbatching divides the live activation set; the logits
+        # buffer scales the same way, so one divisor is honest enough.
+        "act_bytes": lambda trial: (
+            trial.batch_per_chip
+            // max(1, trial.accum_steps)
+            * act_row_bytes_for(trial)
+        ),
+        "batch_bytes": lambda trial: trial.batch_per_chip * row_bytes,
+    }
+
+
+def build_trial_case(
+    model: str,
+    mesh,
+    trial: TrialConfig,
+    *,
+    seq: int = 128,
+    concrete: bool = True,
+    seed: int = 0,
+) -> dict:
+    """One runnable (or AOT-lowerable) case for ``trial``.
+
+    Returns ``{"step", "state", "batch", "rng", "fwd_flops",
+    "flop_signature"}``.  ``concrete=False`` builds everything abstract
+    (eval_shape state, ShapeDtypeStruct batch) — the background
+    pre-compile path; ``concrete=True`` materializes synthetic data and
+    real params for measurement.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    import distributeddataparallel_tpu as ddp
+    from distributeddataparallel_tpu.training.train_step import (
+        make_train_step,
+    )
+
+    model = canonical_model(model)
+    n_data = mesh.shape["data"]
+    rows = trial.batch_per_chip * n_data
+    host = np.random.default_rng(seed)
+
+    if model in ("cnn", "mlp"):
+        from distributeddataparallel_tpu.models import SimpleCNN, TinyMLP
+        from distributeddataparallel_tpu.observability.cost_model import (
+            mlp_fwd_flops,
+            simple_cnn_fwd_flops,
+        )
+        from distributeddataparallel_tpu.ops.losses import (
+            cross_entropy_loss,
+        )
+
+        net = SimpleCNN() if model == "cnn" else TinyMLP()
+        x_shape = (8, 8, 1) if model == "cnn" else (64,)
+        x_init = jnp.zeros((1,) + x_shape, jnp.float32)
+        if concrete:
+            batch = {
+                "image": host.normal(size=(rows,) + x_shape).astype(
+                    np.float32
+                ),
+                "label": host.integers(
+                    0, 10, size=(rows,), dtype=np.int32
+                ),
+            }
+        else:
+            batch = {
+                "image": jax.ShapeDtypeStruct(
+                    (rows,) + x_shape, jnp.float32
+                ),
+                "label": jax.ShapeDtypeStruct((rows,), jnp.int32),
+            }
+
+        def loss_fn(params, b, _rng):
+            logits = net.apply({"params": params}, b["image"])
+            return cross_entropy_loss(logits, b["label"]), {}
+
+        fwd = simple_cnn_fwd_flops(batch=rows, image_shape=(8, 8, 1)) \
+            if model == "cnn" else mlp_fwd_flops(batch=rows, in_features=64)
+    else:
+        from distributeddataparallel_tpu.models import TransformerLM
+        from distributeddataparallel_tpu.observability.cost_model import (
+            transformer_fwd_flops,
+        )
+        from distributeddataparallel_tpu.ops.losses import lm_cross_entropy
+
+        cfg = model_config_for(model, seq=seq, remat=trial.remat)
+        seq = min(seq, cfg.max_seq_len)
+        net = TransformerLM(cfg)
+        x_init = jnp.zeros((1, 8), jnp.int32)
+        if concrete:
+            batch = {
+                "tokens": host.integers(
+                    0, cfg.vocab_size, size=(rows, seq + 1), dtype=np.int32
+                ),
+            }
+        else:
+            batch = {
+                "tokens": jax.ShapeDtypeStruct((rows, seq + 1), jnp.int32),
+            }
+
+        def loss_fn(params, b, _rng):
+            toks = b["tokens"]
+            logits = net.apply(
+                {"params": params}, toks[:, :-1], deterministic=True
+            )
+            return lm_cross_entropy(logits, toks[:, 1:]), {}
+
+        fwd = transformer_fwd_flops(cfg, batch=rows, seq_len=seq)
+
+    bucket_bytes = (
+        int(trial.bucket_mb * 1024 * 1024) if trial.bucket_mb else None
+    )
+    step = make_train_step(
+        loss_fn,
+        mesh=mesh,
+        accum_steps=trial.accum_steps,
+        bucket_bytes=bucket_bytes,
+        zero=trial.zero or False,
+    )
+    tx = optax.adam(1e-3)
+
+    def _make_state(params):
+        if trial.zero:
+            from distributeddataparallel_tpu.parallel.zero import zero_state
+
+            return zero_state(
+                apply_fn=None, params=params, tx=tx, mesh=mesh,
+                level=trial.zero,
+                moment_dtype=(
+                    None if trial.moment_dtype == "f32"
+                    else trial.moment_dtype
+                ),
+                bucket_bytes=bucket_bytes,
+            )
+        return ddp.TrainState.create(apply_fn=None, params=params, tx=tx)
+
+    if concrete:
+        from distributeddataparallel_tpu.data.loader import shard_batch
+
+        params = net.init(jax.random.PRNGKey(seed), x_init)["params"]
+        state = _make_state(params)
+        batch = shard_batch(batch, mesh)
+        rng = jax.random.PRNGKey(seed)
+    else:
+        params_shape = jax.eval_shape(
+            lambda k: net.init(k, x_init)["params"], jax.random.PRNGKey(0)
+        )
+        state = jax.eval_shape(_make_state, params_shape)
+        rng = jax.ShapeDtypeStruct((2,), jnp.uint32)
+
+    return {
+        "step": step,
+        "state": state,
+        "batch": batch,
+        "rng": rng,
+        "fwd_flops": fwd,
+        "flop_signature": getattr(step, "flop_signature", None),
+    }
+
+
+def default_space_for(model: str) -> SearchSpace:
+    """A modest per-model default space — wide enough to beat a bad
+    hand-pick, small enough that enumeration + analytic pruning stays
+    sub-second and top-K measurement stays minutes."""
+    model = canonical_model(model)
+    if model in ("cnn", "mlp"):
+        return SearchSpace(
+            batch_per_chip=(16, 32, 64),
+            accum_steps=(1, 2),
+            remat=(False,),
+            zero=(0, 1),
+            moment_dtype=("f32",),
+            bucket_mb=(None,),
+            dispatch_depth=(2,),
+        )
+    return SearchSpace(
+        batch_per_chip=(1, 2, 4),
+        accum_steps=(1, 2),
+        remat=(False, True),
+        zero=(0, 1, 2),
+        moment_dtype=("f32", "bf16"),
+        bucket_mb=(None, 4.0),
+        dispatch_depth=(2,),
+    )
+
+
+def default_tuned_key(model: str, mesh, *, seq: int = 128) -> dict:
+    """The TunedConfig key dpp.py and the CLI both derive, so a search
+    run and a later apply run agree on identity without coordination.
+    Carries the run identity (model, seq, param count, optimizer
+    family) — never the tunable knobs."""
+    model = canonical_model(model)
+    statics = model_statics(model, seq=seq)
+    return tuned_key(
+        mesh=mesh,
+        extra={
+            "model": model,
+            "seq": statics["seq"],
+            "params_count": statics["params_count"],
+            "optimizer": "adam",
+        },
+    )
+
+
+def trial_key(base_key: dict, trial: TrialConfig) -> dict:
+    """Executable-store key for one trial: the base fingerprint PLUS the
+    knobs (an executable's identity does include them)."""
+    key = dict(base_key)
+    key["trial"] = trial.as_dict()
+    return key
+
+
+def measure_trial(
+    case: dict,
+    trial: TrialConfig,
+    *,
+    n_chips: int,
+    warmup_steps: int = 1,
+    measure_steps: int = 4,
+    exec_store=None,
+    key: dict | None = None,
+    name: str | None = None,
+    peak_flops_per_chip: float | None = None,
+) -> dict:
+    """Short measured window for one concrete case.
+
+    The first step is ticked through ``StepTimer`` so compile/AOT-load
+    time is attributed separately (never poisons the window); the
+    window itself is ``measure_steps`` steps, synced only at the
+    boundary.  Score is model FLOP/s — the MFU numerator, so ranking is
+    peak-independent; ``mfu`` is reported when the peak is known.
+    """
+    import jax
+
+    from distributeddataparallel_tpu.observability.cost_model import (
+        train_step_flops,
+    )
+    from distributeddataparallel_tpu.utils.metrics import StepTimer
+
+    step = case["step"]
+    warm_mode = None
+    if exec_store is not None:
+        from distributeddataparallel_tpu.training.warm_start import (
+            warm_train_step,
+        )
+
+        step = warm_train_step(
+            case["step"], store=exec_store, key=key or {}, name=name or "tune"
+        )
+        warm_mode = step.resolve(
+            case["state"], case["batch"], case["rng"]
+        )["mode"]
+
+    flops = train_step_flops(
+        case["fwd_flops"],
+        remat=trial.remat,
+        flop_signature=case.get("flop_signature"),
+    )
+    rows = trial.batch_per_chip * n_chips
+    timer = StepTimer(window=measure_steps, n_chips=n_chips)
+    s, batch, rng = case["state"], case["batch"], case["rng"]
+
+    s, m = step(s, batch, rng)
+    timer.tick(rows, sync=m["loss"])  # compile/load step, accounted apart
+    for _ in range(max(0, warmup_steps - 1)):
+        s, m = step(s, batch, rng)
+    if warmup_steps > 1:
+        # ddplint: allow[host-sync] — measurement boundary, off-path
+        jax.block_until_ready(m["loss"])
+    timer.reset()
+
+    reading = None
+    for _ in range(measure_steps):
+        s, m = step(s, batch, rng)
+        r = timer.tick(rows, sync=m["loss"])
+        reading = r or reading
+    steps_per_s = reading["steps_per_s"]
+    score = steps_per_s * flops["model_flops"]
+    return {
+        "step_s": 1.0 / steps_per_s,
+        "steps_per_s": steps_per_s,
+        "score": score,
+        "mfu": (
+            score / (peak_flops_per_chip * n_chips)
+            if peak_flops_per_chip else None
+        ),
+        "warm_mode": warm_mode,
+        "model_flops": flops["model_flops"],
+        "compile_or_load_s": timer.compile_s,
+    }
+
+
+def search_model(
+    model: str,
+    *,
+    mesh,
+    seq: int = 128,
+    space: SearchSpace | None = None,
+    trials: list[TrialConfig] | None = None,
+    baseline: TrialConfig | None = None,
+    top_k: int = 3,
+    warmup_steps: int = 1,
+    measure_steps: int = 4,
+    seed: int = 0,
+    efficiency: float | None = None,
+    budget_bytes: int | None = None,
+    tune_store: TuningStore | None = None,
+    store_name: str | None = None,
+    key: dict | None = None,
+    exec_store=None,
+    events=None,
+) -> dict:
+    """Run the full search for ``model`` on ``mesh`` and persist the
+    winner; returns the summary dict (winner, per-trial records,
+    gain_frac vs the baseline, store path)."""
+    import jax
+
+    from distributeddataparallel_tpu.observability.cost_model import (
+        DEFAULT_EFFICIENCY,
+        peak_flops_for,
+        predict_step_s,
+        train_step_flops,
+    )
+    from distributeddataparallel_tpu.observability.memory import (
+        hbm_budget_bytes,
+    )
+    from distributeddataparallel_tpu.analysis.mesh_sim import (
+        analytic_memory_fit,
+    )
+
+    model = canonical_model(model)
+    n_chips = int(mesh.shape["data"])
+    peak = peak_flops_for(jax.devices()[0])
+    budget = budget_bytes or hbm_budget_bytes()
+    eff = efficiency or DEFAULT_EFFICIENCY
+    statics = model_statics(model, seq=seq)
+    seq = statics["seq"] or seq
+    space = space or default_space_for(model)
+    trial_list = trials if trials is not None else space.enumerate(seed=seed)
+    key = key or default_tuned_key(model, mesh, seq=seq)
+    store_name = store_name or f"{model}@d{n_chips}"
+
+    def _predict(trial: TrialConfig) -> dict:
+        fwd = statics["fwd_flops"](trial.batch_per_chip * n_chips)
+        fl = train_step_flops(fwd, remat=trial.remat)
+        return {
+            "model_flops": fl["model_flops"],
+            "step_s": predict_step_s(
+                fl["hardware_flops"], n_chips=n_chips,
+                peak_flops_per_chip=peak, efficiency=eff,
+            ),
+            "fit": analytic_memory_fit(
+                params_bytes=statics["params_bytes"],
+                params_count=statics["params_count"],
+                n_devices=n_chips,
+                zero_level=trial.zero,
+                moment_bytes_per_param=_MOMENT_BYTES[trial.moment_dtype],
+                act_bytes=statics["act_bytes"](trial),
+                batch_bytes=statics["batch_bytes"](trial),
+                budget_bytes=budget,
+            ),
+        }
+
+    pre = None
+    submitted: set[str] = set()
+
+    def _entry_name(trial: TrialConfig) -> str:
+        return f"tune_{store_name}-{trial.label}"
+
+    def _prepare(trial: TrialConfig) -> None:
+        nonlocal pre
+        if exec_store is None:
+            return
+        from distributeddataparallel_tpu.training.warm_start import (
+            BackgroundPrecompiler,
+        )
+
+        if pre is None:
+            pre = BackgroundPrecompiler(exec_store).start()
+        name = _entry_name(trial)
+
+        def _build(t=trial):
+            case = build_trial_case(
+                model, mesh, t, seq=seq, concrete=False, seed=seed
+            )
+            return case["step"], (case["state"], case["batch"], case["rng"])
+
+        pre.submit(name, trial_key(key, trial), _build)
+        submitted.add(name)
+
+    def _measure(trial: TrialConfig) -> dict:
+        case = build_trial_case(
+            model, mesh, trial, seq=seq, concrete=True, seed=seed
+        )
+        name = _entry_name(trial)
+        if pre is not None and name in submitted:
+            # The trial's background compile was submitted one candidate
+            # ago; give it until the shutdown-guard budget to land so the
+            # resolve below is an AOT load, not a duplicate compile.
+            deadline = time.monotonic() + 900
+            while name not in pre.report and time.monotonic() < deadline:
+                time.sleep(0.05)
+        return measure_trial(
+            case, trial,
+            n_chips=n_chips,
+            warmup_steps=warmup_steps,
+            measure_steps=measure_steps,
+            exec_store=exec_store,
+            key=trial_key(key, trial),
+            name=name,
+            peak_flops_per_chip=peak,
+        )
+
+    tuner = Autotuner(
+        predict=_predict,
+        measure=_measure,
+        prepare=_prepare if exec_store is not None else None,
+        top_k=top_k,
+        events=events,
+    )
+    try:
+        winner, records = tuner.search(trial_list, baseline=baseline)
+    finally:
+        if pre is not None:
+            pre.join(timeout=300)  # shutdown guard: no live compile at exit
+
+    base_rec = next((r for r in records if r.status == "baseline"), None)
+    gain_frac = None
+    if winner is not None and base_rec is not None and base_rec.score:
+        gain_frac = (winner.score - base_rec.score) / base_rec.score
+
+    store_path = None
+    if tune_store is not None and winner is not None:
+        store_path = tune_store.save(
+            store_name, key,
+            config=winner.trial.as_dict(),
+            objective="model_flops_per_s",
+            score=winner.score,
+            measured_step_s=winner.measured_step_s,
+            predicted_step_s=winner.predicted_step_s,
+            baseline_step_s=(
+                base_rec.measured_step_s if base_rec is not None else None
+            ),
+            gain_frac=gain_frac,
+            trials=[r.as_dict() for r in records],
+        )
+        get_logger().info(
+            "autotune winner %s (score %.3g) persisted to %s",
+            winner.trial.label, winner.score or 0.0, store_path,
+        )
+
+    if events is not None:
+        events.emit(
+            "tune_result",
+            mode="search",
+            winner=winner.trial.label if winner is not None else None,
+            config=winner.trial.as_dict() if winner is not None else None,
+            score=winner.score if winner is not None else None,
+            mfu=winner.mfu if winner is not None else None,
+            gain_frac=gain_frac,
+            n_trials=len(records),
+            n_measured=sum(
+                1 for r in records if r.status in ("measured", "baseline")
+            ),
+            store_path=store_path,
+        )
+
+    return {
+        "model": model,
+        "name": store_name,
+        "key": key,
+        "n_chips": n_chips,
+        "winner": winner.as_dict() if winner is not None else None,
+        "baseline": base_rec.as_dict() if base_rec is not None else None,
+        "gain_frac": gain_frac,
+        "records": [r.as_dict() for r in records],
+        "store_path": store_path,
+        "precompile_report": dict(pre.report) if pre is not None else {},
+    }

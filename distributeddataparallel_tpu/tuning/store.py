@@ -1,0 +1,143 @@
+"""TunedConfig persistence: the autotuner's winner store.
+
+A ``TunedConfig`` record is the searched winner for one (topology,
+model config, toolchain) fingerprint — the SAME key family the
+warm-start ``ExecutableStore`` uses (``executable_key``), minus the
+tunable knobs themselves (those are the record's payload, not its
+identity).  ``dpp.py --autotune apply`` loads the record on a
+previously-tuned host and reaches its first step with zero search
+trials; any key mismatch (different device count, model config, jax
+version...) falls back LOUDLY to the CLI defaults, mirroring the
+warm-start store's loud JIT fallback — a tuned config is an
+optimization, never a correctness gate.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any
+
+from distributeddataparallel_tpu.training.warm_start import (
+    WarmStartMismatch,
+    _key_diff,
+    _key_get,
+    executable_key,
+)
+from distributeddataparallel_tpu.utils.logging import get_logger
+
+TUNING_STORE_VERSION = 1
+_TUNED_SUFFIX = ".tuned.json"
+
+
+def tuned_key(
+    *,
+    mesh=None,
+    model_config: Any = None,
+    extra: dict | None = None,
+) -> dict:
+    """The TunedConfig invalidation key.
+
+    Delegates to ``executable_key`` so tuned records and AOT executables
+    share one fingerprint vocabulary (topology, versions, model config).
+    ``extra`` carries the run identity the topology cannot see (model
+    name, sequence length, optimizer family) — NOT the tunable knobs:
+    two runs that differ only in a knob the tuner owns must map to the
+    same record, or apply could never find what search persisted.
+    """
+    return executable_key(mesh=mesh, model_config=model_config, extra=extra)
+
+
+class TuningStore:
+    """Directory of TunedConfig records, one ``<name>.tuned.json`` each.
+
+    ``name`` follows the ExecutableStore convention for topology-scoped
+    entries (``gpt2-small@d8``); ``save`` is atomic (tmp + rename);
+    ``load`` verifies the FULL key dict and reports mismatches
+    field-by-field before returning None (or raising, ``strict=True``).
+    """
+
+    def __init__(self, root: str):
+        self.root = os.path.abspath(os.path.expanduser(root))
+        os.makedirs(self.root, exist_ok=True)
+
+    def _path(self, name: str) -> str:
+        return os.path.join(self.root, name + _TUNED_SUFFIX)
+
+    def index(self) -> dict[str, dict]:
+        """Every stored record: ``name -> record``, sorted by name."""
+        out: dict[str, dict] = {}
+        for fname in sorted(os.listdir(self.root)):
+            if not fname.endswith(_TUNED_SUFFIX):
+                continue
+            name = fname[: -len(_TUNED_SUFFIX)]
+            try:
+                with open(os.path.join(self.root, fname)) as fh:
+                    out[name] = json.load(fh)
+            except (OSError, ValueError):
+                continue  # half-written/corrupt records are not entries
+        return out
+
+    def save(
+        self,
+        name: str,
+        key: dict,
+        *,
+        config: dict,
+        objective: str,
+        score: float | None,
+        measured_step_s: float | None = None,
+        predicted_step_s: float | None = None,
+        baseline_step_s: float | None = None,
+        gain_frac: float | None = None,
+        trials: list | tuple = (),
+    ) -> str:
+        """Persist one winner; returns the record path."""
+        record = {
+            "version": TUNING_STORE_VERSION,
+            "key": key,
+            "config": dict(config),
+            "objective": objective,
+            "score": score,
+            "measured_step_s": measured_step_s,
+            "predicted_step_s": predicted_step_s,
+            "baseline_step_s": baseline_step_s,
+            "gain_frac": gain_frac,
+            "trials": list(trials),
+            "created_unix": time.time(),
+        }
+        path = self._path(name)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as fh:
+            fh.write(json.dumps(record, indent=1, sort_keys=True))
+        os.replace(tmp, path)
+        return path
+
+    def load(self, name: str, key: dict, *, strict: bool = False):
+        """The stored record when its key matches ``key``, else None
+        after a LOUD field-by-field warning (``strict=True`` raises
+        ``WarmStartMismatch`` instead — same exception family as the
+        executable store, because it is the same failure)."""
+        try:
+            with open(self._path(name)) as fh:
+                record = json.load(fh)
+        except (OSError, ValueError):
+            return None  # nothing tuned yet — a cold host, not a fault
+        diff = _key_diff(record.get("key", {}), key)
+        if not diff:
+            return record
+        stored_key = record.get("key", {})
+        detail = "; ".join(
+            f"{f}: stored={_key_get(stored_key, f)!r} "
+            f"live={_key_get(key, f)!r}"
+            for f in diff
+        )
+        msg = (
+            f"TunedConfig '{name}' key mismatch ({detail}) — "
+            "falling back to untuned defaults"
+        )
+        if strict:
+            raise WarmStartMismatch(msg)
+        get_logger().warning("%s", msg)
+        return None

@@ -237,6 +237,29 @@ def parse_args(argv=None):
                         "checkpoint/eval boundaries (the nan guard then "
                         "observes each step's flag with a lag of at most "
                         "K).  0 = fully synchronous per-step loop")
+    p.add_argument("--remat", choices=["auto", "on", "off"], default="auto",
+                   help="activation rematerialization for LM models: "
+                        "'auto' keeps the model family's default "
+                        "(gpt2/llama: on), on/off force it — the knob the "
+                        "autotuner searches")
+    p.add_argument("--autotune", choices=["search", "apply", "off"],
+                   default="off",
+                   help="attribution-driven autotuning (tuning/): 'search' "
+                        "runs a cost-model-pruned, measured search before "
+                        "training and applies + persists the winner; "
+                        "'apply' loads a previously-persisted TunedConfig "
+                        "for this topology/model fingerprint (falling back "
+                        "LOUDLY to the CLI values on any mismatch) and "
+                        "starts training with zero search trials")
+    p.add_argument("--tune-dir", default=None, metavar="DIR",
+                   help="TunedConfig store directory (default: "
+                        "<--compile-cache>/tuned when a compile cache is "
+                        "set, else .ddp_tune)")
+    p.add_argument("--tune-trials", type=int, default=3,
+                   help="measured candidates per search (top-K after "
+                        "analytic pruning)")
+    p.add_argument("--tune-steps", type=int, default=4,
+                   help="measured steps per candidate window")
     p.add_argument("--log-every", type=int, default=100)     # ref dpp.py:54
     p.add_argument("--steps-per-epoch", type=int, default=None,
                    help="cap steps per epoch (smoke runs)")
@@ -734,6 +757,33 @@ def validate_args(args) -> None:
     if args.moment_dtype and not args.zero:
         raise SystemExit("--moment-dtype rides the ZeRO sharded update; "
                          "add --zero")
+    if args.autotune != "off":
+        # The tuner owns the generic DP/ZeRO knobs; layouts with their
+        # own step factories (and llama/resnet scale) are out of its
+        # search space.
+        bad = [
+            f for f, on in (
+                ("--fsdp", args.fsdp), ("--pp", args.pp > 1),
+                ("--tp", args.tp > 1), ("--ep", args.ep > 1),
+                ("--cp", args.cp > 1), ("--elastic", args.elastic),
+            ) if on
+        ]
+        if bad:
+            raise SystemExit(
+                f"--autotune searches the DP/ZeRO space only; drop "
+                f"{', '.join(bad)}"
+            )
+        if args.model not in ("mlp", "cnn", "gpt2"):
+            raise SystemExit(
+                "--autotune supports --model mlp|cnn|gpt2 (the tuning "
+                f"harness registry); got {args.model!r}"
+            )
+        if args.tune_trials < 1:
+            raise SystemExit("--tune-trials must be >= 1")
+        if args.tune_steps < 1:
+            raise SystemExit("--tune-steps must be >= 1")
+    if args.remat != "auto" and not is_lm(args):
+        raise SystemExit("--remat applies to LM models (--model gpt2|llama)")
     if args.overlap:
         # ZeRO-1/FSDP/PP own their reductions (reduce_scatter /
         # per-layer gathers / stage collectives) — the chained-bucket
@@ -891,6 +941,8 @@ def build_model(args, num_classes: int = 10, vocab_size: int | None = None):
             overrides["ep_axis"] = "expert"
         if args.layers:
             overrides["num_layers"] = args.layers
+        if args.remat != "auto":
+            overrides["remat"] = args.remat == "on"
         if args.d_model:
             # Scale heads with width (head_dim 16, even for RoPE) instead of
             # keeping the family's head count, which would give tiny or odd
@@ -1028,6 +1080,173 @@ def build_optimizer(args, total_steps: int):
     return optax.adamw(lr, weight_decay=args.weight_decay)
 
 
+def _apply_trial_to_args(args, config: dict, *, n_chips: int = 0) -> None:
+    """Overwrite the tunable knobs on ``args`` with a TunedConfig.
+
+    Only the knobs the tuner owns are touched — everything else
+    (model, dataset, steps, parallelism axes) keeps its CLI value, so
+    an applied record can never change WHAT trains, only how fast.
+    A persisted batch that would starve the dataset (global batch >
+    examples, possible when a record tuned against one --num-examples
+    is replayed against a smaller one) keeps the CLI batch/accum
+    instead of training zero steps.
+    """
+    from distributeddataparallel_tpu.tuning import TrialConfig
+    from distributeddataparallel_tpu.utils.logging import get_logger
+
+    trial = TrialConfig.from_dict(config)
+    cap = (args.num_examples // n_chips
+           if n_chips and getattr(args, "num_examples", None) else None)
+    if cap is not None and trial.batch_per_chip > cap:
+        get_logger().warning(
+            "tuned batch %d/chip needs %d examples but --num-examples "
+            "is %d — keeping --batch-size %d (re-run --autotune search "
+            "against this dataset)",
+            trial.batch_per_chip, trial.batch_per_chip * n_chips,
+            args.num_examples, args.batch_size,
+        )
+    else:
+        args.batch_size = trial.batch_per_chip
+        args.accum_steps = trial.accum_steps
+    args.zero = trial.zero
+    # dpp stores "no override" as None; the tuner's explicit "f32" is
+    # the same thing (and would trip the --moment-dtype-needs---zero
+    # gate at zero=0 if kept literal).
+    args.moment_dtype = (
+        None if trial.moment_dtype == "f32" else trial.moment_dtype
+    )
+    args.bucket_mb = trial.bucket_mb
+    args.dispatch_depth = trial.dispatch_depth
+    if is_lm(args):
+        args.remat = "on" if trial.remat else "off"
+
+
+def _tune_dir_for(args) -> str:
+    if args.tune_dir:
+        return args.tune_dir
+    if args.compile_cache:
+        return os.path.join(args.compile_cache, "tuned")
+    return ".ddp_tune"
+
+
+def _run_autotune(args, mesh, events=None) -> None:
+    """``--autotune`` entry: mutate ``args`` in place before anything
+    model-shaped is built.
+
+    ``apply`` loads the persisted TunedConfig for this (topology, model,
+    toolchain) fingerprint and replays it — zero search trials, loud
+    fallback to the CLI defaults on any key mismatch.  ``search`` runs
+    the full prune→measure pipeline on the live mesh first, persists
+    the winner, then applies it; the next run can use ``apply``.
+    """
+    from distributeddataparallel_tpu.tuning import (
+        TrialConfig,
+        TuningStore,
+        default_tuned_key,
+        search_model,
+    )
+    from distributeddataparallel_tpu.utils.logging import get_logger
+
+    log = get_logger()
+    model = "gpt2-small" if args.model == "gpt2" else args.model
+    n_chips = int(mesh.shape["data"])
+    name = f"{model}@d{n_chips}"
+    seq = args.seq_len if is_lm(args) else 128
+    store = TuningStore(_tune_dir_for(args))
+    key = default_tuned_key(model, mesh, seq=seq)
+
+    if args.autotune == "apply":
+        record = store.load(name, key)
+        applied = record is not None
+        if applied:
+            _apply_trial_to_args(args, record["config"], n_chips=n_chips)
+            log.info(
+                "autotune apply: %r -> %s (score %s, tuned %s)",
+                name, record["config"], record.get("score"),
+                os.path.join(store.root, name),
+            )
+        else:
+            log.warning(
+                "autotune apply: no matching TunedConfig %r under %s — "
+                "running with the CLI defaults (use --autotune search "
+                "to create one)", name, store.root,
+            )
+        if events is not None:
+            events.emit(
+                "tune_result",
+                mode="apply",
+                winner=record["config"] if applied else None,
+                applied=applied,
+                score=record.get("score") if applied else None,
+                store_path=store.root,
+            )
+        return
+
+    exec_store = None
+    if args.compile_cache:
+        from distributeddataparallel_tpu.training.warm_start import (
+            ExecutableStore,
+        )
+
+        exec_store = ExecutableStore(args.compile_cache)
+    # Cap the space by what the dataset can feed: a winner whose global
+    # batch exceeds --num-examples would train zero steps when applied.
+    from distributeddataparallel_tpu.tuning import default_space_for
+
+    space = default_space_for(model)
+    if getattr(args, "num_examples", None):
+        import dataclasses
+
+        cap = max(1, args.num_examples // n_chips)
+        fit = tuple(b for b in space.batch_per_chip if b <= cap)
+        space = dataclasses.replace(
+            space, batch_per_chip=fit or (min(cap, args.batch_size),)
+        )
+    # The CLI flags as given ARE the hand-picked baseline: it is always
+    # measured and always eligible to win, so the reported gain_frac is
+    # an honest "what did tuning buy over what I typed".
+    baseline = TrialConfig(
+        batch_per_chip=args.batch_size,
+        accum_steps=args.accum_steps,
+        remat=(args.remat == "on" if args.remat != "auto"
+               else is_lm(args) and args.model == "gpt2"),
+        zero=args.zero,
+        moment_dtype=args.moment_dtype or "f32",
+        bucket_mb=args.bucket_mb,
+        dispatch_depth=args.dispatch_depth,
+    )
+    summary = search_model(
+        model,
+        mesh=mesh,
+        seq=seq,
+        space=space,
+        top_k=args.tune_trials,
+        measure_steps=args.tune_steps,
+        seed=args.seed,
+        baseline=baseline,
+        tune_store=store,
+        store_name=name,
+        key=key,
+        exec_store=exec_store,
+        events=events,
+    )
+    winner = summary.get("winner")
+    if winner is None:
+        log.warning(
+            "autotune search measured no viable trial — keeping the "
+            "CLI defaults"
+        )
+        return
+    _apply_trial_to_args(args, winner["config"], n_chips=n_chips)
+    log.info(
+        "autotune search: winner %s (gain %+.1f%% vs baseline), "
+        "persisted to %s",
+        winner["trial"],
+        100.0 * (summary.get("gain_frac") or 0.0),
+        summary.get("store_path"),
+    )
+
+
 def train(args) -> float:
     """Per-job trainer (analog of ref dpp.py:27-57). Returns final loss."""
     # Library/test callers reach train() without going through main();
@@ -1128,6 +1347,13 @@ def train(args) -> float:
         import contextlib
 
         return contextlib.nullcontext()
+
+    # Autotune BEFORE anything batch-shaped exists: apply replays a
+    # persisted winner (zero trials), search measures on the live mesh
+    # and persists one.  Either way the tuned knobs land on ``args`` and
+    # the loader/model/step below are built from them.
+    if args.autotune != "off":
+        _run_autotune(args, mesh, events)
 
     cp = args.cp > 1
     if cp:

@@ -42,7 +42,12 @@
 #                               rank, and the gang must EVICT via resize
 #                               (sdc_detect + sdc_evict + gang_resize,
 #                               no restart_attempt)
-#   8. tier-1 pytest            the ROADMAP verify command (CPU, not
+#   8. ddp_tune --check         autotuner smoke: a real 2-trial search
+#                               on a tiny model over an 8-fake-device
+#                               CPU mesh — asserts a winner record is
+#                               persisted and every tune_* event is
+#                               schema-valid
+#   9. tier-1 pytest            the ROADMAP verify command (CPU, not
 #                               slow).  Includes the ZeRO-2/3 bitwise
 #                               dp-parity + low-bit-moment convergence
 #                               tests (tests/test_zero23.py)
@@ -64,7 +69,11 @@
 #                              memory regression fails this stage.
 #                              integrity_overhead_frac (the --integrity-
 #                              every digest's step-time cost, pinned
-#                              <= 1%) gates the same way via _frac
+#                              <= 1%) gates the same way via _frac.
+#                              tuned_step_s gates lower-is-better; the
+#                              autotuner's tune_gain_frac gates HIGHER-
+#                              is-better (gain_frac$ overrides _frac$),
+#                              so a shrinking tuning win is a regression
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -127,6 +136,9 @@ print(f"integrity smoke: sdc_detect rank 1 -> evict -> 1 gang_resize, "
       f"0 restarts ({len(kinds)} records)")
 PY
 rm -rf "${INTEGRITY_SMOKE_DIR}"
+
+echo "== ddp_tune --check =="
+python scripts/ddp_tune.py --check
 
 if [[ "${DDP_PERF_GATE:-0}" == "1" ]]; then
     echo "== perf_gate =="

@@ -87,6 +87,7 @@ def analyze(records: list[dict]) -> dict:
         "lint": [],
         "run_summary": None,
         "serving": None,
+        "tuning": None,
     }
     if worker_procs:
         out["goodput"] = goodput_from_timeline(records, proc=worker_procs[0])
@@ -236,6 +237,32 @@ def analyze(records: list[dict]) -> dict:
                     s["evictions"].get(reason, 0) + 1
                 )
                 s["evicted_blocks"] += r.get("blocks") or 0
+        elif kind in ("tune_trial", "tune_result"):
+            t = out["tuning"]
+            if t is None:
+                t = out["tuning"] = {
+                    "trials": [], "result": None, "drift_fracs": [],
+                }
+            if kind == "tune_trial":
+                t["trials"].append({
+                    k: r.get(k) for k in (
+                        "trial", "status", "predicted_step_s",
+                        "measured_step_s", "score", "mfu", "drift_frac",
+                        "warm_mode",
+                    )
+                })
+                if isinstance(r.get("drift_frac"), (int, float)):
+                    t["drift_fracs"].append(r["drift_frac"])
+            else:
+                # last one wins — a search followed by apply runs in the
+                # same events dir reports the final applied state
+                t["result"] = {
+                    k: r.get(k) for k in (
+                        "mode", "winner", "applied", "score", "mfu",
+                        "gain_frac", "n_trials", "n_measured",
+                        "store_path",
+                    )
+                }
     if out["serving"]:
         s = out["serving"]
         span = (
@@ -626,6 +653,63 @@ def render_markdown(a: dict, events_dir: str) -> str:
             f"| preempt evictions | {sv['evictions'].get('preempt', 0)} |",
             f"| blocks reclaimed | {sv['evicted_blocks']} |",
         ]
+    lines.append("")
+
+    # -- Tuning -------------------------------------------------------
+    lines += ["## Tuning", ""]
+    tu = a["tuning"]
+    if tu is None:
+        lines.append("No tune_* events — search with `dpp.py --autotune "
+                     "search` (or `python scripts/ddp_tune.py search "
+                     "--events-dir DIR`) to record trials here.")
+    else:
+        res = tu["result"]
+        if res:
+            gain = res.get("gain_frac")
+            lines += [
+                f"**autotune {res.get('mode')}**: winner "
+                f"`{res.get('winner')}`"
+                + (f", gain {gain * 100:+.1f}% vs baseline"
+                   if isinstance(gain, (int, float)) else "")
+                + ("" if res.get("applied") in (None, True)
+                   else " — **NOT applied** (key mismatch, ran with CLI "
+                        "defaults)")
+                + ".",
+                "",
+            ]
+        if tu["trials"]:
+            lines += [
+                "| trial | status | predicted | measured | drift | "
+                "warm |",
+                "|---|---|---:|---:|---:|---|",
+            ]
+            fmt = lambda v: (  # noqa: E731
+                "-" if not isinstance(v, (int, float))
+                else f"{v * 1e3:.1f} ms"
+            )
+            for t in tu["trials"]:
+                d = t.get("drift_frac")
+                lines.append(
+                    f"| `{t['trial']}` | {t['status']} "
+                    f"| {fmt(t.get('predicted_step_s'))} "
+                    f"| {fmt(t.get('measured_step_s'))} "
+                    f"| {'-' if not isinstance(d, (int, float)) else f'{d * 100:+.0f}%'} "
+                    f"| {t.get('warm_mode') or '-'} |"
+                )
+            drifts = tu["drift_fracs"]
+            if drifts:
+                # the search doubles as a cost-model calibration probe:
+                # consistent positive drift = the efficiency constant is
+                # too optimistic for this backend, not a tuner bug
+                mean = sum(drifts) / len(drifts)
+                worst = max(drifts, key=abs)
+                lines += [
+                    "",
+                    f"Cost-model drift over {len(drifts)} measured "
+                    f"trial(s): mean {mean * 100:+.0f}%, worst "
+                    f"{worst * 100:+.0f}% "
+                    "((measured - predicted) / predicted).",
+                ]
     lines.append("")
 
     # -- Run summary + trace ------------------------------------------

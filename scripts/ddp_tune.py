@@ -1,0 +1,289 @@
+#!/usr/bin/env python
+"""Autotuner CLI: search, apply, and report over TunedConfig stores.
+
+Usage:
+    # Full search on an 8-fake-device CPU mesh; persist the winner:
+    python scripts/ddp_tune.py search --model gpt2-small --devices 8 \
+        --tune-dir .ddp_tune
+
+    # What would `dpp.py --autotune apply` do on THIS host?  Prints the
+    # dpp.py flags of the stored winner (or fails loudly on key drift):
+    python scripts/ddp_tune.py apply --model gpt2-small --devices 8 \
+        --tune-dir .ddp_tune
+
+    # Every stored record, with its gain and drift accounting:
+    python scripts/ddp_tune.py report --tune-dir .ddp_tune
+
+    # CI smoke (tiny model, 2-trial search on 8 fake CPU devices;
+    # asserts a persisted winner and schema-valid tune_* events):
+    python scripts/ddp_tune.py --check
+
+``search``/``apply`` need a live device mesh (they fingerprint the
+topology); ``--devices N`` forces N fake CPU devices BEFORE the first
+backend query, so a laptop can tune for — and inspect records of — an
+N-chip data-parallel layout.  ``report`` is import-light: it reads
+``*.tuned.json`` records without touching jax at all.
+
+Exit codes: 0 = ok, 1 = usage error or (apply) no matching record,
+2 = --check assertion failure.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+CHECK_EXIT = 2
+
+
+def _force_devices(n: int) -> None:
+    from distributeddataparallel_tpu import compat
+
+    compat.configure_cpu_devices(n)
+
+
+def _mesh():
+    from distributeddataparallel_tpu.runtime.distributed import make_mesh
+
+    return make_mesh()
+
+
+def _fmt_s(v) -> str:
+    return "-" if v is None else f"{v * 1e3:.1f}ms"
+
+
+def cmd_search(args) -> int:
+    if args.devices:
+        _force_devices(args.devices)
+    from distributeddataparallel_tpu.tuning import (
+        TuningStore,
+        search_model,
+    )
+
+    events = None
+    if args.events_dir:
+        from distributeddataparallel_tpu.observability import (
+            EventLog,
+            events_path,
+        )
+
+        os.makedirs(args.events_dir, exist_ok=True)
+        events = EventLog(events_path(args.events_dir, 0), 0)
+    exec_store = None
+    if args.compile_cache:
+        from distributeddataparallel_tpu.training.warm_start import (
+            ExecutableStore,
+        )
+
+        exec_store = ExecutableStore(args.compile_cache)
+    summary = search_model(
+        args.model,
+        mesh=_mesh(),
+        seq=args.seq,
+        top_k=args.trials,
+        measure_steps=args.steps,
+        seed=args.seed,
+        tune_store=TuningStore(args.tune_dir),
+        exec_store=exec_store,
+        events=events,
+    )
+    if args.json:
+        print(json.dumps(summary, default=str))
+    else:
+        for rec in summary["records"]:
+            print(
+                f"  {rec['trial']:<26} {rec['status']:<14}"
+                f" pred={_fmt_s(rec['predicted_step_s'])}"
+                f" meas={_fmt_s(rec['measured_step_s'])}"
+            )
+        w = summary["winner"]
+        if w is None:
+            print("no viable trial measured")
+            return 1
+        gain = summary.get("gain_frac")
+        print(
+            f"winner {w['trial']}  step={_fmt_s(w['measured_step_s'])}"
+            + (f"  gain={gain * 100:+.1f}% vs baseline"
+               if gain is not None else "")
+            + f"\nsaved {summary['store_path']}"
+        )
+    return 0
+
+
+def cmd_apply(args) -> int:
+    if args.devices:
+        _force_devices(args.devices)
+    from distributeddataparallel_tpu.tuning import (
+        TrialConfig,
+        TuningStore,
+        default_tuned_key,
+    )
+
+    mesh = _mesh()
+    name = f"{args.model}@d{int(mesh.shape['data'])}"
+    record = TuningStore(args.tune_dir).load(
+        name, default_tuned_key(args.model, mesh, seq=args.seq)
+    )
+    if record is None:
+        print(
+            f"ddp_tune: no matching TunedConfig {name!r} under "
+            f"{args.tune_dir} — run `ddp_tune.py search` first",
+            file=sys.stderr,
+        )
+        return 1
+    trial = TrialConfig.from_dict(record["config"])
+    if args.json:
+        print(json.dumps(record))
+    else:
+        # the argv fragment a wrapper script splices into its dpp.py call
+        lm = args.model not in ("mlp", "cnn")
+        print(" ".join(trial.cli_flags(lm=lm)))
+    return 0
+
+
+def cmd_report(args) -> int:
+    from distributeddataparallel_tpu.tuning.store import TuningStore
+
+    index = TuningStore(args.tune_dir).index()
+    if not index:
+        print(f"ddp_tune: no records under {args.tune_dir}")
+        return 0
+    if args.json:
+        print(json.dumps(index))
+        return 0
+    for name, rec in index.items():
+        gain = rec.get("gain_frac")
+        print(
+            f"{name}: {rec['config']}"
+            f"  step={_fmt_s(rec.get('measured_step_s'))}"
+            f"  score={rec.get('score'):.3g}"
+            + (f"  gain={gain * 100:+.1f}%" if gain is not None else "")
+        )
+        for t in rec.get("trials", []):
+            drift = t.get("drift_frac")
+            print(
+                f"    {t['trial']:<26} {t['status']:<14}"
+                f" meas={_fmt_s(t.get('measured_step_s'))}"
+                + (f" drift={drift * 100:+.0f}%"
+                   if drift is not None else "")
+            )
+    return 0
+
+
+def run_check() -> int:
+    """CI smoke: a real (tiny) end-to-end search on 8 fake CPU devices.
+
+    Asserts the three things the subsystem promises: a winner record is
+    persisted under the topology-scoped name, every emitted tune_* event
+    validates against the schema, and both tune_trial and tune_result
+    kinds actually appear.
+    """
+    _force_devices(8)
+    from distributeddataparallel_tpu.observability import (
+        EventLog,
+        events_path,
+    )
+    from distributeddataparallel_tpu.observability.schema import (
+        validate_file,
+    )
+    from distributeddataparallel_tpu.tuning import (
+        SearchSpace,
+        TuningStore,
+        search_model,
+    )
+
+    with tempfile.TemporaryDirectory(prefix="ddp_tune_check") as tmp:
+        ev_path = events_path(tmp, 0)
+        summary = search_model(
+            "mlp",
+            mesh=_mesh(),
+            space=SearchSpace(
+                batch_per_chip=(8, 16), accum_steps=(1,), remat=(False,),
+                zero=(0, 1), moment_dtype=("f32",),
+            ),
+            top_k=2,
+            warmup_steps=1,
+            measure_steps=2,
+            seed=0,
+            tune_store=TuningStore(os.path.join(tmp, "tuned")),
+            events=EventLog(ev_path, 0),
+        )
+        problems = []
+        if summary["winner"] is None:
+            problems.append("search measured no winner")
+        store_path = summary.get("store_path")
+        if not (store_path and os.path.exists(store_path)):
+            problems.append(f"winner record not persisted: {store_path!r}")
+        problems += validate_file(ev_path)
+        kinds = {
+            json.loads(line)["kind"] for line in open(ev_path)
+        }
+        for want in ("tune_trial", "tune_result"):
+            if want not in kinds:
+                problems.append(f"no {want} event emitted")
+        if problems:
+            for p in problems:
+                print(f"ddp_tune --check: {p}", file=sys.stderr)
+            return CHECK_EXIT
+    print(
+        "ddp_tune --check: winner "
+        f"{summary['winner']['trial']} persisted, events schema-valid"
+    )
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("cmd", nargs="?", choices=("search", "apply", "report"),
+                   help="search: run the tuner and persist the winner; "
+                        "apply: print the stored winner's dpp.py flags; "
+                        "report: dump every record with drift accounting")
+    p.add_argument("--model", default="gpt2-small",
+                   help="mlp | cnn | tiny-lm | gpt2-small (alias gpt2)")
+    p.add_argument("--devices", type=int, default=0, metavar="N",
+                   help="force N fake CPU devices (0 = use the real "
+                        "backend as-is)")
+    p.add_argument("--seq", type=int, default=128)
+    p.add_argument("--trials", type=int, default=3,
+                   help="top-K candidates to measure after pruning")
+    p.add_argument("--steps", type=int, default=4,
+                   help="measured steps per candidate")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--tune-dir", default=".ddp_tune",
+                   help="TunedConfig store directory")
+    p.add_argument("--compile-cache", default=None, metavar="DIR",
+                   help="warm-start ExecutableStore for background "
+                        "candidate precompiles")
+    p.add_argument("--events-dir", default=None,
+                   help="write tune_* events as observability JSONL here")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable output")
+    p.add_argument("--check", action="store_true",
+                   help="CI smoke: tiny 2-trial search on 8 fake CPU "
+                        "devices; nonzero unless a winner persists and "
+                        "events validate")
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.check:
+        return run_check()
+    if args.cmd == "search":
+        return cmd_search(args)
+    if args.cmd == "apply":
+        return cmd_apply(args)
+    if args.cmd == "report":
+        return cmd_report(args)
+    build_parser().print_usage(sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,463 @@
+"""Autotuner subsystem tests (distributeddataparallel_tpu.tuning):
+
+- Typed search space: enumeration is seed-deterministic and every
+  emitted trial passes the same validity gates dpp.py enforces.
+- TunedConfig store round trip; key mismatch falls back LOUDLY to the
+  untuned defaults (warning naming the differing fields, strict raises)
+  — the same contract as the warm-start executable store.
+- Autotuner core: analytic memory pruning, predicted-throughput
+  ranking, baseline always measured and eligible to win, exact
+  predicted-vs-measured drift accounting, crash-isolated candidates.
+- Generalized BackgroundPrecompiler: arbitrary (name, key, build) jobs,
+  wait/done, and the join-at-shutdown guard (submit after join raises).
+- ExecutableStore capability record: ``_store.json`` carries a bool
+  ``reserialize_ok`` verdict and never shows up as an entry.
+- perf_gate metric directions: ``*_gain_frac`` gates higher-is-better
+  and must not be shadowed by the ``*_frac`` lower-is-better rule.
+- Acceptance: ``dpp.py --autotune search`` persists a winner and emits
+  tune_trial events; a second run with ``--autotune apply`` reaches the
+  first step with ZERO search trials.
+"""
+
+import json
+import logging
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, "/root/repo")
+sys.path.insert(0, os.path.join("/root/repo", "scripts"))
+
+import dpp  # noqa: E402
+import perf_gate  # noqa: E402
+from distributeddataparallel_tpu.analysis.mesh_sim import (  # noqa: E402
+    analytic_memory_fit,
+)
+from distributeddataparallel_tpu.training.warm_start import (  # noqa: E402
+    WarmStartMismatch,
+    _save_allowed,
+)
+from distributeddataparallel_tpu.tuning import (  # noqa: E402
+    Autotuner,
+    SearchSpace,
+    TrialConfig,
+    TuningStore,
+)
+from distributeddataparallel_tpu.utils.logging import get_logger  # noqa: E402
+
+
+class _Capture(logging.Handler):
+    """The repo logger has propagate=False, so caplog can't see it —
+    capture by attaching directly."""
+
+    def __init__(self):
+        super().__init__()
+        self.messages = []
+
+    def emit(self, record):
+        self.messages.append(record.getMessage())
+
+
+class _capture_warnings:
+    def __enter__(self):
+        self._h = _Capture()
+        get_logger().addHandler(self._h)
+        return self._h.messages
+
+    def __exit__(self, *exc):
+        get_logger().removeHandler(self._h)
+
+
+# ---------------------------------------------------------------- space
+
+
+def test_space_enumeration_deterministic_and_valid():
+    space = SearchSpace(
+        batch_per_chip=(8, 16, 32), accum_steps=(1, 2, 3),
+        remat=(False, True), zero=(0, 1, 2),
+        moment_dtype=("f32", "bf16"),
+    )
+    a = space.enumerate(seed=7)
+    b = space.enumerate(seed=7)
+    assert a == b, "same seed must give the same trial order"
+    assert a != space.enumerate(seed=8), "seed must actually shuffle"
+    assert sorted(t.label for t in a) == sorted(
+        t.label for t in space.enumerate(seed=8)
+    ), "seeds reorder, never change the trial SET"
+    for t in a:
+        assert not t.problems(), t
+    labels = {t.label for t in a}
+    # the dpp gates: accum must divide batch; low-bit moments need zero
+    assert not any(t.batch_per_chip % t.accum_steps for t in a)
+    assert "b8-a1-r0-z0-mbf16-q2" not in labels
+    assert "b8-a3-r0-z0-mf32-q2" not in labels
+
+
+def test_trial_round_trip_and_cli_flags():
+    t = TrialConfig(batch_per_chip=16, accum_steps=2, remat=True, zero=2,
+                    moment_dtype="bf16", bucket_mb=4.0, dispatch_depth=3)
+    assert TrialConfig.from_dict(t.as_dict()) == t
+    flags = t.cli_flags()
+    assert "--remat" in flags and "--moment-dtype" in flags
+    assert "--zero" in flags and "--bucket-mb" in flags
+    # mlp/cnn have no remat knob and dpp.py rejects the flag for them
+    assert "--remat" not in t.cli_flags(lm=False)
+    # a valid winner must replay through the dpp argument gates
+    base = ["--model", "gpt2", "--dataset", "synthetic-lm"]
+    dpp.validate_args(dpp.parse_args(base + flags))
+
+
+# ---------------------------------------------------------------- store
+
+
+def test_tuned_config_round_trip(devices, tmp_path):
+    import distributeddataparallel_tpu as ddp
+    from distributeddataparallel_tpu.tuning import tuned_key
+
+    mesh = ddp.make_mesh(("data",))
+    store = TuningStore(str(tmp_path / "tuned"))
+    key = tuned_key(mesh=mesh, extra={"model": "mlp", "seq": 0})
+    trial = TrialConfig(batch_per_chip=16, zero=1)
+    path = store.save(
+        "mlp@d8", key, config=trial.as_dict(), objective="model_flops/s",
+        score=1.0, measured_step_s=0.01, gain_frac=0.25,
+    )
+    assert os.path.exists(path)
+    rec = store.load("mlp@d8", key)
+    assert rec is not None
+    assert TrialConfig.from_dict(rec["config"]) == trial
+    assert rec["gain_frac"] == 0.25
+    assert store.index()["mlp@d8"]["score"] == 1.0
+
+
+def test_tuned_config_key_mismatch_loud(devices, tmp_path):
+    import distributeddataparallel_tpu as ddp
+    from distributeddataparallel_tpu.tuning import tuned_key
+
+    mesh = ddp.make_mesh(("data",))
+    store = TuningStore(str(tmp_path / "tuned"))
+    key = tuned_key(mesh=mesh, extra={"model": "mlp", "seq": 128})
+    store.save("mlp@d8", key, config=TrialConfig().as_dict(),
+               objective="model_flops/s", score=1.0)
+
+    stale = tuned_key(mesh=mesh, extra={"model": "mlp", "seq": 256})
+    with _capture_warnings() as messages:
+        assert store.load("mlp@d8", stale) is None
+    assert any(
+        "key mismatch" in m and "extra.seq" in m
+        and "falling back to untuned defaults" in m
+        for m in messages
+    ), messages
+    with pytest.raises(WarmStartMismatch, match="key mismatch"):
+        store.load("mlp@d8", stale, strict=True)
+    # a cold store (nothing tuned yet) is silent — not a fault
+    with _capture_warnings() as messages:
+        assert store.load("other@d8", key) is None
+    assert not messages
+
+
+# ------------------------------------------------------------ perf_gate
+
+
+def test_perf_gate_gain_frac_direction():
+    """``*_gain_frac`` is a WIN share: higher is better, and it must
+    not be shadowed by the ``_frac$`` lower-is-better waste-share rule
+    (ISSUE 15 satellite f)."""
+    assert perf_gate._bench_direction("tune_gain_frac") == "higher"
+    assert perf_gate._bench_direction("gain_frac") == "higher"
+    # the neighbors keep their directions
+    assert perf_gate._bench_direction("tuned_step_s") == "lower"
+    assert perf_gate._bench_direction("zb_bubble_frac") == "lower"
+    assert perf_gate._bench_direction("integrity_overhead_frac") == "lower"
+    assert perf_gate._bench_direction("restart_reclaimed_s") == "higher"
+    assert perf_gate._bench_direction("serve_tok_s") == "higher"
+
+
+# ------------------------------------------------------- memory pruning
+
+
+def test_analytic_memory_fit_zero_ladder():
+    kw = dict(
+        params_bytes=4_000_000, params_count=1_000_000, n_devices=8,
+        act_bytes=1_000_000, batch_bytes=500_000,
+        budget_bytes=10_000_000,
+    )
+    req = {
+        z: analytic_memory_fit(zero_level=z, **kw)["required_bytes"]
+        for z in (0, 1, 2, 3)
+    }
+    # each ZeRO level shards one more term by N: strictly less memory
+    assert req[0] > req[1] > req[2] > req[3]
+    # zero1 shards the moments (8 B/param) across 8 devices
+    assert req[0] - req[1] == 8_000_000 - 8_000_000 // 8
+    fit = analytic_memory_fit(zero_level=0, **kw)
+    assert not fit["fits"] and fit["analytic"]
+    assert analytic_memory_fit(zero_level=3, **kw)["fits"]
+    # low-bit moments shrink the optimizer term
+    low = analytic_memory_fit(
+        zero_level=1, moment_bytes_per_param=2.0, **kw
+    )
+    assert low["required_bytes"] < req[1]
+
+
+def test_mesh_sim_no_compile_records_fit(devices):
+    from distributeddataparallel_tpu.analysis.mesh_sim import simulate
+
+    rec = simulate("cnn", "dp", batch_per_chip=2, do_compile=False)
+    fit = rec.get("fit")
+    assert fit is not None and fit.get("analytic") is True
+    assert fit["required_bytes"] > 0 and fit["fits"] in (True, False)
+
+
+# ------------------------------------------------------- autotuner core
+
+
+def _fake_hooks(step_s_by_label, *, no_fit=(), fail=()):
+    """Deterministic predict/measure pair: predicted step time is the
+    table value, measured is exactly 2x it (drift_frac == +1.0)."""
+
+    def predict(trial):
+        return {
+            "model_flops": 100.0,
+            "step_s": step_s_by_label[trial.label],
+            "fit": {
+                "required_bytes": 1, "budget_bytes": 2,
+                "fits": trial.label not in no_fit, "analytic": True,
+            },
+        }
+
+    def measure(trial):
+        if trial.label in fail:
+            raise RuntimeError("XLA fell over")
+        s = 2.0 * step_s_by_label[trial.label]
+        return {"step_s": s, "score": 100.0 / s, "mfu": None,
+                "warm_mode": "aot"}
+
+    return predict, measure
+
+
+def test_autotuner_prunes_ranks_and_accounts_drift():
+    trials = [
+        TrialConfig(batch_per_chip=b) for b in (8, 16, 32, 64, 128)
+    ]
+    by_label = {t.label: 0.01 * (i + 1)
+                for i, t in enumerate(trials)}  # slower as batch grows
+    predict, measure = _fake_hooks(
+        by_label, no_fit={trials[4].label}, fail={trials[0].label},
+    )
+    prepared = []
+    tuner = Autotuner(predict=predict, measure=measure,
+                      prepare=prepared.append, top_k=2)
+    baseline = TrialConfig(batch_per_chip=64)
+    winner, records = tuner.search(trials, baseline=baseline)
+    by = {r.trial.label: r for r in records}
+
+    assert by[trials[4].label].status == "pruned-memory"
+    # fastest predicted (b8) and next (b16) are the top-2 candidates;
+    # b8's measurement crashes and that is a RESULT, not a failure
+    assert by[trials[0].label].status.startswith("error:")
+    assert by[trials[1].label].status == "measured"
+    assert by[trials[2].label].status == "pruned-cost"
+    assert by[baseline.label].status == "baseline"
+    # measured = 2x predicted everywhere -> drift is exactly +100%
+    assert by[trials[1].label].drift_frac == pytest.approx(1.0)
+    assert by[baseline.label].drift_frac == pytest.approx(1.0)
+    # b16 measured 0.04s vs baseline 0.08s -> b16 wins on model FLOP/s
+    assert winner is by[trials[1].label]
+    # prepare() was called for each measured candidate after the first
+    assert prepared == [t.trial for t in
+                        [by[trials[1].label], by[baseline.label]]]
+
+
+def test_autotuner_baseline_can_win():
+    trials = [TrialConfig(batch_per_chip=8)]
+    base = TrialConfig(batch_per_chip=64)
+    by_label = {trials[0].label: 0.08, base.label: 0.01}
+    predict, measure = _fake_hooks(by_label)
+    winner, _ = Autotuner(predict=predict, measure=measure,
+                          top_k=1).search(trials, baseline=base)
+    assert winner.trial == base and winner.status == "baseline"
+
+
+def test_autotuner_seeded_search_is_deterministic():
+    space = SearchSpace(batch_per_chip=(8, 16, 32, 64),
+                        accum_steps=(1, 2), zero=(0, 1))
+    by_label = {t.label: 0.01 + 0.001 * i
+                for i, t in enumerate(space.enumerate())}
+    predict, measure = _fake_hooks(by_label)
+
+    def run():
+        tuner = Autotuner(predict=predict, measure=measure, top_k=3)
+        winner, records = tuner.search(space.enumerate(seed=3))
+        return winner.trial.label, [
+            (r.trial.label, r.status, r.measured_step_s) for r in records
+        ]
+
+    assert run() == run()
+
+
+# ----------------------------------------------- background precompiler
+
+
+def test_background_precompiler_generalized(devices, tmp_path):
+    """Arbitrary (name, key, build) jobs run off-thread; results land in
+    report; the join guard makes late submits raise instead of hanging
+    interpreter teardown."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributeddataparallel_tpu.training.warm_start import (
+        BackgroundPrecompiler,
+        ExecutableStore,
+    )
+
+    store = ExecutableStore(str(tmp_path / "aot"), probe=False)
+
+    def build_for(scale):
+        def build():
+            fn = jax.jit(lambda v: v * scale)
+            args = (jax.ShapeDtypeStruct((8,), jnp.float32),)
+            return fn, args
+        return build
+
+    pre = BackgroundPrecompiler(store).start()
+    pre.submit("t2", {"scale": 2}, build_for(2.0))
+    pre.submit("t3", {"scale": 3}, build_for(3.0))
+    assert pre.wait(timeout=60), "worker never went idle"
+    assert pre.done
+    assert pre.report == {"t2": "saved", "t3": "saved"}
+    # resubmitting an already-stored key is a cheap no-op
+    pre.submit("t2", {"scale": 2}, build_for(2.0))
+    assert pre.wait(timeout=60)
+    assert pre.report["t2"] == "cached"
+    # a crashing build is swallowed per-job, not fatal to the worker
+    def bad_build():
+        raise ValueError("no mesh for you")
+    pre.submit("boom", {"x": 1}, bad_build)
+    assert pre.wait(timeout=60)
+    assert pre.report["boom"].startswith("error:")
+
+    pre.join(timeout=60)
+    with pytest.raises(RuntimeError, match="submit after join"):
+        pre.submit("late", {"x": 2}, build_for(4.0))
+    assert sorted(store.index()) == ["t2", "t3"]
+
+
+def test_executable_store_capability_record(devices, tmp_path):
+    from distributeddataparallel_tpu.training.warm_start import (
+        ExecutableStore,
+    )
+
+    root = str(tmp_path / "aot")
+    store = ExecutableStore(root)  # probe at open
+    assert isinstance(store.reserialize_ok, bool)
+    meta = store.store_meta()
+    assert meta["reserialize_ok"] == store.reserialize_ok
+    assert "versions" in meta
+    assert os.path.exists(os.path.join(root, "_store.json"))
+    # the reserved record is store metadata, never an entry
+    assert "_store" not in store.index()
+
+    # reopen trusts the persisted verdict instead of re-probing
+    with open(os.path.join(root, "_store.json")) as fh:
+        rec = json.load(fh)
+    rec["reserialize_ok"] = not store.reserialize_ok
+    with open(os.path.join(root, "_store.json"), "w") as fh:
+        json.dump(rec, fh)
+    assert ExecutableStore(root).reserialize_ok is rec["reserialize_ok"]
+
+    # the save policy: fresh compiles always persist; cache-hit compiles
+    # persist only where the probe said re-serialization round-trips
+    store.reserialize_ok = False
+    assert _save_allowed(store, 0, None)
+    assert _save_allowed(store, 1, None)
+    assert _save_allowed(store, 0, {"key": {}})
+    assert not _save_allowed(store, 1, {"key": {}})
+    store.reserialize_ok = True
+    assert _save_allowed(store, 1, {"key": {}})
+
+
+# ----------------------------------------------------------- acceptance
+
+
+def _tune_args(tmp_path, mode, events_sub):
+    return dpp.parse_args([
+        "--device", "cpu",
+        "--model", "mlp",
+        "--dataset", "synthetic",
+        "--num-examples", "128",
+        "--batch-size", "8",
+        "--epochs", "1",
+        "--log-every", "1000",
+        "--autotune", mode,
+        "--tune-trials", "1",
+        "--tune-steps", "1",
+        "--tune-dir", str(tmp_path / "tuned"),
+        "--events-dir", str(tmp_path / events_sub),
+    ])
+
+
+def _tune_kinds(tmp_path, events_sub):
+    recs = []
+    evdir = str(tmp_path / events_sub)
+    for fname in os.listdir(evdir):
+        if fname.startswith("events-") and fname.endswith(".jsonl"):
+            with open(os.path.join(evdir, fname)) as fh:
+                recs += [json.loads(line) for line in fh if line.strip()]
+    return [r for r in recs if str(r.get("kind", "")).startswith("tune_")]
+
+
+def test_dpp_autotune_search_then_apply(devices, tmp_path):
+    """The PR's acceptance loop: a search run persists a winner and
+    emits tune_trial events; the apply rerun reaches its first train
+    step with ZERO search trials, replaying the stored config."""
+    dpp.train(_tune_args(tmp_path, "search", "ev_search"))
+    assert os.path.exists(str(tmp_path / "tuned" / "mlp@d8.tuned.json"))
+    search_events = _tune_kinds(tmp_path, "ev_search")
+    n_trials = sum(1 for r in search_events if r["kind"] == "tune_trial")
+    results = [r for r in search_events if r["kind"] == "tune_result"]
+    assert n_trials > 0
+    assert [r["mode"] for r in results] == ["search"]
+    assert results[0]["winner"]
+
+    dpp.train(_tune_args(tmp_path, "apply", "ev_apply"))
+    apply_events = _tune_kinds(tmp_path, "ev_apply")
+    assert sum(
+        1 for r in apply_events if r["kind"] == "tune_trial"
+    ) == 0, "apply must not search"
+    results = [r for r in apply_events if r["kind"] == "tune_result"]
+    assert [r["mode"] for r in results] == ["apply"]
+    assert results[0]["applied"] is True
+    assert (
+        results[0]["winner"]["batch_per_chip"]
+        == json.load(
+            open(str(tmp_path / "tuned" / "mlp@d8.tuned.json"))
+        )["config"]["batch_per_chip"]
+    )
+
+
+def test_dpp_autotune_apply_cold_store_falls_back(devices, tmp_path):
+    """apply on a never-tuned host: loud info, CLI defaults, run still
+    trains (a tuned config is an optimization, not a requirement)."""
+    args = _tune_args(tmp_path, "apply", "ev_cold")
+    loss = dpp.train(args)
+    assert loss == loss  # finite run completed
+    results = [r for r in _tune_kinds(tmp_path, "ev_cold")
+               if r["kind"] == "tune_result"]
+    assert [r["mode"] for r in results] == ["apply"]
+    assert results[0]["applied"] is False
+    assert args.batch_size == 8  # defaults untouched
+
+
+def test_dpp_autotune_arg_gates():
+    with pytest.raises(SystemExit, match="autotune"):
+        dpp.validate_args(dpp.parse_args(
+            ["--model", "gpt2", "--dataset", "synthetic-lm",
+             "--autotune", "search", "--fsdp"]
+        ))
+    with pytest.raises(SystemExit, match="remat"):
+        dpp.validate_args(dpp.parse_args(
+            ["--model", "mlp", "--dataset", "synthetic",
+             "--remat", "on"]
+        ))
