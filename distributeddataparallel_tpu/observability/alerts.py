@@ -189,6 +189,35 @@ class SdcStorm(AlertRule):
         )
 
 
+class GangSuspect(AlertRule):
+    """At least ``max_suspects`` gang members are in the heartbeat-
+    hysteresis window (slow-but-alive — flagged by the rendezvous store
+    before the timeout tombstones them).  This is the straggler alarm
+    the multi-host hardening layer promises: loud while the host is
+    merely slow, so an operator can act before membership changes.
+    Clears when the suspect set empties (the beat refreshed or the
+    member was shed)."""
+
+    name = "gang_suspect"
+
+    def __init__(self, max_suspects: int = 1):
+        if max_suspects < 1:
+            raise ValueError(
+                f"gang_suspect threshold must be >= 1, got {max_suspects}"
+            )
+        self.max_suspects = max_suspects
+
+    def evaluate(self, signals):
+        n = signals.get("gang_suspects")
+        if n is None:
+            return None
+        return (
+            n >= self.max_suspects,
+            n == 0,
+            {"value": int(n), "threshold": self.max_suspects},
+        )
+
+
 class LoaderStarvation(AlertRule):
     """Prefetch queue empty at ``windows`` consecutive boundaries: the
     input pipeline is gating the step loop (the live counterpart of the
@@ -260,7 +289,7 @@ class MemoryGrowth(AlertRule):
 RULE_CLASSES = {
     cls.name: cls
     for cls in (StepTimeSpike, MfuFloor, GoodputFloor, RestartStorm,
-                SdcStorm, LoaderStarvation, MemoryGrowth)
+                SdcStorm, GangSuspect, LoaderStarvation, MemoryGrowth)
 }
 
 
@@ -301,7 +330,11 @@ def parse_alert_spec(spec: str | None) -> list[AlertRule]:
         if name in overrides:
             v = overrides[name]
             rules.append(
-                cls(int(v) if name in ("restart_storm", "sdc_storm") else v)
+                cls(
+                    int(v)
+                    if name in ("restart_storm", "sdc_storm", "gang_suspect")
+                    else v
+                )
             )
         else:
             rules.append(cls())

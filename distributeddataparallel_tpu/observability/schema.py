@@ -57,6 +57,15 @@ EVENT_KINDS: dict[str, tuple[str, ...]] = {
     "membership_epoch": ("epoch", "roster", "size"),
     "gang_resize": ("epoch", "old_size", "new_size"),
     "resize_downtime": ("epoch", "seconds"),
+    # Multi-host hardening layer (runtime.hostgang / launcher ladder):
+    # a member in the heartbeat-hysteresis window (slow-but-alive, not
+    # yet tombstoned), a rendezvous-store re-host onto the elected
+    # survivor, and the supervisor's terminal degradation-ladder record
+    # (rung = resize | restart | fail, fault = the chaos entry that
+    # triggered it, or null for organic failures).
+    "gang_suspect": ("member", "age_s"),
+    "rdzv_rehost": ("generation", "owner"),
+    "gang_verdict": ("rung", "fault"),
     "profile_start": ("reason",),
     "profile_stop": (),
     "loader_starved": ("window",),

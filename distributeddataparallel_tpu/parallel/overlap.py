@@ -486,18 +486,41 @@ def _probe_tpu_topology(topology: str, timeout_s: float = 20.0) -> None:
     topology string, so a process pays for the probe at most once.
     """
     if topology not in _TPU_TOPOLOGY_PROBE:
+        import os
         import subprocess
         import sys
 
+        # Scrub the child env: a supervised gang worker carries
+        # distributed-init vars (JAX_COORDINATOR_ADDRESS & co) and chaos
+        # wiring that the probe must not inherit — the throwaway child
+        # would block rendezvousing with a gang it isn't part of, and
+        # the 20s deadline would misread "waiting on a coordinator" as
+        # "plugin wedged".
+        child_env = {
+            k: v for k, v in os.environ.items()
+            if k not in (
+                "JAX_COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES",
+                "JAX_PROCESS_ID", "CLOUD_TPU_TASK_ID", "TPU_WORKER_ID",
+            ) and not k.startswith("DDP_")
+        }
+        # Exit sentinel 3 = "plugin raised cleanly" (no TPU runtime /
+        # no plugin): an expected skip, unlike a crash or a wedge.
         code = (
-            "from jax.experimental.topologies import get_topology_desc; "
-            f"get_topology_desc(platform='tpu', topology_name={topology!r})"
+            "import sys\n"
+            "try:\n"
+            "    from jax.experimental.topologies import "
+            "get_topology_desc\n"
+            f"    get_topology_desc(platform='tpu', "
+            f"topology_name={topology!r})\n"
+            "except Exception:\n"
+            "    sys.exit(3)\n"
         )
         try:
             res = subprocess.run(
                 [sys.executable, "-c", code],
                 capture_output=True,
                 timeout=timeout_s,
+                env=child_env,
             )
             _TPU_TOPOLOGY_PROBE[topology] = res.returncode == 0
         except subprocess.TimeoutExpired:

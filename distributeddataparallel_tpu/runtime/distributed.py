@@ -112,6 +112,44 @@ def destroy_process_group() -> None:
     _STATE.multi_process = False
 
 
+def reinit_after_resize(
+    *,
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> None:
+    """Re-establish ``jax.distributed`` after a membership-epoch resize.
+
+    On a real multi-host fleet an elastic resize changes the PROCESS
+    world, not just the mesh: the control plane must be torn down and
+    re-initialized with the survivors' new (size, id) assignment — the
+    rendezvous store agreed on the roster, this turns that agreement
+    into a live jax.distributed world.  Arguments default to the
+    ``JAX_NUM_PROCESSES`` / ``JAX_PROCESS_ID`` env the caller (launcher
+    resize-respawn, or the hostgang member itself) re-exported for the
+    new epoch.
+
+    Single-process (the CPU-simulation gangs): a no-op beyond state —
+    there is no control plane to cycle, the resize is an in-process mesh
+    rebuild.
+    """
+    was_multi = _STATE.multi_process
+    if _STATE.initialized:
+        destroy_process_group()
+    if not was_multi and not (
+        coordinator_address
+        or num_processes
+        or os.environ.get("JAX_COORDINATOR_ADDRESS")
+    ):
+        _STATE.initialized = True
+        return
+    init_process_group(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
 def is_initialized() -> bool:
     return _STATE.initialized
 

@@ -33,7 +33,10 @@ import dataclasses
 import time
 from typing import Sequence
 
-from distributeddataparallel_tpu.runtime.rendezvous import RendezvousStore
+from distributeddataparallel_tpu.runtime.rendezvous import (
+    RendezvousFencedError,
+    RendezvousStore,
+)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -74,9 +77,16 @@ class ElasticGangCoordinator:
         min_size: int = 1,
         events=None,
         transition_timeout_s: float = 30.0,
+        heartbeat_timeout_s: float | None = None,
+        suspect_after_s: float | None = None,
     ):
         if isinstance(store, (str, bytes)):
-            store = RendezvousStore(store)
+            kw = {}
+            if heartbeat_timeout_s is not None:
+                kw["heartbeat_timeout_s"] = float(heartbeat_timeout_s)
+            if suspect_after_s is not None:
+                kw["suspect_after_s"] = float(suspect_after_s)
+            store = RendezvousStore(store, **kw)
         self.store = store
         self.world = [str(w) for w in world]
         if not self.world:
@@ -86,6 +96,13 @@ class ElasticGangCoordinator:
         self.transition_timeout_s = float(transition_timeout_s)
         self.epoch = -1
         self.roster: tuple[str, ...] = ()
+        # Optional chaos injector (utils.chaos): consulted for heartbeat
+        # suppression (slow-heartbeat).  dpp.py wires this alongside
+        # ``injector.gang = gang``.
+        self.chaos = None
+        #: members currently in the suspect window, refreshed every poll
+        self.suspects_now: tuple[str, ...] = ()
+        self._suspected: set[str] = set()
 
     # -- lifecycle ------------------------------------------------------
 
@@ -99,16 +116,46 @@ class ElasticGangCoordinator:
         ``launcher.spawn(elastic_store=...)``): propose the next epoch
         over the members that actually came back, so epochs stay
         monotonic across the respawn.
+
+        Race-tolerant for the one-member-per-process topology: N
+        processes start concurrently and every one of them runs this,
+        so the epoch-0 proposal can lose the store's epoch fence to a
+        peer's — a fenced loser re-reads and adopts the winner.
+
+        On a LIVE epoch the move depends on who disagrees with its
+        roster.  A live member OUTSIDE the roster (a late joiner —
+        possibly this process) means incumbents may be mid-run: adopt
+        as-is and let ``poll()`` run the barriered transition on the
+        first step, with every survivor acking — proposing here would
+        skip the ack barrier and strand the incumbents in a transition
+        we never participate in.  A roster with only GHOSTS missing
+        (every live member inside it — a respawned gang over a stale
+        store, where every live member is starting right here) is
+        re-proposed over the live set directly, so the respawn doesn't
+        burn a poll-time resize on members that died with the old
+        incarnation.
         """
         for m in self.world:
             self.store.join(m)
-        rec = self.store.epoch()
-        if rec["epoch"] < 0:
-            rec = self.store.propose(self.store.alive(), epoch=0)
-            self._emit_epoch(rec)
-        elif set(self.store.alive()) != set(rec["roster"]):
-            rec = self.store.propose(self.store.alive())
-            self._emit_epoch(rec)
+        deadline = time.monotonic() + self.transition_timeout_s
+        while True:
+            rec = self.store.epoch()
+            alive = self.store.alive()
+            if rec["epoch"] >= 0:
+                roster = set(rec["roster"])
+                if set(alive) == roster or not set(alive) <= roster:
+                    break  # matching, or a joiner: poll() converges it
+            try:
+                if rec["epoch"] < 0:
+                    rec = self.store.propose(alive, epoch=0)
+                else:
+                    rec = self.store.propose(alive)
+                self._emit_epoch(rec)
+                break
+            except RendezvousFencedError:
+                if time.monotonic() >= deadline:
+                    raise
+                time.sleep(0.02)
         self.epoch = rec["epoch"]
         self.roster = tuple(rec["roster"])
         return rec
@@ -129,6 +176,27 @@ class ElasticGangCoordinator:
             member = self.world[int(member)]
         self.store.mark_dead(member)
 
+    def kill_proposer(self) -> None:
+        """Tombstone the would-be epoch proposer — the lexicographically
+        smallest live member (the chaos ``proposer-kill`` hook).  The
+        transition the kill forces must be completed by the promoted
+        second-smallest survivor, which is exactly the re-election path
+        ``RendezvousStore.transition`` hardens."""
+        alive = self.store.alive()
+        if alive:
+            self.store.mark_dead(alive[0])
+
+    def rejoin(self, member: str | int) -> None:
+        """Bring a previously-killed member back (the chaos
+        ``worker-join`` hook / a recovered host): clears its tombstone
+        and restores its heartbeat, so the next ``poll()`` sees a larger
+        live set and resizes UP."""
+        member = str(member)
+        if member not in self.world and member.isdigit() \
+                and int(member) < len(self.world):
+            member = self.world[int(member)]
+        self.store.join(member)
+
     def _hosted_live(self) -> list[str]:
         dead = set(self.store.dead())
         return [m for m in self.world if m not in dead]
@@ -146,12 +214,23 @@ class ElasticGangCoordinator:
         """
         hosted = self._hosted_live()
         for m in hosted:
+            if self.chaos is not None \
+                    and self.chaos.heartbeat_suppressed(m):
+                continue  # slow-heartbeat injection: the beat is "lost"
             self.store.heartbeat(m)
         if not hosted:
             raise RuntimeError(
                 "every member hosted by this process is dead — nothing "
                 "left to resize around (supervised restart territory)"
             )
+        self._watch_suspects()
+        # Failure detector: a member whose heartbeat aged past the full
+        # timeout without any tombstone is a host that died (or was
+        # partitioned away) without anyone observing it — promote the
+        # expiry to a tombstone so the transition below doesn't wait on a
+        # ghost.  The suspect window above already flagged it loudly.
+        for m in self.store.expired():
+            self.store.mark_dead(m)
         alive = self.store.alive()
         if set(alive) == set(self.roster):
             return None
@@ -160,12 +239,34 @@ class ElasticGangCoordinator:
                 f"surviving roster {alive} is below --min-procs "
                 f"{self.min_size}; falling back to gang restart"
             )
-        nxt = self.store.epoch()["epoch"] + 1
-        for m in hosted:
-            self.store.ack(nxt, m)
-        rec = self.store.transition(
-            hosted[0], timeout_s=self.transition_timeout_s
-        )
+        rec = None
+        for attempt in (0, 1):
+            hosted = self._hosted_live()
+            if not hosted:
+                raise RuntimeError(
+                    "every member hosted by this process was lost during "
+                    "the epoch transition"
+                )
+            nxt = self.store.epoch()["epoch"] + 1
+            for m in hosted:
+                self.store.ack(nxt, m)
+            try:
+                rec = self.store.transition(
+                    hosted[0], timeout_s=self.transition_timeout_s
+                )
+                break
+            except RuntimeError:
+                # hosted[0] was tombstoned mid-transition (proposer
+                # kill): retry once as the next surviving hosted member.
+                # A second loss means the gang is shedding faster than it
+                # agrees — surface it.
+                if attempt:
+                    raise
+        if rec is None:
+            raise RuntimeError(
+                "epoch transition returned nothing — store unreachable "
+                "(partitioned?)"
+            )
         prev = self.roster or tuple(rec.get("prev_roster", ()))
         decision = ResizeDecision(
             epoch=rec["epoch"],
@@ -187,6 +288,29 @@ class ElasticGangCoordinator:
                 joined=list(decision.joined),
             )
         return decision
+
+    def _watch_suspects(self) -> None:
+        """Surface the heartbeat-hysteresis window: a member whose beat
+        is old-but-unexpired is flagged ONCE per suspicion (straggler
+        event + alert upstream) and cleared when its beat refreshes —
+        loud before the timeout tombstones it, silent while healthy."""
+        ages = None
+        sus = self.store.suspects()
+        self.suspects_now = tuple(sus)
+        for m in sus:
+            if m in self._suspected:
+                continue
+            self._suspected.add(m)
+            if self.events is not None:
+                if ages is None:
+                    ages = self.store.heartbeat_ages()
+                self.events.emit(
+                    "gang_suspect",
+                    member=m,
+                    age_s=round(float(ages.get(m, -1.0)), 3),
+                    epoch=self.epoch,
+                )
+        self._suspected &= set(sus)
 
     def _emit_epoch(self, rec: dict) -> None:
         if self.events is not None:

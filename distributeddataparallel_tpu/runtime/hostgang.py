@@ -1,0 +1,447 @@
+"""Real-process gang members for the multi-host elastic runtime.
+
+Everywhere else in this repo a "gang" on the CPU simulation is one
+process holding N fake devices, because this jaxlib's CPU backend
+refuses cross-process collectives.  The MEMBERSHIP protocol has no such
+limit — it is pure files/TCP — and this module is where it runs the way
+a real fleet runs it: one OS process per host, each hosting exactly one
+gang member, driving membership epochs over the rendezvous store while
+the launcher supervises the lot.
+
+:class:`HostGangMember` is the per-process driver behind the fault-
+matrix tests and the ``scripts/ci.sh`` 3-host chaos smoke:
+
+- joins the store under ``host<rank>``, publishes its launcher-rank
+  binding (``rank:<i>`` blob) so the supervisor can tell an absorbed
+  in-place resize from an organic crash;
+- runs a deterministic step loop: chaos hooks, heartbeat + failure
+  detection + epoch transitions through ``ElasticGangCoordinator``;
+- publishes its live state (a small counter vector) to the blob board
+  every epoch, so a late JOINER catches up from survivors' live state
+  instead of a checkpoint — the protocol shape of ROADMAP 3c's
+  scale-up warm start;
+- when the TCP server dies under it, runs the deterministic re-host
+  election (:func:`rendezvous.elect_rehost`) with a liveness fallback:
+  if the elected owner never publishes a higher generation, it is
+  presumed dead with its host and the next-smallest survivor takes
+  over;
+- optionally initializes ``jax.distributed`` from the coordinator env
+  the launcher already exported (rendezvous works on CPU; collectives
+  do not, which is exactly what ``guarded_worker`` maps to a skip).
+
+Transports: ``tcp`` (one member serves a ``TCPRendezvousServer``,
+everyone speaks ``TCPRendezvousClient``) or ``file`` (every process
+opens the shared-FS ``RendezvousStore`` directly) — the protocol is
+identical, which is the point of sharing it.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+from distributeddataparallel_tpu.runtime.elastic_gang import (
+    ElasticGangCoordinator,
+)
+from distributeddataparallel_tpu.runtime.rendezvous import (
+    RETRYABLE_ERRORS,
+    AddressBook,
+    RendezvousStore,
+    RetryPolicy,
+    TCPRendezvousClient,
+    TCPRendezvousServer,
+    elect_rehost,
+    rehost_store,
+)
+from distributeddataparallel_tpu.utils.chaos import (
+    FaultInjector,
+    PartitionedStoreProxy,
+)
+
+__all__ = ["EVICTED_EXIT", "HostGangMember", "hostgang_worker",
+           "step_state"]
+
+#: Exit code of a member that discovered its own eviction (tombstoned /
+#: partitioned out) — distinct from a crash so tests can assert the
+#: victim noticed, and from HOST_KILLED_EXIT so the supervisor's logs
+#: tell "shed by the gang" from "chaos killed the host".
+EVICTED_EXIT = 78
+
+
+def _default(cfg: dict, key: str, value):
+    return cfg[key] if key in cfg else value
+
+
+def step_state(acc: float, step: int) -> float:
+    """One step of the members' deterministic live-state recurrence.
+    Same ops in the same order on every host -> bitwise-identical
+    float64 on every member that covered the same step prefix; tests
+    replay it as the checkpoint-restore reference."""
+    return acc * 1.5 + (step + 1) * 0.125
+
+
+class HostGangMember:
+    """One process = one host = one gang member.
+
+    ``cfg`` keys (all optional unless noted):
+
+    - ``store_root`` (required): shared-FS scratch for the rendezvous
+      store, the address book, chaos once-markers, and fault breadcrumbs
+    - ``world_size`` (required): gang size at launch
+    - ``steps``: step-loop length (default 20); ``step_s``: per-step
+      sleep (default 0.05)
+    - ``transport``: ``"tcp"`` (default) or ``"file"``
+    - ``server_rank``: which rank serves the TCP store (default 0)
+    - ``min_size``: resize floor (default 1)
+    - ``heartbeat_timeout_s`` / ``suspect_after_s``: failure-detector
+      windows (defaults 2.0 / 0.8 — test-fast, not production)
+    - ``jax_init``: initialize ``jax.distributed`` from the launcher's
+      coordinator env before the loop (default False on CPU sims)
+    """
+
+    def __init__(self, rank: int, cfg: dict):
+        self.rank = int(rank)
+        self.cfg = dict(cfg)
+        self.name = f"host{self.rank}"
+        self.root = str(cfg["store_root"])
+        self.world_size = int(cfg["world_size"])
+        self.steps = int(_default(cfg, "steps", 20))
+        self.step_s = float(_default(cfg, "step_s", 0.05))
+        self.transport = str(_default(cfg, "transport", "tcp"))
+        self.server_rank = int(_default(cfg, "server_rank", 0))
+        self.min_size = int(_default(cfg, "min_size", 1))
+        self.hb_timeout = float(_default(cfg, "heartbeat_timeout_s", 2.0))
+        self.suspect_after = float(_default(cfg, "suspect_after_s", 0.8))
+        # Must exceed a peer's full RPC retry budget (~1.6s with the
+        # 6-attempt policy below) plus its re-host time: the elected
+        # owner only STARTS re-hosting after its own poll exhausts
+        # retries, and a too-short wait makes the next candidate promote
+        # itself over a live owner — a split-brain store.
+        self.rehost_wait_s = float(_default(cfg, "rehost_wait_s", 4.0))
+        self.book = AddressBook(os.path.join(self.root, "address-book.json"))
+        self.server: TCPRendezvousServer | None = None
+        self.events = None
+        events_dir = os.environ.get("DDP_EVENTS_DIR")
+        if events_dir:
+            from distributeddataparallel_tpu.observability.events import (
+                EventLog,
+            )
+
+            self.events = EventLog(
+                os.path.join(events_dir, f"events-host{self.rank}.jsonl"),
+                self.rank,
+            )
+        # Chaos: shared once-markers + fault breadcrumbs on the shared
+        # scratch, so each entry fires exactly once ACROSS the gang and
+        # the supervisor can attribute its verdict.
+        self.injector = FaultInjector(
+            os.environ.get("DDP_CHAOS", ""),
+            state_dir=os.path.join(self.root, ".chaos"),
+            events=self.events,
+        )
+        self.injector.hosts = {str(self.rank): self.name}
+        self.injector.abrupt_exit = True
+        # Breadcrumbs live INSIDE the store root: that is the path the
+        # supervisor was given (spawn(elastic_store=...)), so that is
+        # where _last_fault looks for attribution.
+        self.injector.fault_log = os.path.join(
+            self.root, "store", "faults.jsonl"
+        )
+        # ``acc`` is the member's live train-state stand-in: a float
+        # evolved by a fixed per-step recurrence, so every member that
+        # executed (or adopted via catch-up) the same step prefix holds
+        # the BITWISE-identical value — the parity the resize tests
+        # assert against a checkpoint-restore replay.
+        self.state = {"step": 0, "epoch": -1, "resizes": 0, "acc": 0.0}
+
+    # -- store wiring ---------------------------------------------------
+
+    def _make_store(self):
+        store_dir = os.path.join(self.root, "store")
+        if self.transport == "file":
+            return RendezvousStore(
+                store_dir,
+                heartbeat_timeout_s=self.hb_timeout,
+                suspect_after_s=self.suspect_after,
+            )
+        if self.rank == self.server_rank:
+            backing = RendezvousStore(
+                store_dir,
+                heartbeat_timeout_s=self.hb_timeout,
+                suspect_after_s=self.suspect_after,
+            )
+            # A respawned gang's server must outrank the dead one's
+            # book entry, or peers keep resolving to a refused socket.
+            prior = self.book.lookup()
+            gen = prior[1] + 1 if prior is not None else 0
+            self.server = TCPRendezvousServer(
+                backing, generation=gen, address_book=self.book
+            )
+            self.injector.server = self.server
+        else:
+            # Everyone resolves through the book; the server may not be
+            # up yet, so wait for the first publish.
+            deadline = time.monotonic() + 30.0
+            while self.book.lookup() is None:
+                if time.monotonic() >= deadline:
+                    raise TimeoutError(
+                        "rendezvous server never published its address"
+                    )
+                time.sleep(0.02)
+        return TCPRendezvousClient(
+            address_book=self.book,
+            retry=RetryPolicy(attempts=6, base_s=0.05, max_s=0.4),
+        )
+
+    # -- lifecycle ------------------------------------------------------
+
+    def run(self) -> dict:
+        if self.cfg.get("jax_init"):
+            # Membership over jax.distributed: the launcher already
+            # exported JAX_COORDINATOR_ADDRESS/JAX_NUM_PROCESSES/
+            # JAX_PROCESS_ID for this child; the rendezvous service
+            # itself works on CPU (only cross-process COMPUTE does not,
+            # which guarded_worker maps to the skip sentinel).
+            from distributeddataparallel_tpu.runtime.distributed import (
+                init_process_group,
+            )
+
+            init_process_group()
+        client = self._make_store()
+        self.injector.store_root = os.path.join(self.root, "store")
+        coord = ElasticGangCoordinator(
+            client,
+            world=[self.name],
+            min_size=self.min_size,
+            events=self.events,
+            transition_timeout_s=max(8.0, 4 * self.hb_timeout),
+        )
+        coord.chaos = self.injector
+        self.injector.gang = coord
+        self.coord = coord
+        self.client = client
+        try:
+            # Join FIRST and wait for the whole launch roster to show up
+            # before establishing the epoch: otherwise the first process
+            # up proposes epoch 0 over a partial gang and every later
+            # joiner forces another epoch — churn that reads exactly like
+            # a real resize to the supervisor's ladder.
+            self._call(client.join, self.name)
+            self._wait_full_gang()
+            self._call(coord.start)
+            self._call(client.put_blob, f"rank:{self.rank}", self.name)
+            self._catch_up()
+            self._loop()
+            self._call(client.put_blob, f"done:{self.name}",
+                       json.dumps(self.state))
+            # Collective exit: leave only after every live peer also
+            # reported done.  A lone early leaver's tombstone would read
+            # as membership drift to a laggard's next poll — a phantom
+            # end-of-run resize.
+            self._wait_peers_done()
+            self._call(coord.stop)
+        finally:
+            self._shutdown()
+        return dict(self.state)
+
+    def _wait_full_gang(self) -> None:
+        """Hold the step loop until the launch roster assembled (or a
+        late JOINER sees an established epoch and skips the wait): chaos
+        step indices stay meaningful relative to a full gang."""
+        if self._call(self.client.epoch)["epoch"] >= 0:
+            return
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            alive = self._call(self.client.alive)
+            if alive is not None and len(alive) >= self.world_size:
+                return
+            self._call(self.client.heartbeat, self.name)
+            time.sleep(0.02)
+        raise TimeoutError(
+            f"gang never assembled {self.world_size} members"
+        )
+
+    def _catch_up(self) -> None:
+        """Scale-up catch-up: a joiner that lands on an established gang
+        adopts the survivors' published live state instead of starting
+        from step 0 — the blob board is the live-state channel checkpoint
+        restore would otherwise be."""
+        blob = self._call(self.client.get_blob, "state")
+        if blob:
+            try:
+                rec = json.loads(blob)
+            except json.JSONDecodeError:
+                return
+            if rec.get("step", 0) > self.state["step"]:
+                self.state.update(
+                    step=int(rec["step"]),
+                    epoch=int(rec.get("epoch", -1)),
+                    acc=float(rec.get("acc", 0.0)),
+                )
+
+    def _loop(self) -> None:
+        while self.state["step"] < self.steps:
+            step = self.state["step"]
+            self.injector.before_step(step)
+            if self.injector.partitioned and not isinstance(
+                self.coord.store, PartitionedStoreProxy
+            ):
+                self.coord.store = PartitionedStoreProxy(self.coord.store)
+            decision = self._poll_with_rehost()
+            if decision is not None:
+                self.state["epoch"] = decision.epoch
+                self.state["resizes"] += 1
+            self.state["acc"] = step_state(self.state["acc"], step)
+            if self._i_publish():
+                # Through coord.store, not the raw client: a partitioned
+                # member's publishes must vanish with its other writes.
+                self._call(
+                    self.coord.store.put_blob, "state",
+                    json.dumps({
+                        "step": step + 1,
+                        "epoch": self.state["epoch"],
+                        "acc": self.state["acc"],
+                    }),
+                )
+            self.state["step"] = step + 1
+            time.sleep(self.step_s)
+
+    def _wait_peers_done(self) -> None:
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            try:
+                others = [
+                    m for m in self.coord.store.alive() if m != self.name
+                ]
+                if all(
+                    self.coord.store.get_blob(f"done:{m}") is not None
+                    for m in others
+                ):
+                    return
+                # Keep beating: expiring out while politely waiting for
+                # peers would BE the phantom drift this wait prevents.
+                self.coord.store.heartbeat(self.name)
+            except (*RETRYABLE_ERRORS, RuntimeError):
+                return  # store gone or us evicted: nothing to wait for
+            time.sleep(0.05)
+
+    def _i_publish(self) -> bool:
+        """The smallest member of the agreed roster owns the live-state
+        blob (same determinism rule as the proposer/re-host elections)."""
+        roster = self.coord.roster
+        return bool(roster) and roster[0] == self.name
+
+    def _call(self, fn, *args):
+        """One store call with eviction detection: a member that lost
+        its own membership exits with EVICTED_EXIT instead of crashing —
+        the gang shed us, which for this process is a verdict, not a
+        bug."""
+        try:
+            return fn(*args)
+        except RuntimeError as exc:
+            msg = str(exc)
+            if "lost during" in msg or "not in the surviving" in msg \
+                    or "is dead" in msg or "unreachable" in msg:
+                self._log("evicted: %s", msg)
+                sys.exit(EVICTED_EXIT)
+            raise
+
+    def _poll_with_rehost(self):
+        try:
+            return self._call(self.coord.poll)
+        except RETRYABLE_ERRORS:
+            if self.transport != "tcp":
+                raise  # file transport has no server to re-host
+            self._rehost()
+            return None
+
+    def _rehost(self) -> None:
+        """The store stopped answering past the retry budget: the server
+        died.  Deterministic re-host with a liveness fallback — owners
+        are tried smallest-first, each given ``rehost_wait_s`` to publish
+        a higher generation before the next candidate presumes it dead
+        (the server's whole host may have gone down with it)."""
+        candidates = list(
+            self.coord.roster
+            or sorted(self.client.epoch_cache.get(
+                max(self.client.epoch_cache, default=-1), {}
+            ).get("roster", []))
+            or [self.name]
+        )
+        seen_gen = max(0, self.client.generation_seen)
+        while candidates:
+            owner = elect_rehost(candidates)
+            if owner == self.name:
+                gen = seen_gen + 1
+                # Seed the new store with the FULL believed roster, not
+                # just ourselves: peers re-register through their own
+                # heartbeats, but until they do the re-hoster's poll
+                # must not read the empty member list as mass death and
+                # run a shrinking transition.  A peer that really died
+                # with the old server expires out naturally.
+                self.server = rehost_store(
+                    os.path.join(self.root, f"store-gen{gen}"),
+                    self.client.cached_history(),
+                    generation=gen,
+                    members=list(candidates),
+                    address_book=self.book,
+                    heartbeat_timeout_s=self.hb_timeout,
+                    suspect_after_s=self.suspect_after,
+                )
+                self.injector.server = self.server
+                if self.events is not None:
+                    self.events.emit(
+                        "rdzv_rehost", generation=gen, owner=self.name
+                    )
+                self._log("re-hosted rendezvous store at generation %d", gen)
+                return
+            deadline = time.monotonic() + self.rehost_wait_s
+            while time.monotonic() < deadline:
+                rec = self.book.lookup()
+                if rec is not None and rec[1] > seen_gen:
+                    return  # owner came up; client re-resolves via book
+                time.sleep(0.05)
+            # Owner never published: presume its host died with the
+            # server and fall through to the next-smallest survivor.
+            candidates = [c for c in candidates if c != owner]
+        raise ConnectionError(
+            "rendezvous server lost and no surviving candidate re-hosted"
+        )
+
+    def _shutdown(self) -> None:
+        if self.server is not None:
+            # Keep serving until every other roster member reported done
+            # or fell out of the live set — the store must outlive its
+            # last client.
+            deadline = time.monotonic() + 10.0
+            store = self.server.store
+            while time.monotonic() < deadline:
+                others = [
+                    m for m in store.alive() if m != self.name
+                ]
+                if not others:
+                    break
+                if all(
+                    store.get_blob(f"done:{m}") is not None for m in others
+                ):
+                    break
+                time.sleep(0.05)
+            try:
+                self.server.close()
+            except OSError:
+                pass
+        if self.events is not None:
+            self.events.close()
+
+    def _log(self, msg: str, *args) -> None:
+        from distributeddataparallel_tpu.utils.logging import get_logger
+
+        get_logger().warning("[%s] " + msg, self.name, *args)
+
+
+def hostgang_worker(rank: int, cfg: dict) -> None:
+    """Module-level launcher target (survives spawn pickling): run one
+    :class:`HostGangMember` to completion."""
+    HostGangMember(rank, cfg).run()

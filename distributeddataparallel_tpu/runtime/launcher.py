@@ -118,6 +118,69 @@ def _join_gang(procs) -> list[tuple[int, int]]:
     return failed
 
 
+def _last_fault(elastic_store: str | None) -> dict | None:
+    """Most recent chaos breadcrumb from the shared fault log (written
+    by ``FaultInjector`` when ``fault_log`` / ``DDP_FAULT_LOG`` is
+    wired), or None — the attribution a ``gang_verdict`` carries so the
+    verdict names the fault that triggered the ladder."""
+    if not elastic_store:
+        return None
+    import json
+
+    last = None
+    try:
+        with open(os.path.join(elastic_store, "faults.jsonl")) as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if isinstance(rec, dict):
+                    last = rec
+    except OSError:
+        return None
+    return last
+
+
+def _absorbed_resize(elastic_store: str, failed, min_procs: int) -> bool:
+    """Did the surviving gang already absorb the failed ranks IN PLACE
+    (the multi-host in-place resize: survivors ran the epoch transition
+    and finished while the dead rank's exit is the only non-zero code)?
+
+    True iff every failed launcher rank published a member binding
+    (``rank:<i>`` blob, written by hostgang members at join), every such
+    member is tombstoned AND out of the agreed roster, and the roster
+    still meets the ``min_procs`` floor.  A rank with no binding (the
+    one-process CPU-sim gang) or an untombstoned member (an organic
+    crash nobody shed) is NOT absorbed — those take the respawn rungs.
+    """
+    from distributeddataparallel_tpu.runtime.rendezvous import (
+        RendezvousStore,
+    )
+
+    try:
+        store = RendezvousStore(elastic_store)
+        names = []
+        for rank, _code in failed:
+            blob = store.get_blob(f"rank:{rank}")
+            if not blob:
+                return False
+            names.append(blob.strip())
+        cur = store.epoch()
+        if cur["epoch"] < 0:
+            return False
+        roster = set(cur["roster"])
+        dead = set(store.dead())
+        if any(n in roster or n not in dead for n in names):
+            return False
+        return len(roster) >= max(min_procs, 1)
+    except (OSError, RuntimeError, ValueError):
+        return False  # torn/unreadable store: not absorbed, ladder on
+
+
 def _elastic_survivors(elastic_store: str):
     """Roster state from an elastic rendezvous store: ``(store, epoch,
     roster, survivors)``, or None when the store has no epoch yet.
@@ -221,8 +284,40 @@ def spawn(
                 os.path.join(events_dir, "events-supervisor.jsonl"),
                 "supervisor",
             )
+        def _verdict(rung: str, **detail) -> None:
+            """The degradation ladder's terminal record: which rung this
+            run ended on (resize / restart / fail), attributed to the
+            chaos fault that triggered it (None for organic failures).
+            Emitted once, at the supervisor — the only process whose view
+            spans every incarnation."""
+            if sup_events is None:
+                return
+            fault = _last_fault(elastic_store)
+            sup_events.emit(
+                "gang_verdict",
+                rung=rung,
+                fault=None if fault is None else fault.get("entry"),
+                fault_kind=None if fault is None else fault.get("kind"),
+                **detail,
+            )
+
+        def _resized_in_place() -> bool:
+            """Did the gang itself run at least one epoch transition
+            beyond the initial roster (in-place resize, no respawn)?"""
+            if elastic_store is None:
+                return False
+            from distributeddataparallel_tpu.runtime.rendezvous import (
+                RendezvousStore,
+            )
+
+            try:
+                return len(RendezvousStore(elastic_store).history()) > 1
+            except OSError:
+                return False
+
         try:
             attempt = 0
+            resizes = 0
             world_override: int | None = None
             while True:
                 # The worker can surface its incarnation
@@ -239,12 +334,47 @@ def spawn(
                 procs = _run_gang(fn, args, nprocs, gang_env)
                 failed = _join_gang(procs)
                 if not failed:
+                    # Clean finish: name the rung the run used to get
+                    # here.  restart dominates resize in the verdict
+                    # (budget was consumed); a fault absorbed without
+                    # either respawn is the in-place resize rung (an
+                    # epoch transition, or a store re-host / recovered
+                    # suspect that never changed membership).
+                    fault = _last_fault(elastic_store)
+                    if attempt > 0:
+                        _verdict("restart", attempts=attempt)
+                    elif resizes > 0 or _resized_in_place():
+                        _verdict("resize", respawns=resizes)
+                    elif fault is not None:
+                        _verdict("resize", respawns=0)
                     return None
                 t_died = time.perf_counter()
-                info = (
-                    _elastic_survivors(elastic_store)
-                    if elastic_store is not None else None
-                )
+                if (
+                    elastic_store is not None
+                    and _absorbed_resize(elastic_store, failed, min_procs)
+                ):
+                    # Multi-host in-place resize: the dead rank's exit is
+                    # the only failure, the survivors tombstoned it, ran
+                    # the epoch transition, and finished their run — the
+                    # gang already took the first ladder rung, nothing to
+                    # respawn.
+                    _verdict("resize", respawns=resizes, failed=failed)
+                    get_logger().warning(
+                        "[supervisor] rank(s) %s died but the surviving "
+                        "gang absorbed the loss in place (elastic resize) "
+                        "— run complete, no respawn",
+                        [r for r, _ in failed],
+                    )
+                    return None
+                info = None
+                if elastic_store is not None:
+                    try:
+                        info = _elastic_survivors(elastic_store)
+                    except RuntimeError:
+                        # Torn epoch store beyond self-heal: membership
+                        # is unreadable, so a resize is off the table —
+                        # fall through to the checkpoint-restart rung.
+                        info = None
                 if info is not None:
                     store, epoch, roster, survivors = info
                     if (
@@ -260,6 +390,7 @@ def spawn(
                         # tombstones) and proposes the next epoch over
                         # exactly the members that actually came back.
                         world_override = len(survivors)
+                        resizes += 1
                         for m in roster:
                             store.leave(m)
                         if sup_events is not None:
@@ -292,6 +423,13 @@ def spawn(
                             attempt=attempt, failed=failed,
                             max_restarts=max_restarts,
                         )
+                    # The ladder's last rung: resize was impossible (or
+                    # already tried), the restart budget is gone — fail
+                    # LOUDLY, with the triggering fault named.
+                    _verdict(
+                        "fail", attempts=attempt, failed=failed,
+                        max_restarts=max_restarts,
+                    )
                     raise RuntimeError(
                         f"spawned processes failed (rank, exitcode): {failed} "
                         f"— restart budget of {max_restarts} exhausted"

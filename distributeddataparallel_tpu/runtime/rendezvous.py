@@ -21,6 +21,26 @@ Two transports share the protocol:
   whose members don't share a filesystem.  One request per line, one
   JSON reply per line; the op names mirror the store methods.
 
+The multi-host hardening layer (this PR) treats the store itself as a
+component that fails:
+
+- every client RPC runs under :class:`RetryPolicy` — bounded retries
+  with exponential backoff and jitter, reconnecting (and re-resolving
+  the address through an :class:`AddressBook`) between attempts, so a
+  connection reset during a server re-host is a delay, not a crash;
+- when the TCP server dies, the deterministic smallest-name survivor
+  re-hosts it (:func:`rehost_store`): the epoch log is replayed from the
+  survivor's client-side epoch cache and the new server is published
+  with a bumped *generation* — every reply carries the generation, and
+  a client that has seen generation g treats any reply from a lower
+  generation as a stale, fenced-off server (reconnect, don't obey);
+- ``propose`` fences epoch regression (:class:`RendezvousFencedError`):
+  a resurrected stale server (or a partitioned proposer) cannot move
+  membership history backwards — first write per epoch wins;
+- heartbeat hysteresis: a member whose beat is old-but-not-expired is
+  ``suspect`` (:meth:`RendezvousStore.suspects`) — flagged loudly
+  (straggler event + alert upstream) before anyone tombstones it.
+
 Module-import rule: stdlib only.  The launcher supervisor and the chaos
 injector import this in fresh interpreters; jax must not load here.
 """
@@ -29,16 +49,30 @@ from __future__ import annotations
 
 import json
 import os
+import random
 import socket
 import socketserver
 import threading
 import time
 import uuid
 
-# A heartbeat older than this many seconds marks its member suspect; the
-# coordinator treats suspects like tombstoned members when computing the
-# next roster.  Generous by default — CPU-simulation steps are slow.
+# A heartbeat older than this many seconds marks its member dead; the
+# coordinator treats expired members like tombstoned members when
+# computing the next roster.  Generous by default — CPU-simulation steps
+# are slow.
 DEFAULT_HEARTBEAT_TIMEOUT_S = 60.0
+
+#: Fraction of the heartbeat timeout after which a member is *suspect*:
+#: still in ``alive()`` (no membership change yet) but surfaced by
+#: ``suspects()`` so the gang can flag the straggler before the timeout
+#: tombstones it — hysteresis between "slow" and "gone".
+DEFAULT_SUSPECT_FRACTION = 0.5
+
+
+class RendezvousFencedError(RuntimeError):
+    """A stale actor tried to move the epoch history backwards — a
+    resurrected old server, or a proposer acting on a pre-partition view.
+    The write was refused; the caller must re-read the current epoch."""
 
 
 def _atomic_write(path: str, payload: str) -> None:
@@ -46,6 +80,91 @@ def _atomic_write(path: str, payload: str) -> None:
     with open(tmp, "w") as fh:
         fh.write(payload)
     os.replace(tmp, path)
+
+
+class RetryPolicy:
+    """Bounded exponential backoff with jitter for store RPCs.
+
+    ``delays()`` yields ``attempts - 1`` sleep durations: after the k-th
+    failure the caller sleeps ``min(base * 2^k, max) * (1 ± jitter)``.
+    Jitter decorrelates the gang — N clients hammering a re-hosting
+    server in lockstep is exactly the thundering herd that keeps it from
+    coming up."""
+
+    def __init__(self, attempts: int = 8, base_s: float = 0.05,
+                 max_s: float = 1.0, jitter: float = 0.5):
+        if attempts < 1:
+            raise ValueError(f"attempts must be >= 1, got {attempts}")
+        self.attempts = int(attempts)
+        self.base_s = float(base_s)
+        self.max_s = float(max_s)
+        self.jitter = float(jitter)
+
+    def delays(self):
+        for k in range(self.attempts - 1):
+            d = min(self.base_s * (2.0 ** k), self.max_s)
+            yield d * (1.0 + self.jitter * (2.0 * random.random() - 1.0))
+
+
+#: Exceptions that mean "the transport failed", not "the store refused":
+#: retried under the policy.  ``OSError`` covers ECONNREFUSED/ECONNRESET/
+#: EPIPE and socket timeouts (``socket.timeout`` is ``OSError``).
+RETRYABLE_ERRORS = (ConnectionError, BrokenPipeError, OSError)
+
+
+def retry_call(fn, *, policy: RetryPolicy | None = None,
+               retry_on=RETRYABLE_ERRORS, on_retry=None):
+    """Run ``fn()`` under ``policy``; ``on_retry(exc, delay)`` is called
+    before each backoff sleep (reconnect hook).  Raises the last error
+    when the budget is exhausted — bounded, never an infinite loop."""
+    policy = policy or RetryPolicy()
+    delays = policy.delays()
+    while True:
+        try:
+            return fn()
+        except retry_on as exc:
+            try:
+                delay = next(delays)
+            except StopIteration:
+                raise exc from None
+            if on_retry is not None:
+                on_retry(exc, delay)
+            time.sleep(delay)
+
+
+class AddressBook:
+    """File-published server address with a generation fence.
+
+    The one piece of shared state the re-host protocol needs: where is
+    the store *now*?  ``publish`` refuses to move the address backwards
+    (a stale server re-publishing generation g-1 is ignored), ``lookup``
+    returns ``(address, generation)`` or None.  The file lives on the
+    one path every member can already reach (the launcher's shared
+    scratch dir); on a real fleet this is a cluster-metadata entry."""
+
+    def __init__(self, path: str):
+        self.path = str(path)
+
+    def publish(self, address: str, generation: int) -> bool:
+        cur = self.lookup()
+        if cur is not None and int(generation) < cur[1]:
+            return False  # stale publisher, fenced
+        _atomic_write(self.path, json.dumps(
+            {"address": str(address), "generation": int(generation)}
+        ))
+        return True
+
+    def lookup(self) -> tuple[str, int] | None:
+        for _ in range(5):
+            try:
+                with open(self.path) as fh:
+                    rec = json.loads(fh.read())
+                return str(rec["address"]), int(rec["generation"])
+            except FileNotFoundError:
+                return None
+            except (json.JSONDecodeError, KeyError, ValueError):
+                time.sleep(0.02)  # torn read mid-publish
+        return None
 
 
 class RendezvousStore:
@@ -65,10 +184,16 @@ class RendezvousStore:
         root: str,
         *,
         heartbeat_timeout_s: float = DEFAULT_HEARTBEAT_TIMEOUT_S,
+        suspect_after_s: float | None = None,
     ):
         self.root = str(root)
         self.heartbeat_timeout_s = float(heartbeat_timeout_s)
-        for sub in ("members", "dead", "acks"):
+        self.suspect_after_s = float(
+            suspect_after_s
+            if suspect_after_s is not None
+            else self.heartbeat_timeout_s * DEFAULT_SUSPECT_FRACTION
+        )
+        for sub in ("members", "dead", "acks", "blobs"):
             os.makedirs(os.path.join(self.root, sub), exist_ok=True)
 
     # -- membership -----------------------------------------------------
@@ -114,12 +239,11 @@ class RendezvousStore:
     def dead(self) -> list[str]:
         return sorted(os.listdir(os.path.join(self.root, "dead")))
 
-    def alive(self) -> list[str]:
-        """Members with a fresh heartbeat and no tombstone, sorted — this
-        IS the deterministic next-roster every survivor computes."""
+    def _heartbeat_ages(self) -> dict[str, float]:
+        """Seconds since each untombstoned member's last beat."""
         now = time.time()
         dead = set(self.dead())
-        out = []
+        ages: dict[str, float] = {}
         for fname in os.listdir(os.path.join(self.root, "members")):
             if not fname.endswith(".json"):
                 continue
@@ -127,14 +251,73 @@ class RendezvousStore:
             if name in dead:
                 continue
             try:
-                age = now - os.stat(
+                ages[name] = now - os.stat(
                     os.path.join(self.root, "members", fname)
                 ).st_mtime
             except FileNotFoundError:
                 continue  # concurrent leave()
-            if age <= self.heartbeat_timeout_s:
-                out.append(name)
-        return sorted(out)
+        return ages
+
+    def alive(self) -> list[str]:
+        """Members with a fresh heartbeat and no tombstone, sorted — this
+        IS the deterministic next-roster every survivor computes.
+        Suspects (old-but-unexpired beats) are still alive: membership
+        only changes at the full timeout, after the suspect window gave
+        the gang a chance to flag the straggler."""
+        return sorted(
+            n for n, age in self._heartbeat_ages().items()
+            if age <= self.heartbeat_timeout_s
+        )
+
+    def suspects(self) -> list[str]:
+        """Members in the hysteresis window: heartbeat older than
+        ``suspect_after_s`` but not yet expired — slow-but-alive hosts
+        the gang should flag (straggler event + alert) BEFORE the
+        timeout tombstones them.  A refreshed beat clears the flag."""
+        return sorted(
+            n for n, age in self._heartbeat_ages().items()
+            if self.suspect_after_s < age <= self.heartbeat_timeout_s
+        )
+
+    def expired(self) -> list[str]:
+        """Members whose heartbeat aged past the full timeout without a
+        tombstone — a host that stopped beating without anyone observing
+        its death.  The coordinator promotes these to tombstones (the
+        suspect → expired → tombstoned ladder's last rung)."""
+        return sorted(
+            n for n, age in self._heartbeat_ages().items()
+            if age > self.heartbeat_timeout_s
+        )
+
+    def heartbeat_ages(self) -> dict[str, float]:
+        """Public (and TCP-exposed) face of :meth:`_heartbeat_ages` —
+        the coordinator reports a suspect's observed age in its
+        ``gang_suspect`` event."""
+        return {
+            n: round(age, 3) for n, age in self._heartbeat_ages().items()
+        }
+
+    # -- blobs ----------------------------------------------------------
+
+    def _blob_path(self, key: str) -> str:
+        key = str(key)
+        if not key or "/" in key or key.startswith("."):
+            raise ValueError(f"bad blob key {key!r}")
+        return os.path.join(self.root, "blobs", key)
+
+    def put_blob(self, key: str, data: str) -> None:
+        """Small out-of-band payload board (text; callers base64 binary).
+        The scale-up path rides on this: a survivor publishes its live
+        state snapshot keyed by membership epoch and the joiner catches
+        up from it — no checkpoint read, no cross-process collective."""
+        _atomic_write(self._blob_path(key), str(data))
+
+    def get_blob(self, key: str) -> str | None:
+        try:
+            with open(self._blob_path(key)) as fh:
+                return fh.read()
+        except FileNotFoundError:
+            return None
 
     # -- epochs ---------------------------------------------------------
 
@@ -143,12 +326,15 @@ class RendezvousStore:
         the first transition).
 
         A missing file genuinely means "no transition yet".  A file that
-        EXISTS but fails to decode is a torn read — e.g. a non-atomic
-        overwrite from an out-of-tree writer, or a filesystem whose
-        rename is not atomic under the reader (NFS) — and defaulting
-        there would silently reset the epoch to -1 and fork the gang's
-        membership history.  Retry briefly (writers replace the file in
-        well under a second) and raise if the corruption persists.
+        EXISTS but fails to decode is a torn read — a transient one
+        (concurrent atomic replace on NFS-ish rename semantics) clears on
+        a brief retry; a PERSISTENT one is a torn write, the artifact of
+        a host dying inside a non-atomic overwrite.  Defaulting there
+        would silently reset the epoch to -1 and fork membership history,
+        so instead the store SELF-HEALS: the append-only ``epochs.jsonl``
+        log holds every record the gang ever agreed on, and its last
+        valid line is re-promoted to ``epoch.json`` (atomically this
+        time).  Only a store with a torn head AND no usable log raises.
         """
         path = os.path.join(self.root, "epoch.json")
         last_err = None
@@ -161,9 +347,18 @@ class RendezvousStore:
             except json.JSONDecodeError as exc:
                 last_err = exc
                 time.sleep(0.05)
+        recovered = None
+        for rec in self.history():
+            if isinstance(rec, dict) and "epoch" in rec:
+                if recovered is None or rec["epoch"] > recovered["epoch"]:
+                    recovered = rec
+        if recovered is not None:
+            _atomic_write(path, json.dumps(recovered))
+            return recovered
         raise RuntimeError(
             f"rendezvous epoch.json at {path!r} is persistently "
-            f"unparseable ({last_err}) — torn or corrupt epoch record"
+            f"unparseable ({last_err}) and epochs.jsonl has no valid "
+            f"record to heal from — torn or corrupt epoch history"
         )
 
     def roster(self) -> list[str]:
@@ -171,11 +366,24 @@ class RendezvousStore:
 
     def propose(self, roster: list[str], *, epoch: int | None = None) -> dict:
         """Write the next epoch record atomically and append it to the
-        transition log.  ``epoch`` defaults to current+1; a concurrent
-        duplicate proposal for the same epoch is harmless (same roster by
-        construction — every proposer computed it from ``alive()``)."""
+        transition log.  ``epoch`` defaults to current+1.
+
+        Epoch-version fence: a proposal for the CURRENT epoch is a
+        duplicate — first write won, the existing record is returned
+        unchanged (a proposer promoted after the original proposer died
+        races the original's late write harmlessly).  A proposal for an
+        OLDER epoch is a stale actor — a resurrected server replaying a
+        pre-partition view — and raises :class:`RendezvousFencedError`
+        instead of forking membership history."""
         cur = self.epoch()
         nxt = cur["epoch"] + 1 if epoch is None else int(epoch)
+        if nxt <= cur["epoch"]:
+            if nxt == cur["epoch"]:
+                return dict(cur)
+            raise RendezvousFencedError(
+                f"stale proposal for epoch {nxt}: membership history is "
+                f"already at epoch {cur['epoch']} — fenced"
+            )
         rec = {
             "epoch": nxt,
             "roster": sorted(str(r) for r in roster),
@@ -188,14 +396,21 @@ class RendezvousStore:
         return rec
 
     def history(self) -> list[dict]:
-        """All epoch transitions, oldest first."""
+        """All epoch transitions, oldest first.  Undecodable lines (a
+        torn final append from a dying writer) are skipped — the log is
+        the self-heal source for a torn ``epoch.json``, so it must
+        degrade to its valid prefix, not amplify the corruption."""
         out = []
         try:
             with open(os.path.join(self.root, "epochs.jsonl")) as fh:
                 for line in fh:
                     line = line.strip()
-                    if line:
+                    if not line:
+                        continue
+                    try:
                         out.append(json.loads(line))
+                    except json.JSONDecodeError:
+                        continue
         except FileNotFoundError:
             pass
         return out
@@ -266,17 +481,32 @@ class RendezvousStore:
                     f"member {name!r} lost during epoch transition"
                 )
             self.barrier(nxt, name, survivors, timeout_s=timeout_s)
-        if name == survivors[0]:
-            self.propose(survivors, epoch=nxt)
+        # Proposer-death tolerance: the deterministic proposer is the
+        # smallest SURVIVING member, re-evaluated each wait iteration.
+        # If the original proposer is tombstoned after the barrier but
+        # before its write lands, the next-smallest survivor promotes
+        # itself and proposes the still-alive subset; a late write from
+        # the original is absorbed by propose()'s same-epoch dedup.
         deadline = time.monotonic() + timeout_s
+        last_proposer = None
         while time.monotonic() < deadline:
             rec = self.epoch()
             if rec["epoch"] >= nxt:
                 return rec
+            self.heartbeat(name)  # waiting must not expire our own beat
+            live = [s for s in survivors if s in set(self.alive())]
+            if name not in live:
+                raise RuntimeError(
+                    f"member {name!r} lost during epoch transition"
+                )
+            last_proposer = live[0]
+            if name == live[0]:
+                self.propose(live, epoch=nxt)
+                continue  # next read observes our own write
             time.sleep(0.02)
         raise TimeoutError(
-            f"epoch {nxt} was never proposed (proposer {survivors[0]!r} "
-            f"died?)"
+            f"epoch {nxt} was never proposed (proposer "
+            f"{last_proposer!r} wedged?)"
         )
 
 
@@ -284,14 +514,43 @@ class RendezvousStore:
 
 _TCP_OPS = (
     "join", "heartbeat", "leave", "mark_dead", "alive", "dead",
-    "epoch", "roster", "propose", "history", "ack", "transition",
+    "epoch", "roster", "propose", "history", "ack", "barrier",
+    "transition", "suspects", "expired", "heartbeat_ages",
+    "put_blob", "get_blob",
 )
+
+#: op -> positional-arg names for the client facade
+_TCP_OP_ARGS = {
+    "join": ("name",), "heartbeat": ("name",), "leave": ("name",),
+    "mark_dead": ("name",), "propose": ("roster",),
+    "ack": ("epoch", "name"), "barrier": ("epoch", "name", "participants"),
+    "transition": ("name",), "put_blob": ("key", "data"),
+    "get_blob": ("key",),
+}
 
 
 class _Handler(socketserver.StreamRequestHandler):
+    def setup(self):
+        super().setup()
+        conns = getattr(self.server, "live_connections", None)
+        if conns is not None:
+            conns.add(self.connection)
+
+    def finish(self):
+        conns = getattr(self.server, "live_connections", None)
+        if conns is not None:
+            conns.discard(self.connection)
+        super().finish()
+
     def handle(self):
         store = self.server.store  # type: ignore[attr-defined]
+        gen = getattr(self.server, "generation", 0)
         for raw in self.rfile:
+            if getattr(self.server, "dying", False):
+                # kill() severs live connections too: a dead server
+                # process answers nobody.  Dropping the socket mid-
+                # request is exactly the reset the client must absorb.
+                return
             try:
                 req = json.loads(raw.decode())
                 op = req.pop("op")
@@ -300,11 +559,15 @@ class _Handler(socketserver.StreamRequestHandler):
                 result = getattr(store, op)(**req)
                 if isinstance(result, set):
                     result = sorted(result)
-                reply = {"ok": True, "result": result}
+                reply = {"ok": True, "result": result, "gen": gen}
             # ddplint: allow[broad-except] — protocol boundary: every
             # failure becomes a structured error reply, never a dead socket
             except Exception as exc:  # noqa: BLE001
-                reply = {"ok": False, "error": f"{type(exc).__name__}: {exc}"}
+                reply = {
+                    "ok": False, "gen": gen,
+                    "error": f"{type(exc).__name__}: {exc}",
+                    "fenced": isinstance(exc, RendezvousFencedError),
+                }
             self.wfile.write((json.dumps(reply) + "\n").encode())
             self.wfile.flush()
 
@@ -316,25 +579,65 @@ class TCPRendezvousServer:
     server thread is a daemon; ``close()`` (or the context exit) shuts it
     down.  Members use ``TCPRendezvousClient(address)``, which exposes the
     same method names as the store.
+
+    ``generation`` stamps every reply: a re-hosted server publishes a
+    higher generation, and clients refuse to go backwards — the fence
+    that keeps a zombie original server from resurrecting stale
+    membership after a re-host.  ``kill()`` (chaos) drops the listener
+    without the graceful shutdown handshake, the way a real server
+    process dies.
     """
 
     def __init__(self, store: RendezvousStore, host: str = "127.0.0.1",
-                 port: int = 0):
+                 port: int = 0, *, generation: int = 0,
+                 address_book: AddressBook | None = None):
         self.store = store
+        self.generation = int(generation)
         self._srv = socketserver.ThreadingTCPServer(
             (host, port), _Handler, bind_and_activate=True
         )
         self._srv.daemon_threads = True
         self._srv.store = store  # type: ignore[attr-defined]
+        self._srv.generation = self.generation  # type: ignore[attr-defined]
+        self._srv.live_connections = set()  # type: ignore[attr-defined]
         self.address = "%s:%d" % self._srv.server_address[:2]
         self._thread = threading.Thread(
             target=self._srv.serve_forever, daemon=True
         )
         self._thread.start()
+        if address_book is not None:
+            address_book.publish(self.address, self.generation)
 
     def close(self) -> None:
         self._srv.shutdown()
         self._srv.server_close()
+        self._thread.join(timeout=5.0)
+
+    def kill(self) -> None:
+        """Chaos hook: die abruptly — close the listener AND sever every
+        live connection (the handler loop checks ``dying`` per request),
+        leaving clients' in-flight RPCs to hit connection resets/EOF the
+        way a dead server process would (what ``rdzv-kill`` injects)."""
+        self._srv.dying = True  # type: ignore[attr-defined]
+        try:
+            self._srv.server_close()
+        except OSError:
+            pass
+        # Reset established connections too: a client blocked on a
+        # long-running op (barrier) must see EOF NOW, not the op's
+        # eventual reply — a dead process's kernel does exactly this.
+        for conn in list(
+            getattr(self._srv, "live_connections", ())
+        ):
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                conn.close()
+            except OSError:
+                pass
+        self._srv.shutdown()
         self._thread.join(timeout=5.0)
 
     def __enter__(self):
@@ -347,30 +650,139 @@ class TCPRendezvousServer:
 
 class TCPRendezvousClient:
     """JSON-lines client for ``TCPRendezvousServer``; method-per-op facade
-    so call sites are transport-agnostic (duck-typed with the store)."""
+    so call sites are transport-agnostic (duck-typed with the store).
 
-    def __init__(self, address: str, *, timeout_s: float = 60.0):
-        host, port = address.rsplit(":", 1)
+    Hardened transport (this PR):
+
+    - every RPC runs under ``retry`` (:class:`RetryPolicy`): connection
+      refused/reset — including mid-``barrier()`` while the server is
+      being killed and re-hosted — reconnects with backoff+jitter
+      instead of raising through the membership protocol;
+    - ``address_book`` re-resolves the server address between attempts,
+      so the retry lands on the re-hosted server, not the dead one;
+    - generation fence: replies carry the server's generation; once the
+      client has seen generation g, a reply from g' < g is a stale
+      (pre-re-host) server — discarded and retried via the book;
+    - ``epoch_cache`` records every epoch record this client ever saw —
+      the survivor-side material :func:`rehost_store` replays when this
+      member is elected to re-host the store.
+    """
+
+    def __init__(self, address: str | None = None, *,
+                 timeout_s: float = 60.0,
+                 retry: RetryPolicy | None = None,
+                 address_book: AddressBook | None = None):
+        if address is None and address_book is None:
+            raise ValueError("need an address or an address_book")
+        self._static_address = address
+        self._book = address_book
+        self._timeout_s = float(timeout_s)
+        self.retry = retry or RetryPolicy()
+        self.generation_seen = -1
+        self.epoch_cache: dict[int, dict] = {}
+        self._sock = None
+        self._rfile = None
+        try:
+            self._connect()
+        except RETRYABLE_ERRORS:
+            # The address may be a just-published book entry racing the
+            # server's listen, or a stale entry a respawned server is
+            # about to overwrite: stay lazy — the first RPC reconnects
+            # under the retry policy, re-resolving through the book.
+            self._disconnect()
+
+    # -- transport ------------------------------------------------------
+
+    def _resolve(self) -> str:
+        if self._book is not None:
+            rec = self._book.lookup()
+            if rec is not None:
+                addr, gen = rec
+                if gen >= self.generation_seen:
+                    return addr
+                # The book itself is stale (it fences on publish, so
+                # this is a torn read) — fall through and retry.
+            if self._static_address is None:
+                raise ConnectionError(
+                    "rendezvous address book is empty and no static "
+                    "address was given"
+                )
+        return self._static_address
+
+    def _connect(self) -> None:
+        self._disconnect()
+        addr = self._resolve()
+        host, port = addr.rsplit(":", 1)
         self._sock = socket.create_connection(
-            (host, int(port)), timeout=timeout_s
+            (host, int(port)), timeout=self._timeout_s
         )
         self._rfile = self._sock.makefile("rb")
 
-    def _call(self, op: str, **kw):
+    def _disconnect(self) -> None:
+        if self._rfile is not None:
+            try:
+                self._rfile.close()
+            except OSError:
+                pass
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+        self._sock = self._rfile = None
+
+    def _rpc_once(self, op: str, kw: dict):
+        if self._sock is None:
+            self._connect()
         self._sock.sendall((json.dumps({"op": op, **kw}) + "\n").encode())
         raw = self._rfile.readline()
         if not raw:
             raise ConnectionError("rendezvous server closed the connection")
         reply = json.loads(raw.decode())
+        gen = int(reply.get("gen", 0))
+        if gen < self.generation_seen:
+            # Stale pre-re-host server still answering: fence it off and
+            # make the retry path re-resolve through the address book.
+            raise ConnectionError(
+                f"stale rendezvous server (generation {gen} < "
+                f"{self.generation_seen}) — fenced"
+            )
+        self.generation_seen = max(self.generation_seen, gen)
         if not reply.get("ok"):
+            if reply.get("fenced"):
+                raise RendezvousFencedError(str(reply.get("error")))
             raise RuntimeError(f"rendezvous: {reply.get('error')}")
         return reply.get("result")
 
+    def _call(self, op: str, **kw):
+        def attempt():
+            return self._rpc_once(op, kw)
+
+        def reconnect(exc, delay):
+            self._disconnect()
+            try:
+                self._connect()
+            except RETRYABLE_ERRORS:
+                pass  # next attempt() reconnects again
+
+        result = retry_call(attempt, policy=self.retry, on_retry=reconnect)
+        if op in ("epoch", "transition", "propose") and isinstance(
+            result, dict
+        ) and "epoch" in result and result["epoch"] >= 0:
+            self.epoch_cache[int(result["epoch"])] = dict(result)
+        elif op == "history" and isinstance(result, list):
+            for rec in result:
+                if isinstance(rec, dict) and "epoch" in rec:
+                    self.epoch_cache[int(rec["epoch"])] = dict(rec)
+        return result
+
+    def cached_history(self) -> list[dict]:
+        """Every epoch record this client has observed, oldest first —
+        the replay material for :func:`rehost_store`."""
+        return [self.epoch_cache[k] for k in sorted(self.epoch_cache)]
+
     def close(self) -> None:
-        try:
-            self._rfile.close()
-        finally:
-            self._sock.close()
+        self._disconnect()
 
     def __enter__(self):
         return self
@@ -383,12 +795,7 @@ class TCPRendezvousClient:
 def _make_op(op):
     def call(self, *args, **kw):
         # Positional args map onto the store's signatures by op.
-        names = {
-            "join": ("name",), "heartbeat": ("name",), "leave": ("name",),
-            "mark_dead": ("name",), "propose": ("roster",),
-            "ack": ("epoch", "name"), "transition": ("name",),
-        }.get(op, ())
-        kw.update(zip(names, args))
+        kw.update(zip(_TCP_OP_ARGS.get(op, ()), args))
         return self._call(op, **kw)
 
     call.__name__ = op
@@ -398,3 +805,67 @@ def _make_op(op):
 for _op in _TCP_OPS:
     setattr(TCPRendezvousClient, _op, _make_op(_op))
 del _op
+
+
+# -- store re-hosting ----------------------------------------------------
+
+
+def elect_rehost(survivors: list[str]) -> str:
+    """The deterministic re-host owner: the lexicographically smallest
+    survivor — same rule as the epoch proposer, so no election protocol
+    is needed on top of the membership the gang already agrees on."""
+    if not survivors:
+        raise ValueError("no survivors to elect a re-host owner from")
+    return sorted(str(s) for s in survivors)[0]
+
+
+def rehost_store(
+    root: str,
+    epoch_records: list[dict],
+    *,
+    generation: int,
+    members: list[str] = (),
+    host: str = "127.0.0.1",
+    port: int = 0,
+    address_book: AddressBook | None = None,
+    heartbeat_timeout_s: float = DEFAULT_HEARTBEAT_TIMEOUT_S,
+    suspect_after_s: float | None = None,
+) -> TCPRendezvousServer:
+    """Stand the rendezvous store back up on a survivor after the server
+    died: seed a fresh :class:`RendezvousStore` at ``root`` by replaying
+    ``epoch_records`` (a survivor's :meth:`TCPRendezvousClient.
+    cached_history` — the append-only epoch log reconstructed from what
+    the gang actually agreed on), re-join ``members`` (the re-hoster's
+    own hosted members; peers re-join via their own heartbeats), and
+    serve it at ``generation`` (strictly greater than the dead server's)
+    published through ``address_book``.
+
+    The epoch fence holds across the re-host: the replayed ``epoch.json``
+    lands on the NEWEST cached epoch, so a stale proposal — or the old
+    server's disk resurrected at an earlier epoch — is refused by
+    ``propose``'s version check, and the generation stamp keeps clients
+    off the old server entirely.
+    """
+    store = RendezvousStore(
+        root,
+        heartbeat_timeout_s=heartbeat_timeout_s,
+        suspect_after_s=suspect_after_s,
+    )
+    records = sorted(
+        (dict(r) for r in epoch_records if "epoch" in r),
+        key=lambda r: int(r["epoch"]),
+    )
+    if records:
+        log_path = os.path.join(store.root, "epochs.jsonl")
+        with open(log_path, "a") as fh:
+            for rec in records:
+                fh.write(json.dumps(rec) + "\n")
+        _atomic_write(
+            os.path.join(store.root, "epoch.json"), json.dumps(records[-1])
+        )
+    for m in members:
+        store.join(m)
+    return TCPRendezvousServer(
+        store, host, port, generation=int(generation),
+        address_book=address_book,
+    )

@@ -210,18 +210,31 @@ class Checkpointer:
             # PyTreeRestore args.
             mgr = ocp.CheckpointManager(self._dir)
             try:
-                return mgr.restore(
+                from dataclasses import fields as dc_fields
+
+                # Restore through a pruned dict template that matches
+                # the on-disk field set exactly — no comm_state key at
+                # all.  (PyTreeRestore with the full template fails a
+                # dict-key check against the legacy checkpoint, and the
+                # partial_restore kwarg only exists on newer orbax.)
+                legacy_tmpl = {
+                    f.name: getattr(template, f.name)
+                    for f in dc_fields(template)
+                    if f.metadata.get("pytree_node", True)
+                    and f.name != "comm_state"
+                }
+                restored = mgr.restore(
                     step,
                     args=ocp.args.PyTreeRestore(
-                        template,
+                        legacy_tmpl,
                         restore_args=(
                             ocp.checkpoint_utils.construct_restore_args(
-                                template
+                                legacy_tmpl
                             )
                         ),
-                        partial_restore=True,
                     ),
                 )
+                return template.replace(**restored)
             finally:
                 mgr.close()
 

@@ -29,6 +29,41 @@ Spec grammar (comma-separated entries, all steps 0-based)::
                        instead of restarting (requires a wired gang
                        coordinator; a no-op with a logged warning
                        otherwise)
+    worker-join@S[:R]  re-join previously-killed member R (default 1) —
+                       the grow half of the elastic protocol: the next
+                       poll() sees the larger live set and resizes UP,
+                       warm-starting from the N+1 precompile entry
+    host-kill@S[:R]    the HOST owning member R (default 1) goes away:
+                       tombstone the member, then die — abruptly
+                       (``os._exit``) when this injector marks itself a
+                       real multi-process host, via SimulatedPreemption
+                       in the one-process CPU-sim gang.  Fires only in
+                       the process that owns R (``hosts``)
+    proposer-kill@S    tombstone the would-be epoch proposer (the
+                       lexicographically-smallest live member) — the
+                       ensuing transition must be completed by the
+                       promoted second-smallest survivor
+    rdzv-kill@S        kill the TCP rendezvous server hosted by this
+                       process (fires only where ``server`` is wired):
+                       clients absorb the resets via retry/backoff and
+                       the smallest-name survivor re-hosts the store
+    slow-heartbeat@S[:SEC[:R]]
+                       suppress member R's (default 1) heartbeats for SEC
+                       seconds (default 10) — the slow-but-alive host:
+                       peers flag it ``suspect`` (hysteresis), and past
+                       the full timeout the failure detector tombstones
+                       it.  Fires only in the process that owns R
+    partition@S[:R]    asymmetric network partition of member R (default
+                       1): its outbound store mutations vanish while its
+                       reads still succeed (PartitionedStoreProxy) — the
+                       member thinks it is healthy, the gang watches it
+                       expire.  Fires only in the process that owns R
+    torn-epoch@S       tear ``epoch.json`` mid-write (truncated JSON, no
+                       atomic rename) and die — the artifact of a host
+                       dying inside a non-atomic write; survivors/
+                       supervisor self-heal from ``epochs.jsonl`` and
+                       take the checkpoint-restart rung.  Fires only
+                       where ``store_root`` is wired
     bitflip@S[:R][:leaf]
                        XOR one low mantissa bit of one param leaf on data
                        rank R (default 1) before step S — a silent HBM
@@ -56,13 +91,22 @@ import time
 __all__ = [
     "FaultInjector",
     "InjectedIOError",
+    "PartitionedStoreProxy",
     "SimulatedPreemption",
+    "HOST_KILLED_EXIT",
     "parse_chaos_spec",
 ]
 
 KINDS = (
-    "ckpt-io", "nan-grad", "slow-step", "preempt", "worker-kill", "bitflip"
+    "ckpt-io", "nan-grad", "slow-step", "preempt", "worker-kill", "bitflip",
+    "worker-join", "host-kill", "proposer-kill", "rdzv-kill",
+    "slow-heartbeat", "partition", "torn-epoch",
 )
+
+#: Exit code of a chaos host-kill in a real multi-process gang: the
+#: supervisor can tell an injected host death (absorbable via resize)
+#: apart from an organic crash.
+HOST_KILLED_EXIT = 77
 
 
 class SimulatedPreemption(RuntimeError):
@@ -117,18 +161,30 @@ def parse_chaos_spec(spec: str) -> list[_Entry]:
                     rank_s, _, _leaf = arg.partition(":")
                     if int(rank_s) < 0:
                         raise ValueError
+                elif kind == "slow-heartbeat":
+                    # SEC or SEC:R
+                    sec_s, _, rank_s = arg.partition(":")
+                    float(sec_s)
+                    if rank_s and int(rank_s) < 0:
+                        raise ValueError
                 else:
                     int(arg)
             elif kind in ("slow-step", "ckpt-io"):
                 arg = ""
-            if kind in ("nan-grad", "preempt") and arg:
+            if kind in (
+                "nan-grad", "preempt", "proposer-kill", "rdzv-kill",
+                "torn-epoch",
+            ) and arg:
                 raise ValueError
         except ValueError:
             raise ValueError(
                 f"bad chaos entry {raw!r}: expected one of "
                 "ckpt-io@N[:K] | nan-grad@S | slow-step@S[:SECONDS] | "
-                "preempt@S | worker-kill@S[:RANK] | "
-                "bitflip@S[:R][:leaf] (comma-separated)"
+                "preempt@S | worker-kill@S[:RANK] | worker-join@S[:RANK] | "
+                "bitflip@S[:R][:leaf] | host-kill@S[:RANK] | "
+                "proposer-kill@S | rdzv-kill@S | "
+                "slow-heartbeat@S[:SEC[:RANK]] | partition@S[:RANK] | "
+                "torn-epoch@S (comma-separated)"
             ) from None
         entries.append(_Entry(kind, step, arg or None))
     return entries
@@ -156,6 +212,26 @@ class FaultInjector:
         # worker-kill hook marks a member dead through it.  dpp.py wires
         # this under --elastic; without it the entry warns and no-ops.
         self.gang = None
+        # Multi-host wiring (runtime.hostgang / dpp.py):
+        #   hosts      rank-string -> member name for the members THIS
+        #              process owns; empty = owns everything (one-process
+        #              CPU-sim gang), and victims pass through unmapped
+        #   server     the TCPRendezvousServer this process hosts, if any
+        #              (rdzv-kill target)
+        #   store_root backing RendezvousStore root reachable from this
+        #              process (torn-epoch target)
+        #   abrupt_exit  host-kill dies via os._exit(HOST_KILLED_EXIT)
+        #              instead of raising (a real host gets no unwind)
+        #   fault_log  breadcrumb JSONL (shared scratch): every fired
+        #              entry is appended so the supervisor can attribute
+        #              the triggering fault in its gang_verdict
+        self.hosts: dict[str, str] = {}
+        self.server = None
+        self.store_root: str | None = None
+        self.abrupt_exit = False
+        self.fault_log = os.environ.get("DDP_FAULT_LOG") or None
+        self.partitioned = False
+        self._suppress: dict[str, float] = {}
         self._fired_local: set[str] = set()
         # Entries this PROCESS started firing (a multi-attempt ckpt-io
         # entry keeps failing attempts here even after its cross-restart
@@ -199,37 +275,148 @@ class FaultInjector:
             with open(m, "w") as fh:
                 fh.write(str(time.time()))
 
-    def _take(self, kind: str, step: int) -> _Entry | None:
-        """The unfired entry of ``kind`` scheduled for ``step``, marked
-        fired as a side effect (None when nothing fires)."""
+    def _peek(self, kind: str, step: int) -> _Entry | None:
+        """The unfired entry of ``kind`` scheduled for ``step``, NOT yet
+        marked — the caller decides ownership (does this process host the
+        victim?) before committing with :meth:`_fire`."""
         for e in self._entries:
             if e.kind == kind and e.step == step \
                     and not self._already_fired(e.key):
-                # Mark BEFORE the fault takes effect: a preemption raise
-                # must not recur after the supervisor restarts us.
-                self._mark(e.key)
-                if self.events is not None:
-                    self.events.emit("chaos_inject", entry=e.key, step=step)
                 return e
         return None
 
+    def _fire(self, e: _Entry, step: int) -> _Entry:
+        """Commit ``e``: once-marker, event, fault breadcrumb.  Mark
+        BEFORE the fault takes effect — a preemption raise must not recur
+        after the supervisor restarts us."""
+        self._mark(e.key)
+        self._breadcrumb(e, step)
+        if self.events is not None:
+            self.events.emit("chaos_inject", entry=e.key, step=step)
+        return e
+
+    def _breadcrumb(self, e: _Entry, step: int) -> None:
+        if not self.fault_log:
+            return
+        try:
+            with open(self.fault_log, "a") as fh:
+                fh.write(
+                    '{"entry": "%s", "kind": "%s", "step": %d, "ts": %f}\n'
+                    % (e.key, e.kind, step, time.time())
+                )
+        except OSError:
+            pass  # attribution is best-effort, never a new failure
+
+    def _take(self, kind: str, step: int) -> _Entry | None:
+        """_peek + _fire in one move, for unconditional (unowned) kinds."""
+        e = self._peek(kind, step)
+        return None if e is None else self._fire(e, step)
+
+    def _owns(self, victim: str) -> bool:
+        """Does this process host ``victim``?  An empty ``hosts`` map is
+        the one-process CPU-sim gang: it owns every member."""
+        return not self.hosts or str(victim) in self.hosts
+
+    def _member(self, victim: str) -> str:
+        return self.hosts.get(str(victim), str(victim))
+
     # -- injection hooks ----------------------------------------------
+    def heartbeat_suppressed(self, member: str) -> bool:
+        """Is ``member``'s heartbeat currently suppressed (an active
+        slow-heartbeat injection)?  Consulted by the gang coordinator's
+        poll loop; expired suppressions self-clear."""
+        until = self._suppress.get(str(member))
+        if until is None:
+            return False
+        if time.monotonic() >= until:
+            del self._suppress[str(member)]
+            return False
+        return True
+
     def before_step(self, step: int) -> None:
         """Call at the top of each train-loop iteration with the global
-        step index.  May sleep (slow-step) or raise SimulatedPreemption."""
+        step index.  May sleep (slow-step), die (host-kill / torn-epoch),
+        or raise SimulatedPreemption."""
         e = self._take("slow-step", step)
         if e is not None:
             time.sleep(float(e.arg or 30.0))
+        e = self._peek("slow-heartbeat", step)
+        if e is not None:
+            sec_s, _, rank_s = (e.arg or "").partition(":")
+            victim = rank_s or "1"
+            if self._owns(victim):
+                self._fire(e, step)
+                self._suppress[self._member(victim)] = (
+                    time.monotonic() + float(sec_s or 10.0)
+                )
+        e = self._peek("partition", step)
+        if e is not None and self._owns(e.arg or "1"):
+            self._fire(e, step)
+            # The flag is the whole injection: the member's store driver
+            # (hostgang loop / test harness) wraps its store in a
+            # PartitionedStoreProxy when it sees this.
+            self.partitioned = True
+        e = self._peek("rdzv-kill", step)
+        if e is not None and self.server is not None:
+            self._fire(e, step)
+            srv, self.server = self.server, None
+            srv.kill()
+        e = self._peek("torn-epoch", step)
+        if e is not None and self.store_root:
+            self._fire(e, step)
+            # A non-atomic write torn by host death: truncated JSON
+            # straight into epoch.json, then the host goes down.  The
+            # store self-heals the file from epochs.jsonl; the GANG takes
+            # the checkpoint-restart rung (no tombstones -> no resize).
+            with open(os.path.join(self.store_root, "epoch.json"), "w") as fh:
+                fh.write('{"epoch": ')
+            raise SimulatedPreemption(
+                f"chaos: host died tearing epoch.json at step {step}"
+            )
+        e = self._peek("host-kill", step)
+        if e is not None and self._owns(e.arg or "1"):
+            self._fire(e, step)
+            victim = self._member(e.arg or "1")
+            if self.gang is not None:
+                self.gang.kill(victim)
+            if self.abrupt_exit:
+                os._exit(HOST_KILLED_EXIT)
+            raise SimulatedPreemption(
+                f"chaos: host owning {victim!r} died at step {step}"
+            )
+        e = self._take("proposer-kill", step)
+        if e is not None:
+            if self.gang is not None:
+                self.gang.kill_proposer()
+            else:
+                from distributeddataparallel_tpu.utils.logging import warn0
+
+                warn0(
+                    "chaos %s: no elastic gang coordinator wired "
+                    "(--elastic not set?) — proposer kill not injected",
+                    e.key,
+                )
         e = self._take("worker-kill", step)
         if e is not None:
             if self.gang is not None:
-                self.gang.kill(e.arg or "1")
+                self.gang.kill(self._member(e.arg or "1"))
             else:
                 from distributeddataparallel_tpu.utils.logging import warn0
 
                 warn0(
                     "chaos %s: no elastic gang coordinator wired "
                     "(--elastic not set?) — kill not injected", e.key,
+                )
+        e = self._take("worker-join", step)
+        if e is not None:
+            if self.gang is not None:
+                self.gang.rejoin(self._member(e.arg or "1"))
+            else:
+                from distributeddataparallel_tpu.utils.logging import warn0
+
+                warn0(
+                    "chaos %s: no elastic gang coordinator wired "
+                    "(--elastic not set?) — rejoin not injected", e.key,
                 )
         e = self._take("preempt", step)
         if e is not None:
@@ -311,3 +498,40 @@ class FaultInjector:
                     f"chaos: injected checkpoint-IO failure "
                     f"({e.key}, attempt {attempt})"
                 )
+
+
+class PartitionedStoreProxy:
+    """Asymmetric network partition around one member's rendezvous store.
+
+    Models the half-open failure a real fabric produces: the member's
+    outbound *mutations* (heartbeats, acks, joins, proposals, blob
+    writes, transitions) silently vanish — dropped packets, no error —
+    while its *reads* still succeed, so the member keeps believing it is
+    healthy right up until it watches the rest of the gang expire it.
+    Wrap the member's store/client when ``FaultInjector.partitioned``
+    goes true; duck-types the store surface, so the coordinator never
+    knows the difference.
+    """
+
+    #: ops whose outbound writes the partition swallows; everything else
+    #: (epoch/alive/dead/history/suspects/expired/get_blob/roster/acked)
+    #: delegates to the real store.
+    DROPPED_OPS = frozenset((
+        "join", "heartbeat", "leave", "mark_dead", "propose", "ack",
+        "put_blob", "barrier", "transition",
+    ))
+
+    def __init__(self, store, dropped=None):
+        self._store = store
+        self._dropped = (
+            self.DROPPED_OPS if dropped is None else frozenset(dropped)
+        )
+
+    def __getattr__(self, name):
+        if name in self._dropped:
+            def _dropped_op(*args, **kwargs):
+                return None
+
+            _dropped_op.__name__ = name
+            return _dropped_op
+        return getattr(self._store, name)

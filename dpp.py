@@ -1823,16 +1823,33 @@ def train(args) -> float:
             ElasticGangCoordinator,
         )
 
+        _hb_env = os.environ.get("DDP_HEARTBEAT_TIMEOUT")
+        _sus_env = os.environ.get("DDP_SUSPECT_AFTER")
         gang = ElasticGangCoordinator(
             elastic_store_dir(args),
             world=[f"proc{i}" for i in range(n_replicas)],
             min_size=args.min_procs,
             events=events,
+            heartbeat_timeout_s=float(_hb_env) if _hb_env else None,
+            suspect_after_s=float(_sus_env) if _sus_env else None,
         )
         gang.start()
-        # The chaos worker-kill entry tombstones a member through the
-        # coordinator; the next poll() on the survivors runs the resize.
+        # The chaos worker-kill/host-kill/proposer-kill entries tombstone
+        # members through the coordinator (and worker-join resurrects
+        # them); the next poll() on the survivors runs the resize.  The
+        # coordinator consults the injector back for slow-heartbeat
+        # suppression, and fault breadcrumbs land in the store root so
+        # the supervisor's gang_verdict can name the triggering fault.
         injector.gang = gang
+        gang.chaos = injector
+        injector.hosts = {
+            str(i): f"proc{i}" for i in range(n_replicas)
+        }
+        injector.store_root = elastic_store_dir(args)
+        if injector.fault_log is None:
+            injector.fault_log = os.path.join(
+                elastic_store_dir(args), "faults.jsonl"
+            )
 
     precompiler = None
 
@@ -2740,6 +2757,10 @@ def train(args) -> float:
                                 ),
                                 restarts=counters.restarts,
                                 sdc_detects=counters.sdc_detects,
+                                gang_suspects=(
+                                    len(gang.suspects_now)
+                                    if gang is not None else 0
+                                ),
                             )
                         log0(
                             "throughput: %.0f %s/s (%.1f %s/s/chip)",

@@ -1,0 +1,69 @@
+#!/usr/bin/env python
+"""CI gate: multi-host chaos smoke — real processes, real faults.
+
+Two supervised 3-process gangs on a TCP rendezvous store
+(``runtime.hostgang``), each with one injected fault, each required to
+end on the resize rung of the degradation ladder with the fault named
+in the supervisor's ``gang_verdict``:
+
+- ``host-kill``: one host dies abruptly; the survivors tombstone it and
+  absorb the loss in place (zero respawns).
+- ``rdzv-kill``: the rendezvous server dies; the elected smallest-name
+  survivor re-hosts the store (``rdzv_rehost``) and the intact roster
+  finishes.
+
+Must run as a file (not ``python -``): the workers are spawned
+processes, and multiprocessing re-imports ``__main__`` from its path.
+"""
+
+import json
+import os
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from distributeddataparallel_tpu.runtime.hostgang import hostgang_worker
+from distributeddataparallel_tpu.runtime.launcher import spawn
+
+
+def run(base: str, name: str, chaos: str) -> list[dict]:
+    root = os.path.join(base, name)
+    events = os.path.join(root, "events")
+    os.makedirs(events)
+    cfg = {"store_root": root, "world_size": 3, "steps": 8,
+           "step_s": 0.05, "transport": "tcp", "min_size": 1,
+           "heartbeat_timeout_s": 2.5, "suspect_after_s": 1.0}
+    spawn(hostgang_worker, args=(cfg,), nprocs=3, max_restarts=2,
+          restart_backoff_s=0.1, env={"DDP_CHAOS": chaos},
+          events_dir=events, elastic_store=os.path.join(root, "store"),
+          min_procs=1)
+    recs = []
+    for fn in sorted(os.listdir(events)):
+        if fn.endswith(".jsonl") and fn != "timeline.jsonl":
+            with open(os.path.join(events, fn)) as fh:
+                recs += [json.loads(ln) for ln in fh if ln.strip()]
+    return recs
+
+
+def main() -> None:
+    base = sys.argv[1] if len(sys.argv) > 1 else tempfile.mkdtemp(
+        prefix="chaos-smoke-"
+    )
+
+    recs = run(base, "hostkill", "host-kill@3:1")
+    v = [r for r in recs if r["kind"] == "gang_verdict"]
+    assert len(v) == 1 and v[0]["rung"] == "resize", v
+    assert v[0]["fault_kind"] == "host-kill" and v[0]["respawns"] == 0, v
+    print("host-kill: resize rung, fault attributed, 0 respawns")
+
+    recs = run(base, "rdzvkill", "rdzv-kill@3")
+    v = [r for r in recs if r["kind"] == "gang_verdict"]
+    assert len(v) == 1 and v[0]["rung"] == "resize", v
+    assert any(r["kind"] == "rdzv_rehost" for r in recs), "no re-host event"
+    print("rdzv-kill: store re-hosted, resize rung")
+
+
+if __name__ == "__main__":
+    main()

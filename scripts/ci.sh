@@ -42,12 +42,21 @@
 #                               rank, and the gang must EVICT via resize
 #                               (sdc_detect + sdc_evict + gang_resize,
 #                               no restart_attempt)
-#   8. ddp_tune --check         autotuner smoke: a real 2-trial search
+#   8. multi-host chaos smoke   3 REAL processes on a TCP rendezvous
+#                               store under the supervised launcher,
+#                               twice: a host-kill must end on the
+#                               resize rung of the degradation ladder
+#                               (gang_verdict names the fault, zero
+#                               respawns), and a rendezvous-server kill
+#                               must re-host the store on the elected
+#                               survivor (rdzv_rehost) and still finish
+#                               on the resize rung
+#   9. ddp_tune --check         autotuner smoke: a real 2-trial search
 #                               on a tiny model over an 8-fake-device
 #                               CPU mesh — asserts a winner record is
 #                               persisted and every tune_* event is
 #                               schema-valid
-#   9. tier-1 pytest            the ROADMAP verify command (CPU, not
+#  10. tier-1 pytest            the ROADMAP verify command (CPU, not
 #                               slow).  Includes the ZeRO-2/3 bitwise
 #                               dp-parity + low-bit-moment convergence
 #                               tests (tests/test_zero23.py)
@@ -136,6 +145,11 @@ print(f"integrity smoke: sdc_detect rank 1 -> evict -> 1 gang_resize, "
       f"0 restarts ({len(kinds)} records)")
 PY
 rm -rf "${INTEGRITY_SMOKE_DIR}"
+
+echo "== multi-host chaos smoke (host-kill -> resize; rdzv-kill -> re-host) =="
+HOSTGANG_SMOKE_DIR="$(mktemp -d)"
+JAX_PLATFORMS=cpu python scripts/chaos_smoke.py "${HOSTGANG_SMOKE_DIR}"
+rm -rf "${HOSTGANG_SMOKE_DIR}"
 
 echo "== ddp_tune --check =="
 python scripts/ddp_tune.py --check

@@ -127,13 +127,35 @@ def analyze(records: list[dict]) -> dict:
                 "attempt": r.get("attempt"),
                 "failed": r.get("failed"),
             })
-        elif kind in ("membership_epoch", "gang_resize", "resize_downtime"):
+        elif kind in ("membership_epoch", "gang_resize", "resize_downtime",
+                      "gang_suspect", "rdzv_rehost", "gang_verdict"):
             el = out["elasticity"]
             if el is None:
                 el = out["elasticity"] = {
                     "epochs": {}, "resizes": [], "downtimes": {},
+                    "suspects": [], "rehosts": [], "verdict": None,
                 }
-            if kind == "membership_epoch":
+            if kind == "gang_suspect":
+                el["suspects"].append({
+                    "member": r.get("member"),
+                    "age_s": r.get("age_s"),
+                    "epoch": r.get("epoch"),
+                })
+            elif kind == "rdzv_rehost":
+                el["rehosts"].append({
+                    "generation": r.get("generation"),
+                    "owner": r.get("owner"),
+                })
+            elif kind == "gang_verdict":
+                # At most one per run (the supervisor's terminal ladder
+                # record); keep the last in case a merged timeline holds
+                # several supervised sub-runs.
+                el["verdict"] = {
+                    "rung": r.get("rung"),
+                    "fault": r.get("fault"),
+                    "fault_kind": r.get("fault_kind"),
+                }
+            elif kind == "membership_epoch":
                 # Worker and supervisor may both emit an epoch record;
                 # keyed by epoch so duplicates collapse (last wins).
                 el["epochs"][r.get("epoch")] = {
@@ -142,13 +164,18 @@ def analyze(records: list[dict]) -> dict:
                     "roster": r.get("roster") or [],
                 }
             elif kind == "gang_resize":
-                el["resizes"].append({
-                    "epoch": r.get("epoch"),
-                    "old_size": r.get("old_size"),
-                    "new_size": r.get("new_size"),
-                    "left": r.get("left") or [],
-                    "joined": r.get("joined") or [],
-                })
+                # Every survivor (and the supervisor) reports the same
+                # transition; collapse duplicates of one epoch.
+                if not any(
+                    z["epoch"] == r.get("epoch") for z in el["resizes"]
+                ):
+                    el["resizes"].append({
+                        "epoch": r.get("epoch"),
+                        "old_size": r.get("old_size"),
+                        "new_size": r.get("new_size"),
+                        "left": r.get("left") or [],
+                        "joined": r.get("joined") or [],
+                    })
             else:
                 if isinstance(r.get("seconds"), (int, float)):
                     ep = r.get("epoch")
@@ -523,6 +550,38 @@ def render_markdown(a: dict, events_dir: str) -> str:
                     f"{', '.join(rz['joined']) or '—'} | "
                     f"{'-' if d is None else f'{d:.2f}s'} |"
                 )
+        if el.get("suspects"):
+            # Several survivors may flag the same member; collapse to
+            # one line per suspect with the worst observed age.
+            worst: dict = {}
+            for s in el["suspects"]:
+                m = s.get("member")
+                if m not in worst or (s.get("age_s") or 0) > (
+                    worst[m].get("age_s") or 0
+                ):
+                    worst[m] = s
+            lines += [""] + [
+                f"- suspect `{m}` (heartbeat age "
+                f"{worst[m].get('age_s'):.2f}s, epoch "
+                f"{worst[m].get('epoch')}) — hysteresis window, not yet "
+                "tombstoned"
+                for m in sorted(worst)
+            ]
+        if el.get("rehosts"):
+            lines += [""] + [
+                f"- rendezvous store re-hosted at generation "
+                f"{rh['generation']} on `{rh['owner']}`"
+                for rh in el["rehosts"]
+            ]
+        if el.get("verdict"):
+            v = el["verdict"]
+            fault = v["fault"] or "no injected fault"
+            lines += [
+                "",
+                f"**Verdict: `{v['rung']}` rung** "
+                f"(degradation ladder: resize -> checkpoint restart -> "
+                f"loud fail), attributed to {fault}.",
+            ]
         if el["restart_reclaimed_s"] is not None:
             lines += [
                 "",

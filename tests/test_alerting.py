@@ -153,7 +153,7 @@ def test_parse_alert_spec_defaults_and_overrides():
     rules = {r.name: r for r in parse_alert_spec("")}
     assert set(rules) == {"step_spike", "mfu_floor", "goodput_floor",
                           "restart_storm", "loader_starved", "mem_growth",
-                          "sdc_storm"}
+                          "sdc_storm", "gang_suspect"}
     rules = {r.name: r for r in parse_alert_spec(
         "mfu_floor=0.3, step_spike=2.5, restart_storm=5"
     )}

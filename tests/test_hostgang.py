@@ -1,0 +1,397 @@
+"""Multi-host elastic runtime: fault-matrix chaos through real OS
+processes, rendezvous hardening, and the degradation ladder.
+
+Every test here runs gang members as SEPARATE interpreters (the
+``runtime.hostgang`` driver) against a TCP rendezvous store, supervised
+by ``launcher.spawn`` — the topology a real fleet runs, not the
+single-process CPU simulation the rest of the suite uses.  The matrix
+tests assert the one invariant the ladder promises: every injected
+fault ends in exactly one rung — resize, checkpoint restart, or loud
+fail — named by a supervisor ``gang_verdict`` event that attributes the
+triggering fault.
+"""
+
+import json
+import multiprocessing as mp
+import os
+import threading
+import time
+
+import pytest
+
+from distributeddataparallel_tpu.runtime.hostgang import (
+    EVICTED_EXIT,
+    hostgang_worker,
+    step_state,
+)
+from distributeddataparallel_tpu.runtime.launcher import spawn
+from distributeddataparallel_tpu.runtime.rendezvous import (
+    AddressBook,
+    RendezvousStore,
+    RetryPolicy,
+    TCPRendezvousClient,
+    TCPRendezvousServer,
+    rehost_store,
+    retry_call,
+)
+from distributeddataparallel_tpu.utils.chaos import HOST_KILLED_EXIT
+
+pytestmark = pytest.mark.skipif(
+    os.environ.get("DDP_SKIP_MULTIPROC") == "1",
+    reason="multi-process gang tests disabled",
+)
+
+
+# ---------------------------------------------------------------------
+# rendezvous hardening units (satellite: retry / re-host / self-heal)
+# ---------------------------------------------------------------------
+
+
+def test_retry_policy_backoff_and_jitter_bounds():
+    p = RetryPolicy(attempts=5, base_s=0.1, max_s=0.8, jitter=0.25)
+    delays = list(p.delays())
+    assert len(delays) == 4  # attempts - 1 sleeps between attempts
+    # Exponential envelope, capped, never negative, jitter-bounded.
+    for i, d in enumerate(delays):
+        nominal = min(0.1 * (2 ** i), 0.8)
+        assert nominal * 0.75 - 1e-9 <= d <= nominal * 1.25 + 1e-9
+
+
+def test_retry_call_recovers_after_transient_refusals():
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise ConnectionRefusedError("not up yet")
+        return "ok"
+
+    out = retry_call(
+        flaky, policy=RetryPolicy(attempts=5, base_s=0.01, max_s=0.02)
+    )
+    assert out == "ok" and calls["n"] == 3
+
+
+def test_address_book_generation_fence(tmp_path):
+    book = AddressBook(str(tmp_path / "book.json"))
+    assert book.lookup() is None
+    assert book.publish("127.0.0.1:1000", 1)
+    assert book.publish("127.0.0.1:2000", 2)
+    # A stale (pre-re-host) server may try to re-publish: fenced.
+    assert not book.publish("127.0.0.1:1000", 1)
+    assert book.lookup() == ("127.0.0.1:2000", 2)
+
+
+def test_store_self_heals_torn_epoch_json(tmp_path):
+    store = RendezvousStore(str(tmp_path))
+    for m in ("a", "b"):
+        store.join(m)
+    store.propose(["a", "b"], epoch=0)
+    # Tear epoch.json the way a host dying mid-write does.
+    with open(os.path.join(str(tmp_path), "epoch.json"), "w") as fh:
+        fh.write('{"epoch": ')
+    rec = store.epoch()  # must re-promote the last valid log record
+    assert rec["epoch"] == 0 and rec["roster"] == ["a", "b"]
+
+
+def test_tcp_client_survives_server_kill_and_rehost_mid_barrier(tmp_path):
+    """The satellite's named scenario: a client blocked in ``barrier()``
+    while the server is killed and re-hosted must complete the barrier
+    against the new server via address-book re-resolution — no error
+    reaches the membership protocol."""
+    book = AddressBook(str(tmp_path / "book.json"))
+    store = RendezvousStore(str(tmp_path / "s0"))
+    for m in ("a", "b"):
+        store.join(m)
+    store.propose(["a", "b"], epoch=0)
+    srv = TCPRendezvousServer(store, generation=0, address_book=book)
+
+    cli = TCPRendezvousClient(
+        address_book=book,
+        retry=RetryPolicy(attempts=8, base_s=0.05, max_s=0.4),
+    )
+    cli.epoch()  # warm the epoch cache (re-host replay material)
+    done = {}
+
+    def in_barrier():
+        done["rec"] = cli.barrier(1, "a", ["a", "b"], timeout_s=20.0)
+
+    t = threading.Thread(target=in_barrier)
+    t.start()
+    time.sleep(0.2)  # let the barrier RPC get in flight
+    srv.kill()
+    new_srv = rehost_store(
+        str(tmp_path / "s1"),
+        cli.cached_history(),
+        generation=1,
+        members=["a", "b"],
+        address_book=book,
+    )
+    try:
+        # The other participant acks on the NEW server; the blocked
+        # client's retry must land there too.
+        with TCPRendezvousClient(address_book=book) as other:
+            other.ack(1, "b")
+            other.ack(1, "a")
+        t.join(timeout=20.0)
+        assert not t.is_alive(), "barrier never completed after re-host"
+        assert done["rec"] is True
+        assert cli.generation_seen == 1
+    finally:
+        new_srv.close()
+        cli.close()
+
+
+# ---------------------------------------------------------------------
+# fault matrix -> degradation ladder (one test per cell)
+# ---------------------------------------------------------------------
+
+
+def _run_gang(tmp_path, chaos, *, world=3, steps=8, step_s=0.05,
+              max_restarts=2, min_procs=1, expect_raise=False):
+    """One supervised hostgang run; returns (events, verdicts, error)."""
+    root = str(tmp_path / "gang")
+    events_dir = os.path.join(root, "events")
+    os.makedirs(events_dir)
+    cfg = {
+        "store_root": root,
+        "world_size": world,
+        "steps": steps,
+        "step_s": step_s,
+        "transport": "tcp",
+        "min_size": min_procs,
+        "heartbeat_timeout_s": 2.5,
+        "suspect_after_s": 1.0,
+    }
+    env = {"DDP_CHAOS": chaos, "JAX_PLATFORMS": "cpu"}
+    err = None
+    try:
+        spawn(
+            hostgang_worker, args=(cfg,), nprocs=world,
+            max_restarts=max_restarts, restart_backoff_s=0.1,
+            env=env, events_dir=events_dir,
+            elastic_store=os.path.join(root, "store"),
+            min_procs=min_procs,
+        )
+    except RuntimeError as exc:
+        if not expect_raise:
+            raise
+        err = exc
+    recs = []
+    for fn in sorted(os.listdir(events_dir)):
+        if not fn.endswith(".jsonl") or fn == "timeline.jsonl":
+            continue
+        with open(os.path.join(events_dir, fn)) as fh:
+            for line in fh:
+                if line.strip():
+                    recs.append(json.loads(line))
+    verdicts = [r for r in recs if r.get("kind") == "gang_verdict"]
+    return recs, verdicts, err
+
+
+def _assert_single_verdict(verdicts, rung, fault_kind):
+    assert len(verdicts) == 1, verdicts
+    v = verdicts[0]
+    assert v["rung"] == rung, v
+    assert v["fault_kind"] == fault_kind, v
+    assert v["fault"] and v["fault"].startswith(fault_kind), v
+    assert v["proc"] == "supervisor", v
+    return v
+
+
+def test_matrix_host_kill_resize(tmp_path):
+    """host-kill: the victim dies abruptly (os._exit, no unwind);
+    survivors tombstone it and absorb the loss in place — resize rung,
+    zero respawns, the dead rank's HOST_KILLED_EXIT in the verdict."""
+    recs, verdicts, _ = _run_gang(tmp_path, "host-kill@3:1")
+    v = _assert_single_verdict(verdicts, "resize", "host-kill")
+    assert v["failed"] == [[1, HOST_KILLED_EXIT]]
+    assert v["respawns"] == 0
+    resizes = [r for r in recs if r.get("kind") == "gang_resize"]
+    assert resizes and all("host1" in r["left"] for r in resizes)
+
+
+def test_matrix_proposer_kill_resize(tmp_path):
+    """proposer-kill: tombstones the would-be proposer (smallest live
+    member); the promoted second-smallest must complete the transition
+    the kill forced — resize rung, victim exits EVICTED_EXIT."""
+    recs, verdicts, _ = _run_gang(tmp_path, "proposer-kill@3")
+    v = _assert_single_verdict(verdicts, "resize", "proposer-kill")
+    assert v["failed"] == [[0, EVICTED_EXIT]]
+    epochs = [r for r in recs if r.get("kind") == "membership_epoch"]
+    final = max(epochs, key=lambda r: r["epoch"])
+    assert "host0" not in final["roster"]
+
+
+def test_matrix_rdzv_kill_rehost_resize(tmp_path):
+    """rdzv-kill: the TCP store dies mid-run; the deterministic
+    smallest-name survivor re-hosts it at a higher generation and the
+    run finishes with the roster intact — resize rung (nothing
+    respawned, nothing restarted), with the re-host on the timeline."""
+    recs, verdicts, _ = _run_gang(tmp_path, "rdzv-kill@3")
+    _assert_single_verdict(verdicts, "resize", "rdzv-kill")
+    rehosts = [r for r in recs if r.get("kind") == "rdzv_rehost"]
+    assert rehosts and rehosts[0]["owner"] == "host0"
+    assert rehosts[0]["generation"] >= 1
+    assert not any(r.get("kind") == "restart_attempt" for r in recs)
+
+
+def test_matrix_slow_heartbeat_suspect_then_resize(tmp_path):
+    """slow-heartbeat: the victim's beat is suppressed past the full
+    timeout.  The hysteresis window must fire FIRST (gang_suspect —
+    straggler alarm, not yet tombstoned), then the failure detector
+    promotes the expiry to a tombstone — resize rung."""
+    recs, verdicts, _ = _run_gang(
+        tmp_path, "slow-heartbeat@3:10.0:1", steps=40, step_s=0.15,
+    )
+    v = _assert_single_verdict(verdicts, "resize", "slow-heartbeat")
+    assert v["failed"] == [[1, EVICTED_EXIT]]
+    sus = [r for r in recs if r.get("kind") == "gang_suspect"]
+    assert sus and {r["member"] for r in sus} == {"host1"}
+    t_suspect = min(r["ts"] for r in sus)
+    t_evict = max(
+        r["ts"] for r in recs if r.get("kind") == "membership_epoch"
+    )
+    assert t_suspect <= t_evict, "suspect must precede the tombstone"
+
+
+def test_matrix_partition_resize(tmp_path):
+    """partition (asymmetric): the victim's writes are dropped while its
+    reads still work — peers expire its heartbeat and shed it; the
+    victim discovers its own eviction from the surviving side's epoch
+    and exits EVICTED_EXIT — resize rung."""
+    # Long enough for the victim's last write to age past the full
+    # heartbeat timeout (2.5s) while peers keep stepping.
+    recs, verdicts, _ = _run_gang(
+        tmp_path, "partition@3:1", steps=40, step_s=0.15,
+    )
+    _assert_single_verdict(verdicts, "resize", "partition")
+    epochs = [r for r in recs if r.get("kind") == "membership_epoch"]
+    final = max(epochs, key=lambda r: r["epoch"])
+    assert "host1" not in final["roster"]
+
+
+def test_matrix_torn_epoch_restart(tmp_path):
+    """torn-epoch: a host dies mid-``epoch.json`` write.  With the whole
+    (single-member) gang gone there are no survivors to resize around:
+    the supervisor restarts from the top — checkpoint-restart rung,
+    budget consumed, fault named."""
+    recs, verdicts, _ = _run_gang(tmp_path, "torn-epoch@3", world=1)
+    v = _assert_single_verdict(verdicts, "restart", "torn-epoch")
+    assert v["attempts"] == 1
+    assert any(r.get("kind") == "restart_attempt" for r in recs)
+    assert not any(r.get("kind") == "gang_resize" for r in recs)
+
+
+def test_matrix_loud_fail_rung(tmp_path):
+    """The ladder's last rung: resize impossible (single member — no
+    survivors to absorb into) and the fault recurs past the restart
+    budget — the supervisor must fail LOUDLY with a fail-rung verdict
+    naming the fault, and raise."""
+    recs, verdicts, err = _run_gang(
+        tmp_path, "host-kill@3:0,host-kill@5:0", world=1, max_restarts=1,
+        expect_raise=True,
+    )
+    assert err is not None and "restart budget" in str(err)
+    v = _assert_single_verdict(verdicts, "fail", "host-kill")
+    assert v["max_restarts"] == 1
+    (rank, code), = v["failed"]
+    assert rank == 0 and code == HOST_KILLED_EXIT
+    # The first death consumed the one restart before the budget died.
+    assert any(r.get("kind") == "restart_attempt" for r in recs)
+
+
+# ---------------------------------------------------------------------
+# shrink AND grow with bitwise live-state parity vs checkpoint restore
+# ---------------------------------------------------------------------
+
+
+def _reference_acc(steps: int) -> float:
+    """Checkpoint-restore replay: what a member restoring from step 0
+    and replaying every step computes — the parity baseline."""
+    acc = 0.0
+    for step in range(steps):
+        acc = step_state(acc, step)
+    return acc
+
+
+def _done_states(store_root: str) -> dict:
+    store = RendezvousStore(store_root)
+    out = {}
+    for name in ("host0", "host1", "host2", "host3"):
+        blob = store.get_blob(f"done:{name}")
+        if blob:
+            out[name] = json.loads(blob)
+    return out
+
+
+def test_multihost_shrink_bitwise_parity(tmp_path):
+    """Shrink: a 3-process TCP gang loses one host mid-run and absorbs
+    it in place.  The survivors' live state must be BITWISE equal to
+    the checkpoint-restore replay — the resize path corrupted nothing
+    and skipped nothing."""
+    steps = 8
+    recs, verdicts, _ = _run_gang(tmp_path, "host-kill@3:1", steps=steps)
+    _assert_single_verdict(verdicts, "resize", "host-kill")
+    states = _done_states(str(tmp_path / "gang" / "store"))
+    assert set(states) == {"host0", "host2"}  # host1 died, no done blob
+    ref = _reference_acc(steps)
+    for name, st in states.items():
+        assert st["step"] == steps
+        assert st["acc"] == ref, (name, st["acc"].hex(), ref.hex())
+
+
+def test_multihost_grow_bitwise_parity(tmp_path):
+    """Grow (ROADMAP 3c): a 4th process joins an established 3-process
+    gang mid-run, catches up from the survivors' PUBLISHED live state
+    (the blob board, not a checkpoint file), and finishes in lockstep:
+    its final state is bitwise-identical to both the incumbents' and
+    the checkpoint-restore replay."""
+    root = str(tmp_path / "gang")
+    os.makedirs(root)
+    steps = 16
+    cfg = {
+        "store_root": root,
+        "world_size": 3,
+        "steps": steps,
+        "step_s": 0.1,
+        "transport": "tcp",
+        "min_size": 1,
+        "heartbeat_timeout_s": 2.5,
+        "suspect_after_s": 1.0,
+    }
+    os.environ.pop("DDP_CHAOS", None)
+    ctx = mp.get_context("spawn")
+    procs = [
+        ctx.Process(target=hostgang_worker, args=(i, cfg))
+        for i in range(3)
+    ]
+    for p in procs:
+        p.start()
+    # Let the gang establish an epoch and make progress, then grow.
+    store_root = os.path.join(root, "store")
+    deadline = time.monotonic() + 30.0
+    while time.monotonic() < deadline:
+        try:
+            blob = RendezvousStore(store_root).get_blob("state")
+            if blob and json.loads(blob).get("step", 0) >= 3:
+                break
+        except OSError:
+            pass
+        time.sleep(0.05)
+    late_cfg = dict(cfg, world_size=4)
+    joiner = ctx.Process(target=hostgang_worker, args=(3, late_cfg))
+    joiner.start()
+    for p in procs + [joiner]:
+        p.join(timeout=90.0)
+    assert [p.exitcode for p in procs + [joiner]] == [0, 0, 0, 0]
+
+    states = _done_states(store_root)
+    assert set(states) == {"host0", "host1", "host2", "host3"}
+    ref = _reference_acc(steps)
+    for name, st in states.items():
+        assert st["acc"] == ref, (name, st["acc"], ref)
+    # The joiner really did catch up (adopted a step > 0), and the gang
+    # agreed on a grown epoch containing it.
+    hist = RendezvousStore(store_root).history()
+    assert any("host3" in rec["roster"] for rec in hist)

@@ -112,8 +112,12 @@ def test_zero_with_grad_accumulation(devices):
     )
     step = ddp.make_train_step(loss_fn, mesh=mesh, zero=True, accum_steps=2)
     losses = []
-    for b in batches:
-        state, metrics = step(state, b, jax.random.PRNGKey(0))
+    # Repeatedly fit ONE batch: with 5 distinct noise batches the
+    # per-batch loss is not monotonic (nothing generalizes from noise),
+    # so descending on a fixed batch is the property that actually
+    # tests the accumulated-ZeRO step optimizes.
+    for _ in batches:
+        state, metrics = step(state, batches[0], jax.random.PRNGKey(0))
         losses.append(float(metrics["loss"]))
     assert losses[-1] < losses[0]
 
