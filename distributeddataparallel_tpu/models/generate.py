@@ -179,10 +179,11 @@ def _step_fns(dm, quantized: bool):
         return logits, upd["cache"]
 
     def decode_fn(params, cache, token, pos):
-        """One decode step: ``token`` (B, 1); ``pos`` is either a shared
-        (1,) global position or a per-row (B, 1) position vector
-        (continuous batching — every slot at its own length).  Returns
-        ((B, 1, V) logits, updated cache)."""
+        """One decode step: ``token`` (B, S); ``pos`` is either a shared
+        (S,) global position or a per-row (B, S) position matrix
+        (continuous batching — every slot at its own length; S > 1 is a
+        speculative-verify window of contiguous per-row positions).
+        Returns ((B, S, V) logits, updated cache)."""
         logits, upd = dm.apply(
             {"params": _live_params(cfg, params, quantized),
              "cache": cache},

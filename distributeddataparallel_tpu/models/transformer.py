@@ -304,28 +304,30 @@ class Attention(nn.Module):
                 (B, cfg.max_seq_len, Hkvl, D), v.dtype,
             )
             if positions.ndim == 2:
-                # Per-row positions (B, 1): a continuous-batching decode
-                # step where every slot sits at its own length (serving
-                # engine).  Insert row-wise and mask row-wise; rows past
-                # a slot's position hold stale/garbage values, which the
+                # Per-row positions (B, S): continuous-batching decode
+                # where every slot sits at its own length (serving
+                # engine).  S == 1 is the classic one-token step; S > 1
+                # is a speculative-verify window — each row inserts S
+                # tokens at ITS OWN contiguous positions and row i
+                # attends causally through position[b, i].  Rows past a
+                # slot's position hold stale/garbage values, which the
                 # finite NEG_INF bias zeroes exactly in the softmax.
-                if S != 1:
+                if positions.shape != (B, S):
                     raise ValueError(
-                        "per-row positions decode a single token per "
-                        f"row, got seq len {S}"
+                        f"per-row positions must be ({B}, {S}), got "
+                        f"{positions.shape}"
                     )
-                row = jnp.arange(B)
-                pos_b = positions[:, 0]  # (B,)
-                ck.value = ck.value.at[row, pos_b].set(k[:, 0])
-                cv.value = cv.value.at[row, pos_b].set(v[:, 0])
+                row = jnp.arange(B)[:, None]  # (B, 1) broadcast index
+                ck.value = ck.value.at[row, positions].set(k)
+                cv.value = cv.value.at[row, positions].set(v)
                 kf = repeat_kv(ck.value, Hl // Hkvl)
                 vf = repeat_kv(cv.value, Hl // Hkvl)
                 kv_pos = jnp.arange(cfg.max_seq_len)
                 bias = jnp.where(
                     kv_pos[None, None, None, :]
-                    <= pos_b[:, None, None, None],
+                    <= positions[:, None, :, None],
                     0.0, NEG_INF,
-                ).astype(jnp.float32)  # (B, 1, 1, max_seq_len)
+                ).astype(jnp.float32)  # (B, 1, S, max_seq_len)
                 out = dot_product_attention(
                     q, kf, vf, causal=False, bias=bias
                 )

@@ -87,6 +87,12 @@ EVENT_KINDS: dict[str, tuple[str, ...]] = {
     "decode_step": ("step", "n_active"),
     "request_done": ("req", "ttft_s", "tokens"),
     "kv_evict": ("blocks",),
+    # Serving fast path: an admission that mapped `tokens` cached
+    # context tokens from the radix prefix cache (skipping their
+    # prefill), and one speculative-verify dispatch (`drafted` tokens
+    # proposed across the slot batch, `accepted` emitted).
+    "prefix_hit": ("req", "tokens"),
+    "spec_verify": ("step", "drafted", "accepted"),
     # Autotuner (tuning/): one record per candidate config (status =
     # pruned-memory / pruned-cost / baseline / measured / error: ...)
     # and one per search or apply outcome (winner = trial label or None).

@@ -38,6 +38,10 @@ _COUNTER_KINDS = {
     "memory": ("memory_bytes", "live_bytes"),
     # Serving: active decode slots over time — occupancy at a glance.
     "decode_step": ("active_slots", "n_active"),
+    # Speculative decoding: accepted tokens per verify dispatch — the
+    # accept-length track dropping toward n_active means drafts stopped
+    # landing.
+    "spec_verify": ("spec_accepted", "accepted"),
 }
 
 #: kinds rendered as instant events (fields worth carrying into args)
@@ -55,6 +59,7 @@ _INSTANT_KINDS = {
     "prefill_chunk": ("req", "start", "len"),
     "request_done": ("req", "ttft_s", "tokens", "latency_s"),
     "kv_evict": ("blocks", "req", "reason"),
+    "prefix_hit": ("req", "tokens", "ctx"),
 }
 
 SUPERVISOR_PID = 0

@@ -5,20 +5,27 @@ trainer; this package opens the inference half of the north star
 ("serve heavy traffic"): a vLLM-style block/paged KV cache over the
 TransformerLM decode twin (``kv_cache``), a host-side continuous-
 batching scheduler with chunked prefill (``scheduler``), the engine
-that compiles exactly two device programs — one decode step over the
-fixed slot batch, one prefill chunk — and drives them per scheduler
-step (``engine``), and a seeded Poisson open-loop load generator
-(``loadgen``).  ``scripts/ddp_serve.py`` is the CLI.
+that compiles at most three device programs — one decode step over the
+fixed slot batch, one prefill chunk, one speculative verify window —
+and drives them per scheduler step (``engine``), and a seeded Poisson
+open-loop load generator with an optional Zipf shared-prefix trace mode
+(``loadgen``).  The serving fast path layers a refcounted radix prefix
+cache (shared KV blocks, copy-on-write) and n-gram speculative decoding
+on top, both bitwise-pinned against the plain paths.
+``scripts/ddp_serve.py`` is the CLI.
 """
 
 from distributeddataparallel_tpu.serving.kv_cache import (  # noqa: F401
     SCRATCH_BLOCK,
     BlockAllocator,
+    block_hash,
+    copy_pool_block,
     gather_block_cache,
     kv_pool_bytes,
     make_pool,
     scatter_decode,
     scatter_prefill,
+    scatter_spec,
 )
 from distributeddataparallel_tpu.serving.scheduler import (  # noqa: F401
     Request,

@@ -1,6 +1,6 @@
 """Continuous-batching inference engine over the paged KV pool.
 
-Exactly TWO device programs serve any traffic mix, each compiled once
+At most THREE device programs serve any traffic mix, each compiled once
 per (model, engine-shape) configuration and persisted through the
 warm-start ``ExecutableStore``:
 
@@ -17,12 +17,27 @@ warm-start ``ExecutableStore``:
   scatter through the request's table with padding rows routed to
   scratch, and the chunk's last real row's argmax (only the final
   chunk's is consumed — it is the request's first generated token).
+- the **verify program** (``spec_k > 0``) replaces the decode program
+  with a fixed ``(num_slots, spec_k + 1)`` window: every slot applies
+  its pending token plus ``spec_k`` self-drafted tokens at its own
+  contiguous positions, and the host accepts the longest draft prefix
+  whose greedy verdicts agree — up to ``spec_k + 1`` tokens per
+  dispatch at one host sync, bitwise identical to stepping the decode
+  program token by token.  Pool donated, same as decode.
 
 Static shapes fall out of the slot/bucket discipline: tokens per decode
-step is always ``(num_slots, 1)``, a prefill chunk is always
+step is always ``(num_slots, spec_k + 1)``, a prefill chunk is always
 ``(1, prefill_chunk)``, block tables are always
 ``(·, max_seq_len // block_size)`` — so the program space is exactly
-{decode} x {prefill_chunk} and nothing retraces at traffic time.
+{decode | verify} x {prefill_chunk} and nothing retraces at traffic
+time.
+
+The prefix cache rides on the same programs: admission maps cached
+blocks into the new request's table (``request_admit`` is followed by a
+``prefix_hit`` event), the skipped tokens simply never get prefill
+chunks, and copy-on-write copies (one jitted block copy, pool donated)
+run before the step's programs whenever a write window touches a shared
+or published block.
 
 The host loop is the scheduler's :class:`StepPlan` executed verbatim,
 emitting the serving lifecycle through the versioned event schema
@@ -50,10 +65,12 @@ import numpy as np
 from distributeddataparallel_tpu.serving.kv_cache import (
     SCRATCH_BLOCK,
     BlockAllocator,
+    copy_pool_block,
     gather_block_cache,
     make_pool,
     scatter_decode,
     scatter_prefill,
+    scatter_spec,
 )
 from distributeddataparallel_tpu.serving.scheduler import (
     Request,
@@ -75,6 +92,15 @@ class EngineConfig:
     quantized_kv: bool = False
     quantize_weights: bool = False
     store_dir: str | None = None  # ExecutableStore root (warm start)
+    # Serving fast path: radix prefix caching (share KV blocks across
+    # requests with a common prompt prefix) and speculative decoding
+    # (spec_k > 0: an n-gram self-draft proposer suggests spec_k tokens
+    # per step, one (num_slots, spec_k + 1) verify dispatch accepts the
+    # longest matching prefix — greedy output stays bitwise identical
+    # to the one-token decode path).
+    prefix_cache: bool = False
+    spec_k: int = 0
+    spec_ngram: int = 3
 
 
 class InferenceEngine:
@@ -147,6 +173,11 @@ class InferenceEngine:
         self.allocator = BlockAllocator(
             config.num_blocks, config.block_size
         )
+        if not 0 <= config.spec_k <= cfg.max_seq_len - 1:
+            raise ValueError(
+                f"spec_k ({config.spec_k}) must be in "
+                f"[0, max_seq_len - 1]"
+            )
         self.scheduler = Scheduler(
             self.allocator,
             num_slots=config.num_slots,
@@ -155,7 +186,18 @@ class InferenceEngine:
             max_prefill_chunks_per_step=(
                 config.max_prefill_chunks_per_step
             ),
+            prefix_cache=config.prefix_cache,
+            lookahead=config.spec_k,
         )
+        # Fast-path counters (loadgen's summary + bench read these).
+        self.prefix_admits = 0
+        self.prefix_hits = 0
+        self.prefix_hit_tokens = 0
+        self.prefix_ctx_tokens = 0
+        self.cow_copies = 0
+        self.spec_rows = 0        # verified (slot, step) rows
+        self.spec_drafted = 0     # drafted tokens across rows
+        self.spec_accepted = 0    # tokens emitted by verify rows
 
         bs = config.block_size
         chunk = config.prefill_chunk
@@ -186,10 +228,42 @@ class InferenceEngine:
             ].astype(jnp.float32)
             return pool, jnp.argmax(last).astype(jnp.int32)
 
+        k = config.spec_k
+        max_seq = cfg.max_seq_len
+
+        def verify_program(params, pool, tables, toks, pos0):
+            # toks (B, k+1): [pending, draft_1..draft_k] per row; row i
+            # applies at global position pos0 + i (clamped at the last
+            # position — overhanging rows write scratch and are never
+            # read: acceptance is capped by the remaining token budget,
+            # which keeps every consumed row strictly inside the
+            # sequence).  Greedy next-token ids for ALL rows come back
+            # in one host sync; the host keeps the longest draft prefix
+            # the model itself would have produced.
+            dense = gather_block_cache(pool, tables, dtype=cfg.dtype)
+            positions = jnp.minimum(
+                pos0[:, None] + jnp.arange(k + 1)[None, :], max_seq - 1
+            )
+            logits, dense = decode_fn(params, dense, toks, positions)
+            pool = scatter_spec(
+                pool, dense, tables, pos0,
+                width=k + 1, max_seq_len=max_seq, block_size=bs,
+            )
+            g = jnp.argmax(
+                logits.astype(jnp.float32), axis=-1
+            ).astype(jnp.int32)  # (B, k+1)
+            return pool, g
+
         self._decode_prog = jax.jit(decode_program, donate_argnums=(1,))
         self._prefill_prog = jax.jit(
             prefill_program, donate_argnums=(1,)
         )
+        self._verify_prog = (
+            jax.jit(verify_program, donate_argnums=(1,))
+            if k > 0 else None
+        )
+        # Copy-on-write: one-block pool copy, pool donated (in-place).
+        self._copy_prog = jax.jit(copy_pool_block, donate_argnums=(0,))
         if config.store_dir:
             self._wire_warm_start(model)
 
@@ -244,6 +318,19 @@ class InferenceEngine:
             "decode": dict(decode.report),
             "prefill": dict(prefill.report),
         }
+        if self._verify_prog is not None:
+            vtoks = jnp.zeros((c.num_slots, c.spec_k + 1), jnp.int32)
+            vg = jnp.zeros((c.num_slots, c.spec_k + 1), jnp.int32)
+            verify = warm_program(
+                self._verify_prog, store=store,
+                key={**base, "program": "verify"}, name="serve_verify",
+            )
+            verify.resolve(
+                (self.params, self.pool, tables, vtoks, pos),
+                (self.pool, vg),
+            )
+            self._verify_prog = verify
+            self.warm_report["verify"] = dict(verify.report)
 
     # -- intake -------------------------------------------------------
     def submit(
@@ -309,6 +396,41 @@ class InferenceEngine:
                     / (len(req.generated) - 1)
                 )
 
+    # -- speculative drafts -------------------------------------------
+    def _ngram_next(self, ctx: np.ndarray, length: int) -> int:
+        """Continuation after the most recent earlier occurrence of the
+        longest matchable suffix (``spec_ngram`` down to 1 tokens) of
+        ``ctx[:length]``; falls back to repeating the last token.
+        Vectorized host arithmetic (the proposer runs per slot per
+        step, so a Python token-by-token scan would eat the verify
+        program's win) and deterministic under the loadgen's
+        virtual-clock replay."""
+        for n in range(min(self.config.spec_ngram, length - 1), 0, -1):
+            pat = ctx[length - n:length]
+            # Candidate starts 0..length-n-1: windows strictly before
+            # the suffix itself, each with a continuation token.
+            eq = np.ones(length - n, dtype=bool)
+            for j in range(n):
+                eq &= ctx[j:length - n + j] == pat[j]
+            idx = np.nonzero(eq)[0]
+            if idx.size:
+                return int(ctx[int(idx[-1]) + n])
+        return int(ctx[length - 1])
+
+    def _propose_drafts(self, req: Request) -> list[int]:
+        """``spec_k`` self-drafted tokens continuing prompt+generated."""
+        k = self.config.spec_k
+        n_ctx = req.prompt_len + len(req.generated)
+        ctx = np.empty(n_ctx + k, dtype=np.int64)
+        ctx[:req.prompt_len] = req.prompt
+        ctx[req.prompt_len:n_ctx] = req.generated
+        out: list[int] = []
+        for i in range(k):
+            nxt = self._ngram_next(ctx, n_ctx + i)
+            ctx[n_ctx + i] = nxt
+            out.append(nxt)
+        return out
+
     # -- the step -----------------------------------------------------
     def step(self) -> dict:
         """Execute one scheduler plan; returns host-side step stats."""
@@ -320,15 +442,36 @@ class InferenceEngine:
                 "kv_evict", blocks=released, req=req.rid,
                 reason="preempt",
             )
+        # Copy-on-write FIRST: the tables already point at the private
+        # copies, so the pool rows must exist before any read/write
+        # goes through them this step.
+        for req, src, dst in plan.cow:
+            self.pool = self._copy_prog(
+                self.pool, jnp.int32(src), jnp.int32(dst)
+            )
+            self.cow_copies += 1
         for req in plan.admitted:
             req.admit_s = self._time()
             self.emit(
                 "request_admit",
                 req=req.rid,
                 prompt_tokens=req.prompt_len,
+                ctx_tokens=req.ctx_len,
                 slot=req.slot,
                 queued_s=req.admit_s - req.arrival_s,
             )
+            if self.config.prefix_cache:
+                self.prefix_admits += 1
+                self.prefix_ctx_tokens += req.ctx_len
+                if req.prefix_hit_tokens > 0:
+                    self.prefix_hits += 1
+                    self.prefix_hit_tokens += req.prefix_hit_tokens
+                    self.emit(
+                        "prefix_hit",
+                        req=req.rid,
+                        tokens=req.prefix_hit_tokens,
+                        ctx=req.ctx_len,
+                    )
 
         c = self.config
         for req, start, n in plan.prefill_chunks:
@@ -346,6 +489,12 @@ class InferenceEngine:
             self.emit(
                 "prefill_chunk", req=req.rid, start=start, len=n
             )
+            if self.config.prefix_cache:
+                # Rows [0, start + n) are finalized: publish the full
+                # blocks into the prefix trie.
+                self.allocator.register_progress(
+                    req.rid, ctx, upto=start + n
+                )
             if self.scheduler.advance_prefill(req, n):
                 if not req.generated:
                     # Fresh prefill: the final chunk's last-row argmax
@@ -360,31 +509,83 @@ class InferenceEngine:
         running = dict(self.scheduler.running)
         n_active = len(running)
         if running:
+            k = c.spec_k
             tables = np.full(
                 (c.num_slots, self.blocks_per_seq),
                 SCRATCH_BLOCK, np.int32,
             )
-            toks = np.zeros((c.num_slots, 1), np.int32)
+            toks = np.zeros((c.num_slots, k + 1), np.int32)
             pos = np.zeros((c.num_slots,), np.int32)
+            drafts: dict[int, list[int]] = {}
             for slot, req in running.items():
                 tables[slot] = self.allocator.table_array(
                     req.rid, self.blocks_per_seq
                 )
                 toks[slot, 0] = req.generated[-1]
                 pos[slot] = req.next_pos
-            self.pool, nxt = self._decode_prog(
-                self.params, self.pool, jnp.asarray(tables),
-                jnp.asarray(toks), jnp.asarray(pos),
-            )
-            # One host sync per engine step (the whole slot batch's
-            # next tokens at once) — completion detection needs the
-            # values; this is the serving analog of the train loop's
-            # bounded dispatch, with depth 0.
-            nxt = np.asarray(nxt)
-            for slot, req in running.items():
-                req.generated.append(int(nxt[slot]))
-                if req.done:
-                    self._finish(req)
+                if k:
+                    d = self._propose_drafts(req)
+                    toks[slot, 1:] = d
+                    drafts[slot] = d
+            if k:
+                # Verify program: one (num_slots, k + 1) dispatch, one
+                # host sync for every row's greedy next token.
+                self.pool, g = self._verify_prog(
+                    self.params, self.pool, jnp.asarray(tables),
+                    jnp.asarray(toks), jnp.asarray(pos),
+                )
+                g = np.asarray(g)
+                drafted = accepted = 0
+                for slot, req in running.items():
+                    d = drafts[slot]
+                    a = 0
+                    while a < k and d[a] == int(g[slot, a]):
+                        a += 1
+                    # Row i's output is the model's greedy token after
+                    # position pos + i, valid through the first draft
+                    # mismatch — accept those plus the bonus token,
+                    # capped by the request's remaining budget.
+                    take = min(
+                        a + 1, req.max_new_tokens - len(req.generated)
+                    )
+                    for i in range(take):
+                        req.generated.append(int(g[slot, i]))
+                    drafted += k
+                    accepted += take
+                    self.spec_rows += 1
+                    if self.config.prefix_cache:
+                        self.allocator.register_progress(
+                            req.rid, req.ctx_tokens(), upto=req.ctx_len
+                        )
+                    if req.done:
+                        self._finish(req)
+                self.spec_drafted += drafted
+                self.spec_accepted += accepted
+                self.emit(
+                    "spec_verify",
+                    step=self._step_idx,
+                    drafted=drafted,
+                    accepted=accepted,
+                    rows=n_active,
+                )
+            else:
+                self.pool, nxt = self._decode_prog(
+                    self.params, self.pool, jnp.asarray(tables),
+                    jnp.asarray(toks), jnp.asarray(pos),
+                )
+                # One host sync per engine step (the whole slot batch's
+                # next tokens at once) — completion detection needs the
+                # values; this is the serving analog of the train
+                # loop's bounded dispatch, with depth 0.
+                nxt = np.asarray(nxt)
+                for slot, req in running.items():
+                    req.generated.append(int(nxt[slot]))
+                    if self.config.prefix_cache:
+                        self.allocator.register_progress(
+                            req.rid, req.ctx_tokens(), upto=req.ctx_len
+                        )
+                    if req.done:
+                        self._finish(req)
             self.emit(
                 "decode_step", step=self._step_idx, n_active=n_active
             )

@@ -38,8 +38,21 @@ The host side (``BlockAllocator``) does the bookkeeping: free-list
 allocation, per-sequence tables, immediate release on preemption, and
 deferred release on completion — finished sequences park their blocks
 in an LRU "evictable" list and are only reclaimed (``kv_evict``) under
-pool pressure, which keeps the eviction path exercised without a
-prefix-reuse feature riding on it yet.
+pool pressure.
+
+Prefix caching (radix trie + refcounts): full blocks whose KV is
+finalized can be *registered* into a radix trie keyed by a rolling
+content hash over the block's token ids (chunk equality is verified on
+lookup, so a hash collision can never alias two different prefixes).
+``alloc_shared`` walks the trie at admission and maps every matched
+block into the new sequence's table — multiple tables then share one
+physical block, tracked by a refcount.  A shared or registered block is
+never written in place: the scheduler plans a copy-on-write
+(``needs_cow``/``cow``) before any write lands in it, and the engine
+executes the copy with ``copy_pool_block``.  Blocks whose refcount
+drops to zero while still registered park in a *cached* LRU — matchable
+by future requests, reclaimable under pressure (cache eviction detaches
+the block's whole trie subtree so no stale edge can ever match).
 """
 
 from __future__ import annotations
@@ -251,16 +264,115 @@ def scatter_prefill(
     return jax.tree.map(one, pool, dense, is_leaf=_is_qkv)
 
 
+def scatter_spec(
+    pool: Pytree,
+    dense: Pytree,
+    tables,
+    pos0,
+    *,
+    width: int,
+    max_seq_len: int,
+    block_size: int,
+) -> Pytree:
+    """Write each slot's speculative verify window back into the pool.
+
+    ``dense`` is the cache pytree after a ``(B, width)`` per-row-window
+    apply: slot ``b``'s row ``i`` holds the KV inserted at global
+    position ``pos0[b] + i``.  Rows past ``max_seq_len - 1`` (a window
+    hanging over the end of the sequence) are routed to the scratch
+    block, mirroring ``scatter_prefill``'s padding policy.  Rows past a
+    slot's *accepted* length are written as-is: they are rejected-draft
+    garbage, but they land inside the next step's verify window (which
+    starts at the accepted frontier) and are overwritten by that apply
+    before any attention read — the same masked-garbage discipline the
+    scratch block relies on.
+    """
+    B = tables.shape[0]
+    row = jnp.arange(B)[:, None]
+    p = pos0[:, None] + jnp.arange(width)[None, :]  # (B, width) global
+    pc = jnp.minimum(p, max_seq_len - 1)
+    blk = jnp.where(
+        p < max_seq_len, tables[row, pc // block_size], SCRATCH_BLOCK
+    )
+    off = pc % block_size
+
+    def one(pl, dn):
+        if dn.ndim == 4:  # dense (B, S, H, D), pool (N, bs, H, D)
+            new = dn[row, pc]  # (B, width, H, D)
+            if _is_qkv(pl):
+                q, s = _quant_rows(new)
+                return {
+                    "q": pl["q"].at[blk, off].set(q),
+                    "scale": pl["scale"].at[blk, off].set(s),
+                }
+            return pl.at[blk, off].set(new.astype(pl.dtype))
+        # dense (L, B, S, H, D), pool (L, N, bs, H, D)
+        new = dn[:, row, pc]  # (L, B, width, H, D)
+        if _is_qkv(pl):
+            q, s = _quant_rows(new)
+            return {
+                "q": pl["q"].at[:, blk, off].set(q),
+                "scale": pl["scale"].at[:, blk, off].set(s),
+            }
+        return pl.at[:, blk, off].set(new.astype(pl.dtype))
+
+    return jax.tree.map(one, pool, dense, is_leaf=_is_qkv)
+
+
+def copy_pool_block(pool: Pytree, src, dst) -> Pytree:
+    """Copy one physical block ``src`` -> ``dst`` across every leaf —
+    the device half of copy-on-write (the allocator rewires the table,
+    this materializes the private copy)."""
+
+    def one(leaf):
+        if leaf.ndim == 4:  # (N, bs, H, D)
+            return leaf.at[dst].set(leaf[src])
+        return leaf.at[:, dst].set(leaf[:, src])  # (L, N, bs, H, D)
+
+    def q_or_plain(leaf):
+        if _is_qkv(leaf):
+            return {"q": one(leaf["q"]), "scale": one(leaf["scale"])}
+        return one(leaf)
+
+    return jax.tree.map(q_or_plain, pool, is_leaf=_is_qkv)
+
+
+#: FNV-1a 64-bit offset basis — the rolling-hash seed for the trie root.
+_ROOT_HASH = 0xCBF29CE484222325
+
+#: Registration-chain sentinel: the chain's trie node was evicted out
+#: from under the sequence, so it can never register further blocks.
+_DEAD = object()
+
+
+def block_hash(parent_hash: int, chunk) -> int:
+    """Rolling content hash of one full block of token ids, chained
+    through the parent block's hash so equal chunks at different tree
+    depths never collide structurally."""
+    h = parent_hash
+    for t in chunk:
+        h = ((h ^ (int(t) + 1)) * 0x100000001B3) & 0xFFFFFFFFFFFFFFFF
+    return h
+
+
 class BlockAllocator:
     """Host-side block accounting for one pool.
 
     Invariants (asserted by :meth:`check`):
 
     - block ``SCRATCH_BLOCK`` is never allocated;
-    - every other block is in exactly one of {free, some live table,
-      some retired table};
-    - eviction only reclaims RETIRED (finished) sequences, oldest
-      retirement first (LRU), and only under allocation pressure.
+    - every other block is in exactly one of {free, live (in >= 1
+      table), retired park, cached LRU}; free/retired/cached are
+      pairwise disjoint and disjoint from live;
+    - a block's refcount equals its multiplicity across live tables —
+      shared (prefix-cache-hit) blocks count once per holder;
+    - eviction only reclaims refcount-0 blocks: retired (finished,
+      unregistered) sequences first, then the cached LRU, oldest first,
+      and only under allocation pressure;
+    - the radix trie is consistent: every registered block is live or
+      cached (never free/retired), every edge's child points back at
+      its parent, and cached blocks are always registered (that is what
+      makes them worth keeping).
 
     All methods are plain host work — the allocator never touches a
     device value.
@@ -280,6 +392,20 @@ class BlockAllocator:
         self._free: list[int] = list(range(num_blocks - 1, 0, -1))
         self._tables: dict[Any, list[int]] = {}
         self._retired: OrderedDict[Any, list[int]] = OrderedDict()
+        # Refcounts: block -> live-table multiplicity (allocated only).
+        self._ref: dict[int, int] = {}
+        # Prefix-cache state.  Trie nodes are canonical block ids (root
+        # = None); edges are keyed by the child's rolling content hash
+        # with the exact token chunk stored alongside for verification.
+        self._children: dict[Any, dict[int, tuple[tuple[int, ...], int]]] = {}
+        self._node_of: dict[int, tuple[Any, int]] = {}  # block -> (parent, h)
+        self._hash_of: dict[int, int] = {}  # registered block -> its hash
+        # Refcount-0 registered blocks, LRU order (oldest first).
+        self._cached: OrderedDict[int, None] = OrderedDict()
+        # Per-sequence registration chain: trie node reached so far and
+        # the number of full blocks already processed.
+        self._reg_node: dict[Any, Any] = {}
+        self._reg_blocks: dict[Any, int] = {}
         self.evictions = 0
         self.evicted_blocks = 0
 
@@ -290,11 +416,20 @@ class BlockAllocator:
 
     @property
     def evictable_blocks(self) -> int:
-        return sum(len(b) for b in self._retired.values())
+        return sum(len(b) for b in self._retired.values()) + len(
+            self._cached
+        )
+
+    @property
+    def cached_blocks(self) -> int:
+        return len(self._cached)
 
     @property
     def live_blocks(self) -> int:
         return sum(len(b) for b in self._tables.values())
+
+    def refcount(self, block: int) -> int:
+        return self._ref.get(block, 0)
 
     def blocks_for(self, tokens: int) -> int:
         return max(1, math.ceil(tokens / self.block_size))
@@ -309,17 +444,85 @@ class BlockAllocator:
         need = self.blocks_for(tokens) - len(self._tables[rid])
         return need <= 0 or self.free_blocks + self.evictable_blocks >= need
 
+    # -- trie internals -----------------------------------------------
+    def _node_hash(self, node) -> int:
+        return _ROOT_HASH if node is None else self._hash_of[node]
+
+    def _evict_cached(self, block: int) -> int:
+        """Detach one cached block (already popped from ``_cached``)
+        from the trie and cascade: refcount-0 descendants are freed
+        with it, live descendants stay allocated but become
+        unmatchable.  Returns the number of blocks freed."""
+        parent, h = self._node_of.pop(block)
+        kids = self._children.get(parent)
+        if kids is not None:
+            kids.pop(h, None)
+            if not kids:
+                self._children.pop(parent, None)
+        freed = 0
+        stack = [block]
+        while stack:
+            x = stack.pop()
+            self._hash_of.pop(x, None)
+            for _chunk, child in self._children.pop(x, {}).values():
+                self._node_of.pop(child, None)
+                stack.append(child)
+            if x in self._ref:
+                continue  # live elsewhere: allocated, now unregistered
+            self._cached.pop(x, None)
+            self._free.append(x)
+            freed += 1
+        # Any registration chain parked on a detached node is broken
+        # for good — never let it register under a recycled node id.
+        for rid, node in self._reg_node.items():
+            if (
+                node is not None
+                and node is not _DEAD
+                and node not in self._node_of
+            ):
+                self._reg_node[rid] = _DEAD
+        return freed
+
+    def _acquire(self, block: int) -> None:
+        """Take one reference on a matched block (reviving it from the
+        cached LRU if it was parked there)."""
+        self._ref[block] = self._ref.get(block, 0) + 1
+        self._cached.pop(block, None)
+
+    def _drop_ref(self, block: int) -> bool:
+        """Release one reference; returns True when the block reached
+        refcount 0 and is NOT registered (caller owns its disposal —
+        free list or retired park).  Registered blocks at refcount 0
+        park themselves in the cached LRU."""
+        n = self._ref[block] - 1
+        if n > 0:
+            self._ref[block] = n
+            return False
+        del self._ref[block]
+        if block in self._node_of:
+            self._cached[block] = None  # LRU append (newest last)
+            return False
+        return True
+
     # -- allocation ---------------------------------------------------
     def _reclaim(self, need: int) -> list[tuple[Any, int]]:
-        """Evict oldest-retired sequences until ``need`` blocks are
-        free; returns ``(rid, n_blocks)`` per eviction."""
+        """Evict refcount-0 blocks until ``need`` are free: retired
+        (finished, unregistered) sequences first, oldest retirement
+        first, then the cached-prefix LRU; returns ``(rid, n_blocks)``
+        per eviction (rid = ``"prefix-cache"`` for cache reclaims)."""
         evicted = []
-        while len(self._free) < need and self._retired:
-            rid, blocks = self._retired.popitem(last=False)
-            self._free.extend(blocks)
+        while len(self._free) < need and (self._retired or self._cached):
+            if self._retired:
+                rid, blocks = self._retired.popitem(last=False)
+                self._free.extend(blocks)
+                n = len(blocks)
+            else:
+                rid = "prefix-cache"
+                block, _ = self._cached.popitem(last=False)
+                n = self._evict_cached(block)
             self.evictions += 1
-            self.evicted_blocks += len(blocks)
-            evicted.append((rid, len(blocks)))
+            self.evicted_blocks += n
+            evicted.append((rid, n))
         return evicted
 
     def alloc(self, rid, tokens: int) -> list[tuple[Any, int]]:
@@ -335,7 +538,12 @@ class BlockAllocator:
                 "evictable"
             )
         evicted = self._reclaim(need)
-        self._tables[rid] = [self._free.pop() for _ in range(need)]
+        table = [self._free.pop() for _ in range(need)]
+        for b in table:
+            self._ref[b] = 1
+        self._tables[rid] = table
+        self._reg_node[rid] = None
+        self._reg_blocks[rid] = 0
         return evicted
 
     def extend(self, rid, tokens: int) -> list[tuple[Any, int]]:
@@ -350,23 +558,206 @@ class BlockAllocator:
                 f"pool exhausted extending {rid!r}: need {need} more"
             )
         evicted = self._reclaim(need)
-        table.extend(self._free.pop() for _ in range(need))
+        fresh = [self._free.pop() for _ in range(need)]
+        for b in fresh:
+            self._ref[b] = 1
+        table.extend(fresh)
         return evicted
+
+    # -- prefix cache -------------------------------------------------
+    def match_prefix(
+        self, token_ids, *, limit: int | None = None
+    ) -> tuple[list[int], int]:
+        """Longest registered prefix of ``token_ids`` (capped at
+        ``limit`` tokens): full-block trie walk, then one partial scan
+        of the frontier node's children for a shared tail block.
+        Returns ``(blocks, matched_tokens)`` without taking refs."""
+        toks = [int(t) for t in token_ids]
+        limit = len(toks) if limit is None else min(limit, len(toks))
+        bs = self.block_size
+        node = None
+        blocks: list[int] = []
+        matched = 0
+        while True:
+            kids = self._children.get(node)
+            if not kids:
+                break
+            rest = toks[matched:limit]
+            if len(rest) >= bs:
+                chunk = tuple(rest[:bs])
+                hit = kids.get(block_hash(self._node_hash(node), chunk))
+                if hit is not None and hit[0] == chunk:
+                    blocks.append(hit[1])
+                    matched += bs
+                    node = hit[1]
+                    continue
+            # Partial tail: longest common prefix (>= 1 token) with any
+            # child's chunk; ties broken by smallest block id so the
+            # walk is deterministic under replay.
+            best_len, best_blk = 0, -1
+            for chunk, blk in kids.values():
+                n = 0
+                for a, b in zip(chunk, rest):
+                    if a != b:
+                        break
+                    n += 1
+                if n > best_len or (n == best_len and n > 0 and blk < best_blk):
+                    best_len, best_blk = n, blk
+            if best_len > 0:
+                blocks.append(best_blk)
+                matched += best_len
+            break
+        return blocks, matched
+
+    def _shared_plan(
+        self, tokens: int, token_ids
+    ) -> tuple[list[int], int, int]:
+        """(matched blocks, matched tokens, fresh blocks needed) for a
+        shared allocation.  The match is capped at ``tokens - 1`` so at
+        least one context token always prefills — a fully-cached prompt
+        still needs a final-chunk logit row to sample its first token
+        from."""
+        limit = min(tokens, len(token_ids)) - 1
+        blocks, matched = self.match_prefix(token_ids, limit=limit)
+        return blocks, matched, self.blocks_for(tokens) - len(blocks)
+
+    def can_alloc_shared(self, tokens: int, token_ids) -> bool:
+        blocks, _, fresh = self._shared_plan(tokens, token_ids)
+        cached_matched = sum(1 for b in blocks if b in self._cached)
+        return (
+            self.free_blocks + self.evictable_blocks - cached_matched
+            >= fresh
+        )
+
+    def alloc_shared(
+        self, rid, tokens: int, token_ids
+    ) -> tuple[list[tuple[Any, int]], int]:
+        """Allocate a table covering ``tokens``, mapping the longest
+        registered prefix of ``token_ids`` as shared blocks.  Returns
+        ``(evictions, matched_tokens)``; the caller skips prefill for
+        the matched tokens (their KV is already resident).  Callers
+        gate on :meth:`can_alloc_shared`."""
+        if rid in self._tables:
+            raise ValueError(f"request {rid!r} already has a table")
+        blocks, matched, fresh = self._shared_plan(tokens, token_ids)
+        cached_matched = sum(1 for b in blocks if b in self._cached)
+        if (
+            self.free_blocks + self.evictable_blocks - cached_matched
+            < fresh
+        ):
+            raise RuntimeError(
+                f"pool exhausted: need {fresh} fresh blocks for "
+                f"{rid!r}, have {self.free_blocks} free + "
+                f"{self.evictable_blocks} evictable"
+            )
+        # Take refs FIRST so reclaim can never evict a matched block.
+        for b in blocks:
+            self._acquire(b)
+        evicted = self._reclaim(fresh)
+        tail = [self._free.pop() for _ in range(fresh)]
+        for b in tail:
+            self._ref[b] = 1
+        self._tables[rid] = blocks + tail
+        full = matched // self.block_size
+        self._reg_node[rid] = blocks[full - 1] if full else None
+        self._reg_blocks[rid] = full
+        return evicted, matched
+
+    def register_progress(self, rid, token_ids, upto: int) -> int:
+        """Register ``rid``'s full blocks whose every row holds
+        finalized KV (positions ``< upto``) into the prefix trie.
+        Idempotent per block; duplicate content dedups onto the
+        existing canonical block (the sequence keeps its private copy
+        unregistered).  Returns the number of newly registered blocks.
+        """
+        node = self._reg_node.get(rid)
+        bs = self.block_size
+        table = self._tables[rid]
+        full = min(upto // bs, len(table))
+        done = self._reg_blocks.get(rid, 0)
+        if node is _DEAD or full <= done:
+            self._reg_blocks[rid] = max(done, full)
+            return 0
+        toks = [int(t) for t in token_ids]
+        new = 0
+        for j in range(done, full):
+            chunk = tuple(toks[j * bs:(j + 1) * bs])
+            h = block_hash(self._node_hash(node), chunk)
+            kids = self._children.setdefault(node, {})
+            hit = kids.get(h)
+            if hit is not None:
+                if hit[0] != chunk:  # hash collision: stop registering
+                    node = _DEAD
+                    break
+                node = hit[1]  # dedup: our copy stays private
+            else:
+                b = table[j]
+                if b in self._node_of:
+                    # Matched shared block whose edge survived; walking
+                    # it is the no-op registration.
+                    node = b
+                else:
+                    kids[h] = (chunk, b)
+                    self._node_of[b] = (node, h)
+                    self._hash_of[b] = h
+                    node = b
+                    new += 1
+            self._reg_blocks[rid] = j + 1
+        self._reg_node[rid] = node
+        return new
+
+    def needs_cow(self, rid, block_idx: int) -> bool:
+        """True when writing into table entry ``block_idx`` would
+        mutate state another holder or the prefix cache depends on:
+        the block is shared (refcount > 1) or registered in the trie
+        (its content is a published prefix)."""
+        b = self._tables[rid][block_idx]
+        return self._ref.get(b, 0) > 1 or b in self._node_of
+
+    def cow(self, rid, block_idx: int) -> tuple[int, int, list[tuple[Any, int]]]:
+        """Copy-on-write: rewire ``rid``'s table entry ``block_idx`` to
+        a fresh private block.  Returns ``(src, dst, evictions)``; the
+        caller must copy the pool rows ``src -> dst`` on device before
+        the next write/read through the table."""
+        table = self._tables[rid]
+        src = table[block_idx]
+        if self.free_blocks + self.evictable_blocks < 1:
+            raise RuntimeError(f"pool exhausted: no block to CoW for {rid!r}")
+        evicted = self._reclaim(1)
+        dst = self._free.pop()
+        self._ref[dst] = 1
+        table[block_idx] = dst
+        if self._drop_ref(src):
+            self._free.append(src)
+        return src, dst, evicted
 
     # -- release ------------------------------------------------------
     def release(self, rid) -> int:
-        """Immediately return ``rid``'s blocks to the free list (the
-        preemption path — a preempted sequence is recomputed, its old
-        KV is garbage).  Returns the block count."""
+        """Drop ``rid``'s references: exclusively-held unregistered
+        blocks return to the free list immediately (the preemption
+        path — a preempted sequence is recomputed, its private KV is
+        garbage), registered blocks park in the cached LRU at refcount
+        0, shared blocks stay with their other holders.  Returns the
+        table's block count."""
         blocks = self._tables.pop(rid)
-        self._free.extend(blocks)
+        self._reg_node.pop(rid, None)
+        self._reg_blocks.pop(rid, None)
+        for b in blocks:
+            if self._drop_ref(b):
+                self._free.append(b)
         return len(blocks)
 
     def retire(self, rid) -> int:
-        """Finished sequence: park blocks in the LRU evictable list;
-        reclaimed by :meth:`alloc`/:meth:`extend` only under pressure."""
+        """Finished sequence: unregistered refcount-0 blocks park in
+        the per-rid LRU evictable list, registered ones in the cached
+        LRU; both are reclaimed by :meth:`alloc`/:meth:`extend` only
+        under pressure.  Returns the table's block count."""
         blocks = self._tables.pop(rid)
-        self._retired[rid] = blocks
+        self._reg_node.pop(rid, None)
+        self._reg_blocks.pop(rid, None)
+        park = [b for b in blocks if self._drop_ref(b)]
+        if park:
+            self._retired[rid] = park
         return len(blocks)
 
     # -- tables -------------------------------------------------------
@@ -388,23 +779,82 @@ class BlockAllocator:
         return out
 
     def check(self) -> None:
-        """Assert the partition invariant (tests call this liberally)."""
-        seen: set[int] = set()
-        for group in (
-            [self._free],
-            self._tables.values(),
-            self._retired.values(),
+        """Assert the partition + refcount + trie invariants (tests
+        call this liberally)."""
+
+        def _range(b):
+            if b == SCRATCH_BLOCK:
+                raise AssertionError("scratch block allocated")
+            if not 0 < b < self.num_blocks:
+                raise AssertionError(f"block {b} out of range")
+
+        live: dict[int, int] = {}
+        for blocks in self._tables.values():
+            for b in blocks:
+                _range(b)
+                live[b] = live.get(b, 0) + 1
+        idle: set[int] = set()
+        for blocks in (
+            [self._free, list(self._cached)]
+            + list(self._retired.values())
         ):
-            for blocks in group:
-                for b in blocks:
-                    if b == SCRATCH_BLOCK:
-                        raise AssertionError("scratch block allocated")
-                    if not 0 < b < self.num_blocks:
-                        raise AssertionError(f"block {b} out of range")
-                    if b in seen:
-                        raise AssertionError(f"block {b} double-owned")
-                    seen.add(b)
-        if len(seen) != self.num_blocks - 1:
+            for b in blocks:
+                _range(b)
+                if b in idle or b in live:
+                    raise AssertionError(f"block {b} double-owned")
+                idle.add(b)
+        if len(live) + len(idle) != self.num_blocks - 1:
             raise AssertionError(
-                f"{self.num_blocks - 1 - len(seen)} blocks leaked"
+                f"{self.num_blocks - 1 - len(live) - len(idle)} "
+                "blocks leaked"
             )
+        # Refcounts mirror live-table multiplicity exactly.
+        if self._ref != live:
+            raise AssertionError(
+                f"refcounts {self._ref} != table multiplicity {live}"
+            )
+        # Trie: registered blocks are live or cached; cached blocks are
+        # registered; retired/free blocks are never registered.
+        for b in self._node_of:
+            if b not in live and b not in self._cached:
+                raise AssertionError(
+                    f"registered block {b} is neither live nor cached"
+                )
+        for b in self._cached:
+            if b not in self._node_of:
+                raise AssertionError(f"cached block {b} not registered")
+        # Edge <-> node consistency, both directions.
+        for node, kids in self._children.items():
+            if node is not None and node not in self._node_of:
+                raise AssertionError(
+                    f"trie node {node} has children but no registration"
+                )
+            for h, (chunk, child) in kids.items():
+                if len(chunk) != self.block_size:
+                    raise AssertionError(
+                        f"edge chunk of {child} has {len(chunk)} tokens"
+                    )
+                if self._node_of.get(child) != (node, h):
+                    raise AssertionError(
+                        f"edge {node}->{child} not mirrored in _node_of"
+                    )
+        for child, (parent, h) in self._node_of.items():
+            edge = self._children.get(parent, {}).get(h)
+            if edge is None or edge[1] != child:
+                raise AssertionError(
+                    f"registration of {child} has no parent edge"
+                )
+            if child not in self._hash_of:
+                raise AssertionError(f"registered {child} missing hash")
+        # Registration chains point at valid nodes.
+        for rid, node in self._reg_node.items():
+            if rid not in self._tables:
+                raise AssertionError(f"chain for dead request {rid!r}")
+            if (
+                node is not None
+                and node is not _DEAD
+                and node not in self._node_of
+            ):
+                raise AssertionError(
+                    f"chain of {rid!r} parked on unregistered {node}"
+                )

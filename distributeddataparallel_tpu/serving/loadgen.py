@@ -34,7 +34,17 @@ import numpy as np
 
 @dataclasses.dataclass(frozen=True)
 class LoadConfig:
-    """Shape of the synthetic traffic."""
+    """Shape of the synthetic traffic.
+
+    ``prefix_pool > 0`` turns on the shared-prefix trace mode that
+    models production traffic (repeated system prompts / few-shot
+    headers): a seeded pool of ``prefix_pool`` fixed prefixes of
+    ``prefix_len`` tokens is sampled once, and every request draws its
+    prefix from the pool with Zipf rank weights (rank r picked with
+    probability proportional to ``r ** -zipf_alpha`` — a handful of hot
+    prefixes dominate, the tail stays warm) followed by an independent
+    random suffix.  Everything stays a pure function of ``seed``.
+    """
 
     rate_rps: float = 8.0
     duration_s: float = 2.0
@@ -42,6 +52,9 @@ class LoadConfig:
     output_len: tuple[int, int] = (4, 16)    # uniform [lo, hi]
     vocab_size: int = 256
     seed: int = 0
+    prefix_pool: int = 0     # 0 = plain random prompts
+    prefix_len: int = 0      # shared-prefix tokens per pooled prefix
+    zipf_alpha: float = 1.1  # rank-weight exponent over the pool
 
 
 def make_trace(cfg: LoadConfig) -> list[dict]:
@@ -50,7 +63,18 @@ def make_trace(cfg: LoadConfig) -> list[dict]:
     arrival time."""
     if cfg.rate_rps <= 0 or cfg.duration_s <= 0:
         raise ValueError("rate_rps and duration_s must be positive")
+    if cfg.prefix_pool > 0 and cfg.prefix_len < 1:
+        raise ValueError("prefix_pool needs prefix_len >= 1")
     rng = np.random.default_rng(cfg.seed)
+    pool = None
+    if cfg.prefix_pool > 0:
+        pool = [
+            rng.integers(0, cfg.vocab_size, cfg.prefix_len, dtype=np.int32)
+            for _ in range(cfg.prefix_pool)
+        ]
+        ranks = np.arange(1, cfg.prefix_pool + 1, dtype=np.float64)
+        probs = ranks ** -float(cfg.zipf_alpha)
+        probs /= probs.sum()
     trace = []
     t = 0.0
     while True:
@@ -60,11 +84,22 @@ def make_trace(cfg: LoadConfig) -> list[dict]:
         p_lo, p_hi = cfg.prompt_len
         o_lo, o_hi = cfg.output_len
         plen = int(rng.integers(p_lo, p_hi + 1))
+        if pool is not None:
+            prefix = pool[int(rng.choice(cfg.prefix_pool, p=probs))]
+            suffix_len = max(plen - cfg.prefix_len, 1)
+            prompt = np.concatenate([
+                prefix,
+                rng.integers(
+                    0, cfg.vocab_size, suffix_len, dtype=np.int32
+                ),
+            ])
+        else:
+            prompt = rng.integers(
+                0, cfg.vocab_size, plen, dtype=np.int32
+            )
         trace.append({
             "arrival_s": t,
-            "prompt": rng.integers(
-                0, cfg.vocab_size, plen, dtype=np.int32
-            ),
+            "prompt": prompt,
             "max_new_tokens": int(rng.integers(o_lo, o_hi + 1)),
         })
     return trace
@@ -175,6 +210,23 @@ def summary(engine, *, wall_elapsed_s: float | None = None) -> dict:
             float(np.mean(tok_lat)) if tok_lat else 0.0
         ),
     })
+    # Serving fast path (prefix cache + speculative decoding) stats.
+    if getattr(engine, "prefix_admits", 0) > 0:
+        out.update({
+            "prefix_hit_frac": engine.prefix_hits / engine.prefix_admits,
+            "prefill_flops_avoided_frac": (
+                engine.prefix_hit_tokens
+                / max(engine.prefix_ctx_tokens, 1)
+            ),
+            "prefix_hit_tokens": engine.prefix_hit_tokens,
+            "cow_copies": engine.cow_copies,
+        })
+    if getattr(engine, "spec_rows", 0) > 0:
+        out.update({
+            "spec_drafted": engine.spec_drafted,
+            "spec_accepted": engine.spec_accepted,
+            "spec_accept_mean": engine.spec_accepted / engine.spec_rows,
+        })
     if engine.registry is not None:
         for k in ("serve_tok_s", "serve_p50_ttft_s", "serve_p99_ttft_s"):
             engine.registry.gauge(k).set(out[k])

@@ -58,6 +58,7 @@ class Request:
     first_token_s: float | None = None
     done_s: float | None = None
     preemptions: int = 0
+    prefix_hit_tokens: int = 0  # context tokens served from the cache
 
     def __post_init__(self):
         self.prompt = np.asarray(self.prompt, np.int32).reshape(-1)
@@ -107,6 +108,12 @@ class StepPlan:
     decode: list[Request]
     preempted: list[tuple[Request, int]]  # (req, released_blocks)
     evicted: list[tuple[Any, int]]  # (rid, n_blocks) LRU reclaims
+    # Copy-on-write ops (req, src_block, dst_block): the engine must
+    # copy the pool rows BEFORE executing this plan's prefill/decode —
+    # the table already points at dst.
+    cow: list[tuple[Request, int, int]] = dataclasses.field(
+        default_factory=list
+    )
 
     @property
     def empty(self) -> bool:
@@ -124,6 +131,8 @@ class Scheduler:
         prefill_chunk: int,
         max_seq_len: int,
         max_prefill_chunks_per_step: int = 1,
+        prefix_cache: bool = False,
+        lookahead: int = 0,
     ):
         if num_slots < 1:
             raise ValueError(f"num_slots must be >= 1, got {num_slots}")
@@ -135,11 +144,22 @@ class Scheduler:
             )
         if max_prefill_chunks_per_step < 1:
             raise ValueError("max_prefill_chunks_per_step must be >= 1")
+        if not 0 <= lookahead <= max_seq_len - 1:
+            raise ValueError(
+                f"lookahead ({lookahead}) must be in [0, max_seq_len - 1]"
+            )
         self.alloc = allocator
         self.num_slots = num_slots
         self.prefill_chunk = prefill_chunk
         self.max_seq_len = max_seq_len
         self.max_prefill_chunks = max_prefill_chunks_per_step
+        # Prefix caching: admission maps the longest registered prefix
+        # as shared blocks and skips its prefill.  Lookahead: extra
+        # write-window tokens per decode step (speculative verify
+        # writes positions [next_pos, next_pos + lookahead]), so table
+        # growth and CoW must cover them.
+        self.prefix_cache = prefix_cache
+        self.lookahead = lookahead
         self.waiting: deque[Request] = deque()
         self.prefilling: list[Request] = []
         self.running: dict[int, Request] = {}  # slot -> Request
@@ -167,17 +187,50 @@ class Scheduler:
         return bool(self.waiting or self.prefilling or self.running)
 
     # -- planning -----------------------------------------------------
+    def _cow_window(
+        self,
+        req: Request,
+        lo_pos: int,
+        hi_pos: int,
+        cow: list[tuple[Request, int, int]],
+        evicted: list[tuple[Any, int]],
+    ) -> bool:
+        """Make the blocks covering positions ``[lo_pos, hi_pos]``
+        privately writable (copy-on-write where shared/registered).
+        Returns False when the pool cannot supply a copy target."""
+        bs = self.alloc.block_size
+        for idx in range(lo_pos // bs, hi_pos // bs + 1):
+            if not self.alloc.needs_cow(req.rid, idx):
+                continue
+            if self.alloc.free_blocks + self.alloc.evictable_blocks < 1:
+                return False
+            src, dst, ev = self.alloc.cow(req.rid, idx)
+            evicted.extend(ev)
+            cow.append((req, src, dst))
+        return True
+
     def plan_step(self) -> StepPlan:
         evicted: list[tuple[Any, int]] = []
         preempted: list[tuple[Request, int]] = []
+        cow: list[tuple[Request, int, int]] = []
 
-        # 1) grow running sequences (priority over admission).
+        # 1) grow running sequences (priority over admission), then
+        # make their decode write window [next_pos, next_pos +
+        # lookahead] privately writable — a speculative verify writes
+        # the whole window, and none of it may land in a shared or
+        # published (trie-registered) block.
         for slot in sorted(self.running):
             req = self.running[slot]
-            need = req.next_pos + 1
-            if self.alloc.can_extend(req.rid, need):
-                evicted.extend(self.alloc.extend(req.rid, need))
-            else:
+            need = min(
+                req.next_pos + 1 + self.lookahead, self.max_seq_len
+            )
+            if not self.alloc.can_extend(req.rid, need):
+                preempted.append((req, self._preempt(req)))
+                continue
+            evicted.extend(self.alloc.extend(req.rid, need))
+            if not self._cow_window(
+                req, req.next_pos, need - 1, cow, evicted
+            ):
                 preempted.append((req, self._preempt(req)))
 
         # 2) admission.  Allocate ctx_len + 1 tokens: the first decode
@@ -185,31 +238,59 @@ class Scheduler:
         # in the same engine step as the final chunk, BEFORE the next
         # plan's extend phase), so a prompt that exactly fills its
         # blocks would otherwise spill its first decode row to scratch.
+        # Lookahead widens that to ctx_len + 1 + lookahead for the
+        # verify window's sake.  With the prefix cache on, admission
+        # walks the trie: matched blocks map shared, their prefill is
+        # skipped (req.prefilled starts at the match length).
         admitted: list[Request] = []
         while self.waiting and self._free_slots:
             req = self.waiting[0]
-            if not self.alloc.can_alloc(req.ctx_len + 1):
-                break  # FIFO: don't let a small request jump a big one
-            self.waiting.popleft()
-            evicted.extend(self.alloc.alloc(req.rid, req.ctx_len + 1))
+            tokens = min(
+                req.ctx_len + 1 + self.lookahead, self.max_seq_len
+            )
+            if self.prefix_cache:
+                ids = req.ctx_tokens()
+                if not self.alloc.can_alloc_shared(tokens, ids):
+                    break  # FIFO: a small request never jumps a big one
+                self.waiting.popleft()
+                ev, matched = self.alloc.alloc_shared(
+                    req.rid, tokens, ids
+                )
+                evicted.extend(ev)
+                req.prefilled = matched
+                req.prefix_hit_tokens = matched
+            else:
+                if not self.alloc.can_alloc(tokens):
+                    break
+                self.waiting.popleft()
+                evicted.extend(self.alloc.alloc(req.rid, tokens))
+                req.prefilled = 0
+                req.prefix_hit_tokens = 0
             req.slot = self._free_slots.pop()
-            req.prefilled = 0
             self.prefilling.append(req)
             admitted.append(req)
 
-        # 3) prefill chunks, FIFO across mid-prefill requests.
+        # 3) prefill chunks, FIFO across mid-prefill requests.  Each
+        # scheduled chunk's write window must be privately writable
+        # (the first chunk after a partial-block prefix hit writes
+        # into the shared tail block -> CoW); a chunk whose CoW can't
+        # be supplied is simply deferred to a later step.
         chunks: list[tuple[Request, int, int]] = []
         budget = self.max_prefill_chunks
         for req in self.prefilling:
             if budget == 0:
                 break
             n = min(self.prefill_chunk, req.ctx_len - req.prefilled)
+            if not self._cow_window(
+                req, req.prefilled, req.prefilled + n - 1, cow, evicted
+            ):
+                continue
             chunks.append((req, req.prefilled, n))
             budget -= 1
 
         # 4) decode everyone still running.
         decode = [self.running[s] for s in sorted(self.running)]
-        return StepPlan(admitted, chunks, decode, preempted, evicted)
+        return StepPlan(admitted, chunks, decode, preempted, evicted, cow)
 
     # -- transitions (engine drives these) ----------------------------
     def advance_prefill(self, req: Request, n_tokens: int) -> bool:

@@ -28,10 +28,16 @@
 #                               doubles serving memory
 #   5. ddp_serve --smoke        end-to-end serving smoke on a tiny
 #                               model under a deterministic virtual
-#                               clock: >=1 request completes and the
-#                               events dir yields a schema-valid
-#                               timeline + structurally valid Perfetto
-#                               trace with the request-lifecycle kinds
+#                               clock, two phases: (a) plain engine —
+#                               >=1 request completes and the events
+#                               dir yields a schema-valid timeline +
+#                               structurally valid Perfetto trace with
+#                               the request-lifecycle kinds; (b) fast
+#                               path — prefix cache + spec decoding on
+#                               a shared-prefix Zipf trace must land
+#                               >0 prefix hits and >1 mean accepted
+#                               tokens/verify, with prefix_hit /
+#                               spec_verify kinds schema-valid
 #   6. elastic shrink smoke     4 -> 3 in-process resize on a fake-device
 #                               CPU gang: chaos kills one member mid-run,
 #                               the coordinator must land a gang_resize

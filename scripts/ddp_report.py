@@ -227,7 +227,8 @@ def analyze(records: list[dict]) -> dict:
                 k: v for k, v in r.items() if k not in ("v", "seq", "kind")
             }
         elif kind in ("request_admit", "prefill_chunk", "decode_step",
-                      "request_done", "kv_evict"):
+                      "request_done", "kv_evict", "prefix_hit",
+                      "spec_verify"):
             s = out["serving"]
             if s is None:
                 s = out["serving"] = {
@@ -237,6 +238,10 @@ def analyze(records: list[dict]) -> dict:
                     "evictions": {"lru": 0, "preempt": 0},
                     "evicted_blocks": 0, "ttft_s": [],
                     "first_ts": None, "last_ts": None,
+                    "ctx_tokens": 0, "prefix_hits": 0,
+                    "prefix_hit_tokens": 0, "spec_dispatches": 0,
+                    "spec_drafted": 0, "spec_accepted": 0,
+                    "spec_rows": 0, "accept_hist": {},
                 }
             ts = r.get("ts")
             if isinstance(ts, (int, float)):
@@ -246,6 +251,7 @@ def analyze(records: list[dict]) -> dict:
                     else max(s["last_ts"], ts)
             if kind == "request_admit":
                 s["admitted"] += 1
+                s["ctx_tokens"] += r.get("ctx_tokens") or 0
             elif kind == "prefill_chunk":
                 s["prefill_chunks"] += 1
             elif kind == "decode_step":
@@ -264,6 +270,20 @@ def analyze(records: list[dict]) -> dict:
                     s["evictions"].get(reason, 0) + 1
                 )
                 s["evicted_blocks"] += r.get("blocks") or 0
+            elif kind == "prefix_hit":
+                s["prefix_hits"] += 1
+                s["prefix_hit_tokens"] += r.get("tokens") or 0
+            elif kind == "spec_verify":
+                s["spec_dispatches"] += 1
+                s["spec_drafted"] += r.get("drafted") or 0
+                s["spec_accepted"] += r.get("accepted") or 0
+                rows = r.get("rows") or 0
+                s["spec_rows"] += rows
+                if rows:
+                    # accept-length histogram, bucketed by the
+                    # dispatch's mean accepted tokens per row
+                    b = int((r.get("accepted") or 0) // rows)
+                    s["accept_hist"][b] = s["accept_hist"].get(b, 0) + 1
         elif kind in ("tune_trial", "tune_result"):
             t = out["tuning"]
             if t is None:
@@ -304,6 +324,20 @@ def analyze(records: list[dict]) -> dict:
         ttfts = sorted(s.pop("ttft_s"))
         s["ttft_p50_s"] = _quantile(ttfts, 0.50)
         s["ttft_p99_s"] = _quantile(ttfts, 0.99)
+        s["prefix_hit_frac"] = (
+            s["prefix_hits"] / s["admitted"] if s["admitted"] else None
+        )
+        s["prefill_flops_avoided_frac"] = (
+            s["prefix_hit_tokens"] / s["ctx_tokens"]
+            if s["ctx_tokens"] else None
+        )
+        s["spec_accept_mean"] = (
+            s["spec_accepted"] / s["spec_rows"]
+            if s["spec_rows"] else None
+        )
+        s["accept_hist"] = {
+            str(k): s["accept_hist"][k] for k in sorted(s["accept_hist"])
+        }
     if out["elasticity"]:
         el = out["elasticity"]
         # dicts keyed by epoch -> sorted lists for the --json face
@@ -712,6 +746,32 @@ def render_markdown(a: dict, events_dir: str) -> str:
             f"| preempt evictions | {sv['evictions'].get('preempt', 0)} |",
             f"| blocks reclaimed | {sv['evicted_blocks']} |",
         ]
+        if sv["prefix_hits"]:
+            hit = sv["prefix_hit_frac"]
+            avoided = sv["prefill_flops_avoided_frac"]
+            lines += [
+                f"| prefix-cache hits | {sv['prefix_hits']} "
+                f"({'-' if hit is None else f'{hit:.0%}'} of admits) |",
+                f"| prefill FLOPs avoided | "
+                f"{'-' if avoided is None else f'{avoided:.0%}'} "
+                f"({sv['prefix_hit_tokens']} cached ctx tokens) |",
+            ]
+        if sv["spec_dispatches"]:
+            lines += [
+                f"| spec-verify dispatches | {sv['spec_dispatches']} |",
+                f"| spec tokens drafted / accepted | "
+                f"{sv['spec_drafted']} / {sv['spec_accepted']} |",
+                f"| mean accepted tokens per row | "
+                f"{sv['spec_accept_mean']:.2f} |",
+            ]
+            hist = " ".join(
+                f"{k}:{v}" for k, v in sv["accept_hist"].items()
+            )
+            lines += [
+                "",
+                f"Accept-length histogram (dispatch mean, tokens/row): "
+                f"`{hist}`",
+            ]
     lines.append("")
 
     # -- Tuning -------------------------------------------------------

@@ -68,6 +68,21 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--quantize-kv", action="store_true")
     ap.add_argument("--quantize-weights", action="store_true")
+    ap.add_argument("--prefix-cache", action="store_true",
+                    help="radix prefix cache: share KV blocks across "
+                         "requests with a common prompt prefix")
+    ap.add_argument("--spec-k", type=int, default=0,
+                    help="speculative decoding: n-gram-draft k tokens "
+                         "per step through the verify program (0 = off)")
+    ap.add_argument("--spec-ngram", type=int, default=3,
+                    help="longest n-gram the self-draft proposer matches")
+    ap.add_argument("--prefix-pool", type=int, default=0,
+                    help="shared-prefix trace mode: pool of N fixed "
+                         "prefixes sampled with Zipf rank weights")
+    ap.add_argument("--prefix-len", type=int, default=0,
+                    help="tokens per pooled shared prefix")
+    ap.add_argument("--zipf-alpha", type=float, default=1.1,
+                    help="Zipf exponent over the prefix pool ranks")
     ap.add_argument("--events-dir", default=None)
     ap.add_argument("--store", default=None,
                     help="ExecutableStore dir (warm-start AOT reuse)")
@@ -146,6 +161,9 @@ def main(argv=None) -> int:
         quantized_kv=args.quantize_kv,
         quantize_weights=args.quantize_weights,
         store_dir=args.store,
+        prefix_cache=args.prefix_cache,
+        spec_k=args.spec_k,
+        spec_ngram=args.spec_ngram,
     )
     engine = InferenceEngine(
         model, params, ecfg, events=events, registry=registry,
@@ -158,6 +176,9 @@ def main(argv=None) -> int:
         output_len=_range(args.output_len),
         vocab_size=cfg.vocab_size,
         seed=args.seed,
+        prefix_pool=args.prefix_pool,
+        prefix_len=args.prefix_len,
+        zipf_alpha=args.zipf_alpha,
     ))
     out = run_load(engine, trace, clock=clock)
     out["requests"] = len(trace)
@@ -204,13 +225,89 @@ def main(argv=None) -> int:
                            "request_done"):
                 if needed not in kinds:
                     failures.append(f"smoke: no {needed} event")
+
+        # Phase 2: the serving fast path — prefix cache + speculative
+        # decoding on a shared-prefix Zipf trace.  Gates that the radix
+        # cache actually hits, the verifier actually accepts drafts,
+        # and that the new prefix_hit / spec_verify kinds keep the
+        # timeline and the Perfetto export schema-valid.
+        fp_dir = None
+        fp_events = None
+        if args.events_dir:
+            fp_dir = os.path.join(args.events_dir, "fastpath")
+            os.makedirs(fp_dir, exist_ok=True)
+            fp_events = EventLog(events_path(fp_dir, 0), 0)
+            fp_events.emit("run_start", argv=["--smoke", "fastpath"],
+                           role="serve")
+        fp_clock = VirtualClock(args.virtual_dt)
+        fp_engine = InferenceEngine(
+            model, params,
+            EngineConfig(
+                num_slots=args.slots,
+                num_blocks=args.blocks,
+                block_size=args.block_size,
+                prefill_chunk=args.chunk,
+                max_prefill_chunks_per_step=args.max_prefill_chunks,
+                quantized_kv=args.quantize_kv,
+                quantize_weights=args.quantize_weights,
+                store_dir=args.store,
+                prefix_cache=True,
+                spec_k=max(args.spec_k, 3),
+                spec_ngram=args.spec_ngram,
+            ),
+            events=fp_events, time_fn=fp_clock,
+        )
+        fp_trace = make_trace(LoadConfig(
+            rate_rps=24.0,
+            duration_s=args.duration,
+            prompt_len=(56, 72),
+            output_len=(8, 16),
+            vocab_size=cfg.vocab_size,
+            seed=args.seed,
+            prefix_pool=4,
+            prefix_len=48,
+            zipf_alpha=args.zipf_alpha,
+        ))
+        fp_out = run_load(fp_engine, fp_trace, clock=fp_clock)
+        if fp_events is not None:
+            fp_events.emit("run_end", status="ok")
+            fp_events.close()
+            merge_timeline(fp_dir)
+        if fp_out["completed"] < len(fp_trace):
+            failures.append(
+                "smoke fastpath: only "
+                f"{fp_out['completed']}/{len(fp_trace)} completed"
+            )
+        if fp_engine.prefix_hits < 1:
+            failures.append("smoke fastpath: no prefix-cache hit")
+        accept_mean = fp_out.get("spec_accept_mean", 0.0)
+        if accept_mean <= 1.0:
+            failures.append(
+                "smoke fastpath: spec_accept_mean "
+                f"{accept_mean:.2f} <= 1 (speculation not landing)"
+            )
+        if fp_dir is not None:
+            problems = validate_file(
+                os.path.join(fp_dir, "timeline.jsonl")
+            )
+            failures.extend(problems[:5])
+            records = load_timeline(fp_dir)
+            trace_problems = validate_trace(to_trace_events(records))
+            failures.extend(trace_problems[:5])
+            kinds = {r.get("kind") for r in records}
+            for needed in ("prefix_hit", "spec_verify"):
+                if needed not in kinds:
+                    failures.append(f"smoke fastpath: no {needed} event")
+
         if failures:
             print("SMOKE FAIL:\n  " + "\n  ".join(failures),
                   file=sys.stderr)
             return 1
         print("serving smoke OK: "
               f"{out['completed']}/{out['requests']} requests, "
-              f"{out.get('serve_tok_s', 0):.1f} tok/s")
+              f"{out.get('serve_tok_s', 0):.1f} tok/s; fastpath "
+              f"hit_frac={fp_out.get('prefix_hit_frac', 0):.2f} "
+              f"accept_mean={accept_mean:.2f}")
     return 0
 
 

@@ -266,6 +266,40 @@ def _graph_cases(modes):
         yield ("serve", serve_step,
                ServeState(params=engine.pool, opt_state=()), sbatch, rng)
 
+        # The speculative-verify program ((num_slots, k+1) window) must
+        # hold the exact same contract as decode: zero training
+        # collectives and full pool donation (GL003) — it replaces the
+        # decode program on the hot path whenever spec_k > 0.
+        vengine = InferenceEngine(
+            lm, p,
+            EngineConfig(num_slots=4, num_blocks=8, block_size=8,
+                         prefill_chunk=8, spec_k=3),
+        )
+        vbatch = {
+            "tables": jnp.zeros((4, bps), jnp.int32),
+            "toks": jnp.zeros((4, 4), jnp.int32),
+            "pos": jnp.zeros((4,), jnp.int32),
+        }
+
+        def verify_step(state, batch, _rng, _eng=vengine):
+            return _eng._verify_prog(
+                _eng.params, state.params, batch["tables"],
+                batch["toks"], batch["pos"],
+            )
+
+        verify_step.lower = (
+            lambda state, batch, _rng, _eng=vengine:
+            _eng._verify_prog.lower(
+                _eng.params, state.params, batch["tables"],
+                batch["toks"], batch["pos"],
+            )
+        )
+        verify_step.collective_manifest = collective_manifest(
+            "serve-verify", grad_reduce={}, donate=True,
+        )
+        yield ("serve-verify", verify_step,
+               ServeState(params=vengine.pool, opt_state=()), vbatch, rng)
+
 
 def _schedule_ir_of(step, state):
     """The schedule IR a step carries as data: pipeline factories attach

@@ -60,12 +60,17 @@ _LOWER_BETTER = re.compile(
 #: a serving tok/s IMPROVEMENT must not read as a regression.  Same for
 #: reclaimed_s: restart seconds the elastic resize path gave BACK
 #: (bench elastic_resize's restart_reclaimed_s) — it ends in _s and
-#: contains "restart", but more of it is better.  And gain_frac: the
-#: autotuner's speedup over the hand-picked baseline (bench autotune's
-#: tune_gain_frac) — it ends in _frac but it is a WIN share, not a
-#: waste share; this pattern is checked first so _LOWER_BETTER's
-#: ``_frac$`` cannot shadow it.
-_HIGHER_BETTER = re.compile(r"(tok_s|img_s|_per_s|reclaimed_s|gain_frac)$")
+#: contains "restart", but more of it is better.  And the WIN-share
+#: suffixes: gain_frac (autotune speedup over the hand-picked config),
+#: _hit_frac (prefix-cache hit rate), _avoided_frac (prefill FLOPs the
+#: cache skipped), _speedup (fast-path tokens/s ratio) — they end in
+#: _frac (or look like a plain name) but more of each is better; this
+#: pattern is checked FIRST so _LOWER_BETTER's ``_frac$`` cannot
+#: shadow them.
+_HIGHER_BETTER = re.compile(
+    r"(tok_s|img_s|_per_s|reclaimed_s|gain_frac|_hit_frac|_avoided_frac"
+    r"|_speedup)$"
+)
 
 
 def _bench_direction(name: str) -> str:

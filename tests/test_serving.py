@@ -14,7 +14,14 @@ The load-bearing contracts:
   decodes, and preemption requeues at the FRONT of the waiting queue;
 - a seeded loadgen trace under a ``VirtualClock`` replays to an
   identical run (tokens, events, summary) — serving runs are a pure
-  function of (seed, config);
+  function of (seed, config), with the prefix cache and speculative
+  decoding on as well as off;
+- the serving fast path is invisible to outputs: generation after a
+  radix prefix-cache hit is bit-identical to a cold prefill, and
+  speculative decoding through the (num_slots, k+1) verify program is
+  bit-identical to one-token decode — both pinned against
+  ``generate()``, including under pool pressure with ``check()`` run
+  every scheduler step;
 - a serving events dir yields a schema-valid timeline, a structurally
   valid Perfetto trace, and a populated ddp_report Serving section.
 """
@@ -218,24 +225,39 @@ def test_engine_matches_generate_greedy(cfg_fn, devices):
         np.testing.assert_array_equal(engine.output_tokens(rid), want)
 
 
-def test_engine_parity_under_pool_pressure(devices):
+@pytest.mark.parametrize("prefix_cache,spec_k", [(False, 0), (True, 3)],
+                         ids=["plain", "fastpath"])
+def test_engine_parity_under_pool_pressure(prefix_cache, spec_k, devices):
     """A pool too small to hold every sequence forces LRU evictions and
     recompute preemptions mid-flight; outputs must STILL be bit-exact
     vs generate() — preemption re-prefills prompt + generated-so-far
-    and resumes, it never corrupts a continuation."""
+    and resumes, it never corrupts a continuation.
+
+    The fastpath variant turns on the radix prefix cache AND
+    speculative decoding under the same pressure: shared prompt
+    prefixes mean refcounted blocks, CoW on divergence, cached-subtree
+    evictions, and the verify program's multi-token appends all run
+    against ``check()`` every single step."""
     model, params = _model(_unrolled)
     engine = InferenceEngine(
         model, params,
         EngineConfig(num_slots=4, num_blocks=8, block_size=4,
-                     prefill_chunk=8),
+                     prefill_chunk=8, prefix_cache=prefix_cache,
+                     spec_k=spec_k),
     )
     rng = np.random.default_rng(11)
     # Repeated shapes: only two generate() reference compiles, but six
     # in-flight sequences against a 7-block pool — guaranteed pressure.
+    # The 7-token prompts share a full-block 4-token prefix, so the
+    # fastpath variant exercises sharing + CoW, not just eviction.
+    shared = _prompt(rng, 4)
     cases = [(4, 12), (7, 11), (4, 12), (7, 11), (4, 12), (7, 11)]
     rids = {}
     for plen, n_new in cases:
-        p = _prompt(rng, plen)
+        if plen == 7:
+            p = np.concatenate([shared, _prompt(rng, 3)])
+        else:
+            p = _prompt(rng, plen)
         rids[engine.submit(p, n_new)] = (p, n_new)
     while engine.has_work():
         engine.step()
@@ -248,6 +270,153 @@ def test_engine_parity_under_pool_pressure(devices):
     }
     # The point of the test is pressure: something must have given.
     assert stats["evictions"] + stats["preemptions"] > 0, stats
+    if spec_k:
+        assert engine.spec_rows > 0
+    for rid, (p, n_new) in rids.items():
+        want = np.asarray(
+            generate(model, params, jnp.asarray(p)[None], n_new)
+        )[0]
+        np.testing.assert_array_equal(engine.output_tokens(rid), want)
+
+
+# ---------------------------------------------------------------------
+# Serving fast path: refcounted radix prefix cache + spec decoding
+# ---------------------------------------------------------------------
+
+def test_allocator_prefix_sharing_refcount_lifecycle():
+    """Refcount/CoW contract: a block shared by two sequences survives
+    one owner's release, copy-on-write gives the writer a private copy,
+    and a block is only reclaimable once every reference is gone."""
+    a = BlockAllocator(num_blocks=16, block_size=4)
+    ids = np.arange(12, dtype=np.int32)  # 3 full blocks of context
+
+    # Cold path: nothing registered yet, so no match.
+    ev, matched = a.alloc_shared("a", 13, ids)
+    assert ev == [] and matched == 0
+    a.check()
+    # Registration publishes a's first 3 blocks into the radix trie.
+    assert a.register_progress("a", ids, upto=12) == 3
+    a.check()
+
+    # Second sequence with the same context maps the shared blocks.
+    ev, matched = a.alloc_shared("b", 13, ids)
+    assert ev == [] and matched >= 8  # >= 2 full blocks shared
+    a.check()
+    n_shared = (matched + 3) // 4  # full + the partially matched tail
+    ta, tb = list(a.table_of("a")), list(a.table_of("b"))
+    shared_blocks = tb[:n_shared]
+    assert shared_blocks == ta[:n_shared]
+    for blk in shared_blocks:
+        assert a.refcount(blk) == 2
+
+    # Shared + registered blocks need CoW before any in-place write.
+    assert a.needs_cow("b", 0)
+    src, dst, ev = a.cow("b", 0)
+    assert src == shared_blocks[0] and dst != src and ev == []
+    assert a.refcount(src) == 1 and a.refcount(dst) == 1
+    assert a.table_of("b")[0] == dst
+    a.check()
+
+    # Releasing one owner must NOT free blocks the other still maps.
+    before = set(a.table_of("a"))
+    assert a.release("b") > 0
+    a.check()
+    assert set(a.table_of("a")) == before
+    # Registered blocks still referenced by "a" are not evictable.
+    assert a.evictable_blocks == 0
+
+    # Last reference gone: registered blocks become revivable cache...
+    a.release("a")
+    a.check()
+    assert a.cached_blocks == 3 and a.evictable_blocks == 3
+    # ...and a big enough demand reclaims them (refcount 0 only).
+    evs, m = a.alloc_shared("c", 57, _prompt(np.random.default_rng(0), 57))
+    a.check()
+    assert sum(n for _, n in evs) >= 1  # forced cache eviction
+    assert a.cached_blocks < 3
+    a.release("c")
+    a.check()
+
+
+def test_allocator_match_prefix_is_collision_checked():
+    """The radix walk verifies chunk CONTENT, not just the rolling
+    hash: a different token run never matches a cached block."""
+    a = BlockAllocator(num_blocks=8, block_size=4)
+    ids = np.arange(8, dtype=np.int32)
+    a.alloc_shared("a", 9, ids)
+    a.register_progress("a", ids, upto=8)
+    other = ids + 1
+    blocks, matched = a.match_prefix(other, limit=8)
+    assert blocks == [] and matched == 0
+    blocks, matched = a.match_prefix(ids, limit=8)
+    assert matched == 8 and len(blocks) == 2
+    a.release("a")
+    a.check()
+
+
+def test_engine_prefix_hit_parity_vs_cold_prefill(devices):
+    """A warm radix cache must be invisible: generation after a prefix
+    hit is bit-identical to a cold prefill of the same prompt (which is
+    itself pinned to generate())."""
+    model, params = _model(_unrolled)
+
+    def run(prefix_cache):
+        engine = InferenceEngine(
+            model, params,
+            EngineConfig(num_slots=4, num_blocks=24, block_size=4,
+                         prefill_chunk=8, prefix_cache=prefix_cache),
+        )
+        rng = np.random.default_rng(17)
+        shared = _prompt(rng, 12)  # 3 full blocks
+        prompts = [
+            np.concatenate([shared, _prompt(rng, 5)]),
+            np.concatenate([shared, _prompt(rng, 7)]),
+            shared.copy(),                 # prompt == cached prefix
+            np.concatenate([shared[:6], _prompt(rng, 4)]),  # diverges
+        ]
+        outs = []
+        for p in prompts:  # sequential: each run registers its blocks
+            rid = engine.submit(p, 8)
+            engine.run()
+            outs.append(engine.output_tokens(rid))
+        return engine, outs
+
+    warm_engine, warm = run(True)
+    _, cold = run(False)
+    assert warm_engine.prefix_hits >= 2
+    assert warm_engine.prefix_hit_tokens >= 12
+    warm_engine.allocator.check()
+    for w, c in zip(warm, cold):
+        np.testing.assert_array_equal(w, c)
+
+
+@pytest.mark.parametrize("cfg_fn", [_unrolled, _scanned],
+                         ids=["unrolled", "scanned"])
+@pytest.mark.parametrize("spec_k", [2, 5])
+def test_engine_spec_decode_greedy_parity(cfg_fn, spec_k, devices):
+    """Speculative decoding must be invisible: greedy outputs through
+    the (num_slots, k+1) verify program are bit-identical to
+    generate().  Early decodes reject most drafts (k > accepted, the
+    partial-accept path) while the looping tail accepts full windows
+    that cross block boundaries (block_size 4 < k+1 appends)."""
+    model, params = _model(cfg_fn)
+    engine = InferenceEngine(
+        model, params,
+        EngineConfig(num_slots=4, num_blocks=24, block_size=4,
+                     prefill_chunk=8, spec_k=spec_k),
+    )
+    rng = np.random.default_rng(23)
+    cases = [(3, 6), (8, 7), (16, 9), (4, 12)]
+    rids = {}
+    for plen, n_new in cases:
+        p = _prompt(rng, plen)
+        rids[engine.submit(p, n_new)] = (p, n_new)
+    while engine.has_work():
+        engine.step()
+        engine.allocator.check()
+    assert engine.spec_rows > 0
+    # Both regimes happened: some rejected drafts, some full accepts.
+    assert engine.spec_accepted < engine.spec_drafted + engine.spec_rows
     for rid, (p, n_new) in rids.items():
         want = np.asarray(
             generate(model, params, jnp.asarray(p)[None], n_new)
@@ -405,6 +574,75 @@ def test_virtual_clock_replay_is_identical(devices):
     assert out1 == out2
     assert out1["serve_tok_s"] > 0
     assert out1["serve_p50_ttft_s"] <= out1["serve_p99_ttft_s"]
+
+
+def test_make_trace_zipf_shared_prefix():
+    """Shared-prefix mode: every prompt starts with one of the pooled
+    prefixes, hot ranks dominate per the Zipf weights, and the whole
+    trace stays a pure function of the seed."""
+    cfg = LoadConfig(
+        rate_rps=80.0, duration_s=1.0, prompt_len=(10, 16),
+        output_len=(2, 4), vocab_size=97, seed=7,
+        prefix_pool=3, prefix_len=8, zipf_alpha=1.2,
+    )
+    t1, t2 = make_trace(cfg), make_trace(cfg)
+    assert len(t1) == len(t2) > 10
+    for r1, r2 in zip(t1, t2):
+        assert r1["arrival_s"] == r2["arrival_s"]
+        np.testing.assert_array_equal(r1["prompt"], r2["prompt"])
+    heads = {tuple(int(t) for t in r["prompt"][:8]) for r in t1}
+    assert 1 < len(heads) <= 3  # drawn from the 3-prefix pool
+    counts = sorted(
+        (sum(1 for r in t1
+             if tuple(int(t) for t in r["prompt"][:8]) == h)
+         for h in heads),
+        reverse=True,
+    )
+    assert counts[0] > counts[-1]  # Zipf skew: a hot prefix dominates
+    for r in t1:
+        assert len(r["prompt"]) >= 9  # prefix + >=1 suffix token
+    with pytest.raises(ValueError, match="prefix_len"):
+        make_trace(LoadConfig(prefix_pool=2))
+
+
+def test_virtual_clock_replay_is_identical_fastpath(devices):
+    """The fast path stays a pure function of (seed, config): with the
+    prefix cache AND speculation on, the same Zipf trace under a
+    VirtualClock reproduces every token, timestamp, and the summary —
+    including the prefix-hit and accept-length stats."""
+    model, params = _model(_unrolled)
+    trace = make_trace(LoadConfig(
+        rate_rps=60.0, duration_s=0.4, prompt_len=(10, 14),
+        output_len=(2, 8), vocab_size=97, seed=5,
+        prefix_pool=2, prefix_len=8, zipf_alpha=1.1,
+    ))
+    assert len(trace) >= 4
+
+    def once():
+        clock = VirtualClock(0.01)
+        engine = InferenceEngine(
+            model, params,
+            EngineConfig(num_slots=2, num_blocks=16, block_size=4,
+                         prefill_chunk=8, prefix_cache=True, spec_k=3),
+            time_fn=clock,
+        )
+        out = run_load(engine, trace, clock=clock)
+        tokens = {
+            rid: list(r.generated) for rid, r in engine.completed.items()
+        }
+        timing = {
+            rid: (r.admit_s, r.first_token_s, r.done_s, r.preemptions)
+            for rid, r in engine.completed.items()
+        }
+        engine.allocator.check()
+        return out, tokens, timing
+
+    out1, toks1, tm1 = once()
+    out2, toks2, tm2 = once()
+    assert out1["completed"] == len(trace)
+    assert toks1 == toks2 and tm1 == tm2 and out1 == out2
+    assert out1["prefix_hit_frac"] > 0
+    assert out1["spec_accept_mean"] > 0
 
 
 # ---------------------------------------------------------------------

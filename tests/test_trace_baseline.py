@@ -318,6 +318,39 @@ def test_bench_direction_suffix_inference():
     assert perf_gate._bench_direction("host_gather_img_s") == "higher"
     assert perf_gate._bench_direction("tokens_per_s") == "higher"
     assert perf_gate._bench_direction("gpt2_mfu") == "higher"
+    # Serving fast-path WIN shares: hit rate, avoided prefill FLOPs,
+    # and speedup ratio must beat _LOWER_BETTER's _frac$ / plain-name
+    # fallthrough — a cache that hits MORE must never gate as worse.
+    assert perf_gate._bench_direction("prefix_hit_frac") == "higher"
+    assert perf_gate._bench_direction(
+        "prefill_flops_avoided_frac") == "higher"
+    assert perf_gate._bench_direction("spec_tok_s_speedup") == "higher"
+    # ...without disturbing the waste-share neighbors
+    assert perf_gate._bench_direction("preempt_frac") == "lower"
+    assert perf_gate._bench_direction("serve_p99_ttft_s") == "lower"
+
+
+def test_perf_gate_fastpath_win_shares_gate_higher_better(tmp_path):
+    store = str(tmp_path / "runs")
+    base = tmp_path / "BENCH_fp_a.json"
+    base.write_text(json.dumps({"parsed": {"headline": {
+        "prefix_hit_frac": 0.60, "spec_tok_s_speedup": 1.8,
+    }}}))
+    assert perf_gate.main([str(base), "--store", store,
+                           "--baseline", "fp", "--update-baseline"]) == 0
+    # hit rate / speedup dropped -> regression; improved -> pass
+    worse = tmp_path / "BENCH_fp_b.json"
+    worse.write_text(json.dumps({"parsed": {"headline": {
+        "prefix_hit_frac": 0.30, "spec_tok_s_speedup": 1.8,
+    }}}))
+    assert perf_gate.main([str(worse), "--store", store,
+                           "--baseline", "fp"]) == perf_gate.REGRESS_EXIT
+    better = tmp_path / "BENCH_fp_c.json"
+    better.write_text(json.dumps({"parsed": {"headline": {
+        "prefix_hit_frac": 0.75, "spec_tok_s_speedup": 2.1,
+    }}}))
+    assert perf_gate.main([str(better), "--store", store,
+                           "--baseline", "fp"]) == 0
 
 
 def test_perf_gate_zb_bubble_gates_lower_better(tmp_path):
