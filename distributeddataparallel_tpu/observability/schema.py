@@ -93,6 +93,16 @@ EVENT_KINDS: dict[str, tuple[str, ...]] = {
     # proposed across the slot batch, `accepted` emitted).
     "prefix_hit": ("req", "tokens"),
     "spec_verify": ("step", "drafted", "accepted"),
+    # Serving fleet (serving/fleet + serving/router): one routing
+    # decision per request (`engine` = decode owner, `prefill` = None on
+    # a session-affinity hit), one record per completed KV-block handoff
+    # prefill→decode (`bytes` on the wire, `attempts` > 1 means digest
+    # NAK + resend), the drain/fail rung when an engine dies (the
+    # serving `gang_verdict`), and one per-tier latency rollup per run.
+    "route_admit": ("req", "engine"),
+    "kv_handoff": ("req", "blocks", "bytes"),
+    "engine_verdict": ("engine", "rung"),
+    "tier_summary": ("tier", "completed"),
     # Autotuner (tuning/): one record per candidate config (status =
     # pruned-memory / pruned-cost / baseline / measured / error: ...)
     # and one per search or apply outcome (winner = trial label or None).

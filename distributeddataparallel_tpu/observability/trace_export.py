@@ -42,6 +42,9 @@ _COUNTER_KINDS = {
     # accept-length track dropping toward n_active means drafts stopped
     # landing.
     "spec_verify": ("spec_accepted", "accepted"),
+    # Serving fleet: bytes shipped per prefill->decode KV-block handoff
+    # — spikes line up with prefill-tier completions on the span tracks.
+    "kv_handoff": ("handoff_bytes", "bytes"),
 }
 
 #: kinds rendered as instant events (fields worth carrying into args)
@@ -60,6 +63,9 @@ _INSTANT_KINDS = {
     "request_done": ("req", "ttft_s", "tokens", "latency_s"),
     "kv_evict": ("blocks", "req", "reason"),
     "prefix_hit": ("req", "tokens", "ctx"),
+    # Serving fleet: routing decisions and engine-death verdicts.
+    "route_admit": ("req", "engine", "prefill", "affinity", "session"),
+    "engine_verdict": ("engine", "rung", "tier", "requeued", "reason"),
 }
 
 SUPERVISOR_PID = 0
@@ -177,6 +183,21 @@ def to_trace_events(records: list[dict]) -> dict:
                 "s": "g" if pid == SUPERVISOR_PID else "p",
                 "args": _args(rec, _INSTANT_KINDS[kind]),
             })
+            # route_admit carries the router's queue depth: double it
+            # into a counter track (like step spans double as step_s).
+            if kind == "route_admit" and isinstance(
+                rec.get("queue_depth"), (int, float)
+            ):
+                events.append({
+                    "ph": "C",
+                    "name": "router_queue",
+                    "pid": pid,
+                    "tid": 0,
+                    "ts": us(float(ts)),
+                    "args": {
+                        "router_queue": float(rec["queue_depth"])
+                    },
+                })
 
     # Per-track monotonic order (viewers require ts-sorted streams per
     # track; a global ts sort gives that and keeps the file diffable).

@@ -9,10 +9,18 @@ that compiles at most three device programs — one decode step over the
 fixed slot batch, one prefill chunk, one speculative verify window —
 and drives them per scheduler step (``engine``), and a seeded Poisson
 open-loop load generator with an optional Zipf shared-prefix trace mode
-(``loadgen``).  The serving fast path layers a refcounted radix prefix
-cache (shared KV blocks, copy-on-write) and n-gram speculative decoding
-on top, both bitwise-pinned against the plain paths.
-``scripts/ddp_serve.py`` is the CLI.
+and multi-turn sessions (``loadgen``).  The serving fast path layers a
+refcounted radix prefix cache (shared KV blocks, copy-on-write) and
+n-gram speculative decoding on top, both bitwise-pinned against the
+plain paths.
+
+The fleet layer disaggregates prefill from decode: ``handoff`` moves a
+finished prefill's KV blocks between engines (digest-verified frames
+over in-memory pipes or TCP), ``router`` is the stdlib session-affinity
+front door (least-loaded admission, heartbeat health, drain-and-requeue
+on engine death), and ``fleet`` wires P prefill + D decode engines
+behind one router — in-process (deterministic) or one process per
+engine.  ``scripts/ddp_serve.py`` is the CLI (``--fleet P:D``).
 """
 
 from distributeddataparallel_tpu.serving.kv_cache import (  # noqa: F401
@@ -26,6 +34,8 @@ from distributeddataparallel_tpu.serving.kv_cache import (  # noqa: F401
     scatter_decode,
     scatter_prefill,
     scatter_spec,
+    set_pool_block,
+    set_pool_blocks,
 )
 from distributeddataparallel_tpu.serving.scheduler import (  # noqa: F401
     Request,
@@ -41,4 +51,25 @@ from distributeddataparallel_tpu.serving.loadgen import (  # noqa: F401
     VirtualClock,
     make_trace,
     run_load,
+)
+from distributeddataparallel_tpu.serving.handoff import (  # noqa: F401
+    HandoffError,
+    HandoffPayload,
+    HandoffReceiver,
+    HandoffSender,
+    PipeChannel,
+    SocketChannel,
+    block_nbytes,
+    extract_kv_blocks,
+)
+from distributeddataparallel_tpu.serving.router import (  # noqa: F401
+    Router,
+    RouterError,
+    root_block_hash,
+)
+from distributeddataparallel_tpu.serving.fleet import (  # noqa: F401
+    FleetConfig,
+    FleetService,
+    ServingFleet,
+    fleet_worker,
 )

@@ -56,12 +56,19 @@ from __future__ import annotations
 
 import dataclasses
 import time
+from collections import deque
 from typing import Any
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from distributeddataparallel_tpu.serving.handoff import (
+    HandoffError,
+    HandoffPayload,
+    extract_kv_blocks,
+    unpack_block_rows,
+)
 from distributeddataparallel_tpu.serving.kv_cache import (
     SCRATCH_BLOCK,
     BlockAllocator,
@@ -71,6 +78,7 @@ from distributeddataparallel_tpu.serving.kv_cache import (
     scatter_decode,
     scatter_prefill,
     scatter_spec,
+    set_pool_blocks,
 )
 from distributeddataparallel_tpu.serving.scheduler import (
     Request,
@@ -140,6 +148,12 @@ class InferenceEngine:
         self._step_idx = 0
         self._next_rid = 0
         self.completed: dict[int, Request] = {}
+        # Handed-off sequences waiting for a free slot + pool space;
+        # drained at each step() start (and at inject time).
+        self._pending_injections: deque[tuple[Request, HandoffPayload]] = (
+            deque()
+        )
+        self.handoffs_in = 0
 
         quantized = config.quantize_weights
         if quantized:
@@ -264,6 +278,13 @@ class InferenceEngine:
         )
         # Copy-on-write: one-block pool copy, pool donated (in-place).
         self._copy_prog = jax.jit(copy_pool_block, donate_argnums=(0,))
+        # KV handoff landing: ALL of a payload's blocks scattered in
+        # one dispatch (pool donated).  Compiles once per distinct
+        # block count, which the jit cache absorbs after the first few
+        # request shapes.
+        self._set_blocks_prog = jax.jit(
+            set_pool_blocks, donate_argnums=(0,)
+        )
         if config.store_dir:
             self._wire_warm_start(model)
 
@@ -334,7 +355,12 @@ class InferenceEngine:
 
     # -- intake -------------------------------------------------------
     def submit(
-        self, prompt, max_new_tokens: int, *, arrival_s: float | None = None
+        self,
+        prompt,
+        max_new_tokens: int,
+        *,
+        arrival_s: float | None = None,
+        session=None,
     ) -> int:
         rid = self._next_rid
         self._next_rid += 1
@@ -345,12 +371,134 @@ class InferenceEngine:
             arrival_s=(
                 self._time() if arrival_s is None else float(arrival_s)
             ),
+            session=session,
         )
         self.scheduler.submit(req)
         return rid
 
     def has_work(self) -> bool:
-        return self.scheduler.has_work()
+        return bool(self._pending_injections) or self.scheduler.has_work()
+
+    # -- KV handoff (disaggregated prefill/decode, serving.fleet) -----
+    def extract_handoff(
+        self, rid: int, *, max_new_tokens: int | None = None
+    ) -> HandoffPayload:
+        """Pull a just-completed request's context KV off this engine as
+        a :class:`HandoffPayload` for a decode-tier peer.
+
+        Contract: call between the ``step()`` that completed ``rid``
+        and this engine's NEXT ``step()`` — the retired blocks keep
+        their content until a later plan reclaims them under allocation
+        pressure, which only happens inside ``plan_step``.  The request
+        leaves ``self.completed`` (the decode tier owns it from here).
+        ``max_new_tokens`` overrides the shipped budget: a prefill-tier
+        engine runs the request at ``max_new_tokens=1`` and restores
+        the fleet-level budget here.
+        """
+        req = self.completed.pop(rid)
+        meta = {
+            "rid": rid,
+            "session": req.session,
+            "prompt": [int(t) for t in req.prompt],
+            "generated": [int(t) for t in req.generated],
+            "max_new_tokens": int(max_new_tokens or req.max_new_tokens),
+            "arrival_s": req.arrival_s,
+            "first_token_s": req.first_token_s,
+            "ctx_len": req.ctx_len,
+        }
+        return HandoffPayload(
+            meta, extract_kv_blocks(self.pool, req.final_blocks)
+        )
+
+    def inject_handoff(self, payload: HandoffPayload) -> int:
+        """Adopt a handed-off sequence: allocate a fresh table, land
+        the shipped blocks bitwise (``set_pool_blocks``, pool donated),
+        and place the request straight into a decode slot.  Queued when
+        slots/pool are full; the queue drains here and at each
+        ``step()`` start, so a busy decode tier backpressures instead
+        of dropping."""
+        meta = payload.meta
+        rid = self._next_rid
+        self._next_rid += 1
+        req = Request(
+            rid=rid,
+            prompt=np.asarray(meta["prompt"], np.int32),
+            max_new_tokens=int(meta["max_new_tokens"]),
+            arrival_s=float(meta.get("arrival_s") or 0.0),
+            session=meta.get("session"),
+        )
+        req.generated = [int(t) for t in meta.get("generated") or ()]
+        req.first_token_s = meta.get("first_token_s")
+        req.handoff = True
+        if not req.generated:
+            raise HandoffError(
+                f"handoff for rid {meta.get('rid')!r} carries no "
+                "pending token (prefill tier must generate one)"
+            )
+        sched = self.scheduler
+        total = req.prompt_len + req.max_new_tokens
+        if total > sched.max_seq_len:
+            raise HandoffError(
+                f"handoff request {rid}: prompt {req.prompt_len} + "
+                f"budget {req.max_new_tokens} exceeds max_seq_len "
+                f"{sched.max_seq_len}"
+            )
+        want = self.allocator.blocks_for(req.ctx_len)
+        if want != len(payload.blocks):
+            raise HandoffError(
+                f"handoff request {rid}: ctx {req.ctx_len} needs "
+                f"{want} blocks, payload ships {len(payload.blocks)}"
+            )
+        self._pending_injections.append((req, payload))
+        self._drain_injections()
+        return rid
+
+    def _drain_injections(self) -> None:
+        sched = self.scheduler
+        while self._pending_injections:
+            req, payload = self._pending_injections[0]
+            tokens = min(
+                req.ctx_len + 1 + sched.lookahead, sched.max_seq_len
+            )
+            if not sched.can_adopt(tokens):
+                break
+            self._pending_injections.popleft()
+            for rid_, blocks in self.allocator.alloc(req.rid, tokens):
+                self.emit(
+                    "kv_evict", blocks=blocks, req=rid_, reason="lru"
+                )
+            table = self.allocator.table_of(req.rid)
+            rows = [
+                unpack_block_rows(self.pool, data)
+                for data in payload.blocks
+            ]
+            self.pool = self._set_blocks_prog(
+                self.pool,
+                jax.tree.map(lambda *rs: np.stack(rs), *rows),
+                jnp.asarray(table[: len(rows)], jnp.int32),
+            )
+            req.prefilled = req.ctx_len
+            sched.adopt(req)
+            req.admit_s = self._time()
+            self.handoffs_in += 1
+            self.emit(
+                "request_admit",
+                req=req.rid,
+                prompt_tokens=req.prompt_len,
+                ctx_tokens=req.ctx_len,
+                slot=req.slot,
+                queued_s=req.admit_s - req.arrival_s,
+                handoff=True,
+            )
+            if self.config.prefix_cache:
+                # Publish the landed context into the prefix trie so
+                # session-affinity follow-ups hit it like any local
+                # prefill would.
+                self.prefix_admits += 1
+                self.prefix_ctx_tokens += req.ctx_len
+                self.allocator.register_progress(
+                    req.rid, req.ctx_tokens(), upto=req.ctx_len
+                )
 
     # -- telemetry helpers --------------------------------------------
     def emit(self, kind: str, **fields) -> None:
@@ -366,6 +514,14 @@ class InferenceEngine:
 
     def _finish(self, req: Request) -> None:
         req.done_s = self._time()
+        # Snapshot the context blocks before retire() drops the table —
+        # a fleet's prefill tier ships exactly these (rows [0, ctx_len)
+        # hold finalized KV; the pending token's row is unwritten).
+        req.final_blocks = tuple(
+            self.allocator.table_of(req.rid)[
+                : self.allocator.blocks_for(req.ctx_len)
+            ]
+        )
         retired = self.scheduler.finish(req)
         self.completed[req.rid] = req
         ttft = (req.first_token_s or req.done_s) - req.arrival_s
@@ -434,6 +590,7 @@ class InferenceEngine:
     # -- the step -----------------------------------------------------
     def step(self) -> dict:
         """Execute one scheduler plan; returns host-side step stats."""
+        self._drain_injections()
         plan = self.scheduler.plan_step()
         for rid, blocks in plan.evicted:
             self.emit("kv_evict", blocks=blocks, req=rid, reason="lru")

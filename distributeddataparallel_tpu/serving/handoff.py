@@ -1,0 +1,416 @@
+"""KV-block handoff between a prefill-tier and a decode-tier engine.
+
+The disaggregated fleet (``serving.fleet``) runs chunked prefill to
+completion on one engine, then ships the sequence's KV blocks to a
+decode engine that owns the token stream from there on.  This module is
+the wire layer of that move:
+
+- **extraction** — one host-side ``device_get`` of exactly the blocks
+  the sequence owns (``extract_kv_blocks``), serialized leaf-by-leaf in
+  deterministic pytree order so the receiving pool (same model, same
+  block size) can rebuild rows bitwise (``unpack_block_rows`` feeds
+  ``kv_cache.set_pool_block``);
+- **framing** — length-prefixed frames over either an in-memory
+  ``PipeChannel`` pair (deterministic single-process fleets, tests,
+  bench) or a ``SocketChannel`` over TCP with the PR 16 ``RetryPolicy``
+  backoff on connect (``ddp_serve --fleet`` multi-process mode);
+- **integrity** — a per-block sha256 digest rides in the header frame;
+  the receiver NAKs the indices that fail verification and the sender
+  re-ships only those blocks (re-handoff), so a corrupted frame costs a
+  retry, never silent divergence of the decode stream.
+
+Sender and receiver are poll-driven state machines — no thread blocks
+waiting for an ACK — so the same protocol runs synchronously inside one
+process (offer → pump both ends until drained) and asynchronously
+across processes (each engine loop polls its channels once per step).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import math
+import select
+import socket
+import struct
+from collections import deque
+from typing import Any, Callable
+
+import jax
+import numpy as np
+
+from distributeddataparallel_tpu.runtime.rendezvous import (
+    RetryPolicy,
+    retry_call,
+)
+from distributeddataparallel_tpu.serving.kv_cache import _is_qkv
+
+Pytree = Any
+
+#: Digest-mismatch redelivery budget per handoff before the sender gives
+#: up — a link that corrupts four attempts in a row is dead, not noisy.
+MAX_ATTEMPTS = 4
+
+_LEN = struct.Struct(">I")
+
+
+class HandoffError(RuntimeError):
+    """A handoff could not be completed (redelivery budget exhausted or
+    a protocol frame arrived out of order)."""
+
+
+def block_digest(data: bytes) -> str:
+    """Integrity digest of one block's wire bytes (truncated sha256 —
+    collision resistance is irrelevant, corruption detection is not)."""
+    return hashlib.sha256(data).hexdigest()[:16]
+
+
+# ---------------------------------------------------------------------------
+# Host-side block serialization
+# ---------------------------------------------------------------------------
+
+
+def _leaf_arrays(pool: Pytree) -> list:
+    """Pool leaves as a flat array list in deterministic pytree order,
+    int8 q/scale dicts expanded q-then-scale."""
+    arrs = []
+    for leaf in jax.tree.leaves(pool, is_leaf=_is_qkv):
+        if _is_qkv(leaf):
+            arrs.append(leaf["q"])
+            arrs.append(leaf["scale"])
+        else:
+            arrs.append(leaf)
+    return arrs
+
+
+def _block_shape(a) -> tuple:
+    """Shape of one block's rows within leaf ``a``: the pool's block
+    axis dropped, layer axis (scanned leaves) kept leading."""
+    if a.ndim == 4:  # (N, bs, H, D)
+        return tuple(a.shape[1:])
+    return (a.shape[0],) + tuple(a.shape[2:])  # (L, N, bs, H, D)
+
+
+def block_nbytes(pool: Pytree) -> int:
+    """Wire bytes of one block across every pool leaf — the unit MEMFIT
+    sizes the transient host-side handoff buffer with."""
+    return sum(
+        math.prod(_block_shape(a)) * a.dtype.itemsize
+        for a in _leaf_arrays(pool)
+    )
+
+
+def extract_kv_blocks(pool: Pytree, block_ids) -> list[bytes]:
+    """Pull exactly ``block_ids`` out of the device pool as per-block
+    wire bytes.  One gather + ``device_get`` per leaf, not per block —
+    the host copy is the whole transfer cost of a handoff."""
+    ids = np.asarray(list(block_ids), np.int32)
+    hosts = []
+    for a in _leaf_arrays(pool):
+        if a.ndim == 4:
+            hosts.append(np.asarray(jax.device_get(a[ids])))
+        else:  # (L, N, bs, H, D) → block-major (n, L, bs, H, D)
+            g = np.asarray(jax.device_get(a[:, ids]))
+            hosts.append(np.ascontiguousarray(np.moveaxis(g, 1, 0)))
+    return [
+        b"".join(h[i].tobytes() for h in hosts) for i in range(len(ids))
+    ]
+
+
+def unpack_block_rows(pool: Pytree, data: bytes) -> Pytree:
+    """Rebuild the ``rows`` pytree ``kv_cache.set_pool_block`` expects
+    from one block's wire bytes, using the *receiving* pool's leaf
+    shapes and dtypes (both tiers run the same model config)."""
+    off = 0
+
+    def cut(a):
+        nonlocal off
+        shape = _block_shape(a)
+        count = math.prod(shape)
+        arr = np.frombuffer(
+            data, dtype=a.dtype, count=count, offset=off
+        ).reshape(shape)
+        off += count * a.dtype.itemsize
+        return arr
+
+    def one(leaf):
+        if _is_qkv(leaf):
+            return {"q": cut(leaf["q"]), "scale": cut(leaf["scale"])}
+        return cut(leaf)
+
+    rows = jax.tree.map(one, pool, is_leaf=_is_qkv)
+    if off != len(data):
+        raise HandoffError(
+            f"handoff block size mismatch: {len(data)} wire bytes for a "
+            f"{off}-byte pool block (tier configs differ?)"
+        )
+    return rows
+
+
+# ---------------------------------------------------------------------------
+# Channels: framed byte transport
+# ---------------------------------------------------------------------------
+
+
+class PipeChannel:
+    """In-memory framed channel — one direction of a ``pair()``.
+
+    Deterministic and buffer-unbounded, so a single-process fleet can
+    push a whole handoff and pump the receiving end in the same step
+    without OS socket buffering in the loop.
+    """
+
+    def __init__(self):
+        self._rx: deque[bytes] = deque()
+        self._peer: PipeChannel | None = None
+        self.closed = False
+
+    @classmethod
+    def pair(cls) -> tuple["PipeChannel", "PipeChannel"]:
+        a, b = cls(), cls()
+        a._peer, b._peer = b, a
+        return a, b
+
+    def send(self, frame: bytes) -> None:
+        if self.closed or self._peer is None or self._peer.closed:
+            raise ConnectionError("pipe channel closed")
+        self._peer._rx.append(bytes(frame))
+
+    def try_recv(self) -> bytes | None:
+        return self._rx.popleft() if self._rx else None
+
+    def close(self) -> None:
+        self.closed = True
+
+
+class SocketChannel:
+    """Length-prefixed frames over a connected TCP socket.
+
+    Reads are non-blocking (``select`` + reassembly buffer) so an engine
+    loop can poll between scheduler steps; writes use ``sendall`` —
+    handoff frames are at most a few hundred KiB (see MEMFIT.md).
+    """
+
+    def __init__(self, sock: socket.socket):
+        sock.setblocking(True)
+        sock.settimeout(None)
+        self._sock = sock
+        self._buf = bytearray()
+
+    @classmethod
+    def connect(
+        cls, addr, *, policy: RetryPolicy | None = None
+    ) -> "SocketChannel":
+        sock = retry_call(
+            lambda: socket.create_connection(tuple(addr), timeout=5.0),
+            policy=policy or RetryPolicy(attempts=6, base_s=0.1, max_s=1.0),
+        )
+        return cls(sock)
+
+    def send(self, frame: bytes) -> None:
+        self._sock.sendall(_LEN.pack(len(frame)) + frame)
+
+    def try_recv(self) -> bytes | None:
+        while True:
+            r, _, _ = select.select([self._sock], [], [], 0)
+            if not r:
+                break
+            chunk = self._sock.recv(1 << 16)
+            if not chunk:
+                raise ConnectionError("handoff peer closed")
+            self._buf += chunk
+        if len(self._buf) >= _LEN.size:
+            (n,) = _LEN.unpack_from(self._buf)
+            if len(self._buf) >= _LEN.size + n:
+                frame = bytes(self._buf[_LEN.size:_LEN.size + n])
+                del self._buf[:_LEN.size + n]
+                return frame
+        return None
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+def _json_frame(msg: dict) -> bytes:
+    return json.dumps(msg, separators=(",", ":")).encode()
+
+
+# ---------------------------------------------------------------------------
+# Payload + sender/receiver state machines
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class HandoffPayload:
+    """One sequence's KV move: JSON-safe request metadata (prompt,
+    first sampled token, remaining budget, timing) plus the raw block
+    bytes in table order."""
+
+    meta: dict
+    blocks: list[bytes]
+
+    @property
+    def nbytes(self) -> int:
+        return sum(len(b) for b in self.blocks)
+
+
+class HandoffSender:
+    """Prefill-tier end: ``offer()`` ships header + block frames,
+    ``poll()`` consumes ACK/NAK frames, re-shipping NAKed blocks until
+    the redelivery budget runs out."""
+
+    def __init__(
+        self,
+        channel,
+        *,
+        max_attempts: int = MAX_ATTEMPTS,
+        time_fn: Callable[[], float] | None = None,
+    ):
+        import time as _time
+
+        self._chan = channel
+        self._max_attempts = int(max_attempts)
+        self._time = time_fn or _time.monotonic
+        self._pending: dict[int, list] = {}  # hid -> [payload, t0, tries]
+        self._next_hid = 0
+        self.offered = 0
+        self.redelivered_blocks = 0
+
+    @property
+    def in_flight(self) -> int:
+        return len(self._pending)
+
+    def abort_all(self) -> list[dict]:
+        """Drop every in-flight handoff (the peer died mid-transfer);
+        returns their metas so the caller can requeue the requests."""
+        metas = [entry[0].meta for entry in self._pending.values()]
+        self._pending.clear()
+        return metas
+
+    def offer(self, payload: HandoffPayload) -> int:
+        hid = self._next_hid
+        self._next_hid += 1
+        header = {
+            "kind": "handoff",
+            "hid": hid,
+            "meta": payload.meta,
+            "digests": [block_digest(b) for b in payload.blocks],
+        }
+        self._chan.send(_json_frame(header))
+        for b in payload.blocks:
+            self._chan.send(b)
+        self._pending[hid] = [payload, self._time(), 1]
+        self.offered += 1
+        return hid
+
+    def poll(self) -> list[dict]:
+        """Drain ACK/NAK frames; returns a record per completed handoff
+        (``hid``/``meta``/``blocks``/``bytes``/``attempts``/
+        ``handoff_s``)."""
+        done = []
+        while True:
+            frame = self._chan.try_recv()
+            if frame is None:
+                break
+            msg = json.loads(frame)
+            if msg.get("kind") != "ack" or msg.get("hid") not in self._pending:
+                raise HandoffError(f"unexpected sender frame: {msg!r}")
+            hid = msg["hid"]
+            payload, t0, tries = self._pending[hid]
+            bad = msg.get("bad") or []
+            if bad:
+                if tries >= self._max_attempts:
+                    del self._pending[hid]
+                    raise HandoffError(
+                        f"handoff {hid}: {len(bad)} blocks still corrupt "
+                        f"after {tries} attempts"
+                    )
+                self._chan.send(
+                    _json_frame(
+                        {"kind": "resend", "hid": hid, "indices": bad}
+                    )
+                )
+                for i in bad:
+                    self._chan.send(payload.blocks[i])
+                self._pending[hid][2] = tries + 1
+                self.redelivered_blocks += len(bad)
+            else:
+                del self._pending[hid]
+                done.append({
+                    "hid": hid,
+                    "meta": payload.meta,
+                    "blocks": len(payload.blocks),
+                    "bytes": payload.nbytes,
+                    "attempts": tries,
+                    "handoff_s": self._time() - t0,
+                })
+        return done
+
+
+class HandoffReceiver:
+    """Decode-tier end: ``poll()`` reassembles header + block frames,
+    verifies every block digest, NAKs the bad indices, and yields fully
+    verified payloads ready for injection."""
+
+    def __init__(self, channel):
+        self._chan = channel
+        # hid currently streaming block frames: [hid, expected indices, at]
+        self._cursor: list | None = None
+        self._inflight: dict[int, dict] = {}
+        self.received = 0
+        self.rejected_blocks = 0
+
+    def poll(self) -> list[HandoffPayload]:
+        out = []
+        while True:
+            frame = self._chan.try_recv()
+            if frame is None:
+                break
+            if self._cursor is None:
+                msg = json.loads(frame)
+                hid = msg.get("hid")
+                if msg.get("kind") == "handoff":
+                    self._inflight[hid] = {
+                        "meta": msg["meta"],
+                        "digests": msg["digests"],
+                        "blocks": [None] * len(msg["digests"]),
+                    }
+                    want = list(range(len(msg["digests"])))
+                elif msg.get("kind") == "resend" and hid in self._inflight:
+                    want = list(msg["indices"])
+                else:
+                    raise HandoffError(
+                        f"unexpected receiver frame: {msg!r}"
+                    )
+                self._cursor = [hid, want, 0] if want else None
+                if not want:
+                    out.extend(self._verify(hid))
+            else:
+                hid, want, at = self._cursor
+                self._inflight[hid]["blocks"][want[at]] = frame
+                self._cursor[2] = at + 1
+                if self._cursor[2] == len(want):
+                    self._cursor = None
+                    out.extend(self._verify(hid))
+        return out
+
+    def _verify(self, hid: int) -> list[HandoffPayload]:
+        entry = self._inflight[hid]
+        bad = [
+            i
+            for i, (b, d) in enumerate(
+                zip(entry["blocks"], entry["digests"])
+            )
+            if b is None or block_digest(b) != d
+        ]
+        self._chan.send(_json_frame({"kind": "ack", "hid": hid, "bad": bad}))
+        if bad:
+            self.rejected_blocks += len(bad)
+            return []
+        del self._inflight[hid]
+        self.received += 1
+        return [HandoffPayload(entry["meta"], entry["blocks"])]

@@ -337,6 +337,60 @@ def copy_pool_block(pool: Pytree, src, dst) -> Pytree:
     return jax.tree.map(q_or_plain, pool, is_leaf=_is_qkv)
 
 
+def set_pool_block(pool: Pytree, rows: Pytree, dst) -> Pytree:
+    """Write one block's worth of rows into physical block ``dst``
+    across every leaf — the device half of a prefill→decode KV handoff
+    (``serving.handoff`` moves the bytes between hosts, this lands
+    them).  ``rows`` mirrors the pool pytree with the block axis
+    dropped: ``(bs, H, D)`` leaves (scanned: ``(L, bs, H, D)``), int8
+    leaves as q/scale dicts — raw pool content, never re-quantized, so
+    a handed-off block stays bitwise identical to the source pool's.
+    """
+
+    def one(pl, rw):
+        if pl.ndim == 4:  # (N, bs, H, D)
+            return pl.at[dst].set(rw)
+        return pl.at[:, dst].set(rw)  # (L, N, bs, H, D)
+
+    def q_or_plain(pl, rw):
+        if _is_qkv(pl):
+            return {
+                "q": one(pl["q"], rw["q"]),
+                "scale": one(pl["scale"], rw["scale"]),
+            }
+        return one(pl, rw)
+
+    return jax.tree.map(q_or_plain, pool, rows, is_leaf=_is_qkv)
+
+
+def set_pool_blocks(pool: Pytree, rows: Pytree, dst) -> Pytree:
+    """Batched :func:`set_pool_block`: land ``n`` handed-off blocks in
+    ONE scatter per leaf.  ``rows`` mirrors the pool pytree with a
+    leading block axis — ``(n, bs, H, D)`` leaves (scanned:
+    ``(n, L, bs, H, D)``) — and ``dst`` is the ``(n,)`` int32 vector of
+    physical destinations.  One dispatch per handoff instead of one per
+    block matters because every per-block call is a full-pool
+    functional update; at a dozen blocks per request the per-block form
+    dominates injection cost.
+    """
+
+    def one(pl, rw):
+        if pl.ndim == 4:  # (N, bs, H, D), rows (n, bs, H, D)
+            return pl.at[dst].set(rw)
+        # (L, N, bs, H, D), rows (n, L, bs, H, D) -> (L, n, bs, H, D)
+        return pl.at[:, dst].set(jnp.moveaxis(rw, 0, 1))
+
+    def q_or_plain(pl, rw):
+        if _is_qkv(pl):
+            return {
+                "q": one(pl["q"], rw["q"]),
+                "scale": one(pl["scale"], rw["scale"]),
+            }
+        return one(pl, rw)
+
+    return jax.tree.map(q_or_plain, pool, rows, is_leaf=_is_qkv)
+
+
 #: FNV-1a 64-bit offset basis — the rolling-hash seed for the trie root.
 _ROOT_HASH = 0xCBF29CE484222325
 

@@ -55,6 +55,17 @@ class LoadConfig:
     prefix_pool: int = 0     # 0 = plain random prompts
     prefix_len: int = 0      # shared-prefix tokens per pooled prefix
     zipf_alpha: float = 1.1  # rank-weight exponent over the pool
+    # Multi-turn sessions (turns > 1): each base request seeds a
+    # session; follow-up turns arrive ~turn_gap_s later (exponential)
+    # with a prompt that EXTENDS the prior turn's prompt by a uniform
+    # [lo, hi] draw of fresh tokens — the trace shape that exercises
+    # router session affinity and cross-request prefix reuse.  The
+    # follow-up stream draws from its own seeded rng AFTER the base
+    # trace is built, so turns == 1 traces stay bitwise identical to
+    # pre-multi-turn ones (replay pinning).
+    turns: int = 1
+    turn_gap_s: float = 0.25
+    turn_tokens: tuple[int, int] = (4, 12)
 
 
 def make_trace(cfg: LoadConfig) -> list[dict]:
@@ -102,7 +113,53 @@ def make_trace(cfg: LoadConfig) -> list[dict]:
             "prompt": prompt,
             "max_new_tokens": int(rng.integers(o_lo, o_hi + 1)),
         })
+    if cfg.turns > 1:
+        trace = _add_turns(cfg, trace)
     return trace
+
+
+def _add_turns(cfg: LoadConfig, base: list[dict]) -> list[dict]:
+    """Expand each base request into a ``cfg.turns``-turn session.
+
+    Follow-up prompts are strict extensions of the prior turn's prompt
+    (turn t's prompt is a prefix of turn t+1's), which is exactly what
+    makes a session's first KV block content-stable — the router's
+    affinity key — and its full context a radix-trie hit on the engine
+    that served the previous turn.  Uses an independent rng seeded off
+    ``(seed, salt)`` so the base trace's draws are untouched.
+    """
+    if cfg.turn_gap_s <= 0:
+        raise ValueError("turn_gap_s must be positive")
+    lo, hi = cfg.turn_tokens
+    if not 1 <= lo <= hi:
+        raise ValueError(f"turn_tokens must be 1 <= lo <= hi, got {lo, hi}")
+    rng = np.random.default_rng([cfg.seed, 0x7A95])
+    out: list[dict] = []
+    for i, r in enumerate(base):
+        sid = f"s{i}"
+        out.append({**r, "session": sid, "turn": 0})
+        t = r["arrival_s"]
+        prompt = r["prompt"]
+        for turn in range(1, cfg.turns):
+            t += float(rng.exponential(cfg.turn_gap_s))
+            prompt = np.concatenate([
+                prompt,
+                rng.integers(
+                    0, cfg.vocab_size,
+                    int(rng.integers(lo, hi + 1)), dtype=np.int32,
+                ),
+            ])
+            out.append({
+                "arrival_s": t,
+                "prompt": prompt,
+                "max_new_tokens": int(
+                    rng.integers(cfg.output_len[0], cfg.output_len[1] + 1)
+                ),
+                "session": sid,
+                "turn": turn,
+            })
+    out.sort(key=lambda r: r["arrival_s"])
+    return out
 
 
 class VirtualClock:
@@ -148,11 +205,16 @@ def run_load(
             # The engine stamps TTFT/latency with ITS clock: translate
             # the trace-relative arrival into that domain (monotonic
             # absolute on the wall clock, as-is on the virtual one).
+            kw = (
+                {"session": r["session"]} if r.get("session") is not None
+                else {}
+            )
             engine.submit(
                 r["prompt"], r["max_new_tokens"],
                 arrival_s=(
                     t0 + r["arrival_s"] if wall else r["arrival_s"]
                 ),
+                **kw,
             )
             i += 1
         if engine.has_work():
@@ -166,7 +228,12 @@ def run_load(
             raise RuntimeError(
                 f"load did not drain within {max_steps} iterations"
             )
-    return summary(engine, wall_elapsed_s=now() if wall else clock())
+    elapsed = now() if wall else clock()
+    # Fleets fold per-tier stats into their own summary; single engines
+    # use the module-level one.
+    if hasattr(engine, "summary"):
+        return engine.summary(wall_elapsed_s=elapsed)
+    return summary(engine, wall_elapsed_s=elapsed)
 
 
 def _pct(values, q: float) -> float:

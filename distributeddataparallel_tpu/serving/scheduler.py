@@ -49,6 +49,8 @@ class Request:
     prompt: np.ndarray  # (P,) int32
     max_new_tokens: int
     arrival_s: float = 0.0
+    #: Multi-turn session id (router affinity key); None for one-shots.
+    session: Any = None
 
     # Progress (scheduler/engine mutate):
     generated: list[int] = dataclasses.field(default_factory=list)
@@ -59,6 +61,13 @@ class Request:
     done_s: float | None = None
     preemptions: int = 0
     prefix_hit_tokens: int = 0  # context tokens served from the cache
+    #: Snapshot of the table's context blocks, stashed at finish time —
+    #: what a prefill-tier engine ships in a KV handoff (the live table
+    #: is gone once the allocator retires the sequence).
+    final_blocks: tuple = ()
+    #: True when this request's context KV arrived via a prefill→decode
+    #: handoff instead of local prefill.
+    handoff: bool = False
 
     def __post_init__(self):
         self.prompt = np.asarray(self.prompt, np.int32).reshape(-1)
@@ -291,6 +300,30 @@ class Scheduler:
         # 4) decode everyone still running.
         decode = [self.running[s] for s in sorted(self.running)]
         return StepPlan(admitted, chunks, decode, preempted, evicted, cow)
+
+    def can_adopt(self, tokens: int) -> bool:
+        """True when a handed-off sequence covering ``tokens`` could be
+        placed right now: a decode slot is free and the allocator can
+        cover a fresh table (evicting retired/cached blocks if needed).
+        """
+        return bool(self._free_slots) and self.alloc.can_alloc(tokens)
+
+    def adopt(self, req: Request) -> None:
+        """Place a handed-off request straight into a decode slot,
+        skipping waiting/prefilling entirely — its context KV was
+        injected by the engine (``serving.handoff``), so the caller has
+        already allocated the table and set ``prefilled``/``generated``.
+        """
+        if not self._free_slots:
+            raise RuntimeError("adopt() with no free slot")
+        if req.prefilled < req.ctx_len or not req.generated:
+            raise ValueError(
+                f"request {req.rid}: adopt() needs fully-resident "
+                f"context and a pending token (prefilled "
+                f"{req.prefilled} / ctx {req.ctx_len})"
+            )
+        req.slot = self._free_slots.pop()
+        self.running[req.slot] = req
 
     # -- transitions (engine drives these) ----------------------------
     def advance_prefill(self, req: Request, n_tokens: int) -> bool:

@@ -38,6 +38,17 @@
 #                               >0 prefix hits and >1 mean accepted
 #                               tokens/verify, with prefix_hit /
 #                               spec_verify kinds schema-valid
+#   5b. ddp_serve --fleet 1:2 --smoke
+#                               disaggregated serving fleet: 1 prefill +
+#                               2 decode engine PROCESSES behind the
+#                               session-affinity router, KV-block
+#                               handoff over TCP, one decode worker
+#                               killed mid-run — asserts every request
+#                               completes (zero dropped), >=1 handoff,
+#                               >=1 affinity-routed follow-up turn, and
+#                               a schema-valid merged timeline with the
+#                               route_admit / kv_handoff / tier_summary
+#                               / engine_verdict kinds
 #   6. elastic shrink smoke     4 -> 3 in-process resize on a fake-device
 #                               CPU gang: chaos kills one member mid-run,
 #                               the coordinator must land a gang_resize
@@ -108,6 +119,12 @@ echo "== ddp_serve --smoke =="
 SERVE_SMOKE_DIR="$(mktemp -d)"
 python scripts/ddp_serve.py --smoke --events-dir "${SERVE_SMOKE_DIR}"
 rm -rf "${SERVE_SMOKE_DIR}"
+
+echo "== ddp_serve --fleet 1:2 --smoke (disaggregated prefill/decode) =="
+FLEET_SMOKE_DIR="$(mktemp -d)"
+python scripts/ddp_serve.py --fleet 1:2 --smoke \
+    --events-dir "${FLEET_SMOKE_DIR}"
+rm -rf "${FLEET_SMOKE_DIR}"
 
 echo "== elastic shrink smoke (4 -> 3) =="
 ELASTIC_SMOKE_DIR="$(mktemp -d)"

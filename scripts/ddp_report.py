@@ -87,6 +87,7 @@ def analyze(records: list[dict]) -> dict:
         "lint": [],
         "run_summary": None,
         "serving": None,
+        "fleet": None,
         "tuning": None,
     }
     if worker_procs:
@@ -284,6 +285,44 @@ def analyze(records: list[dict]) -> dict:
                     # dispatch's mean accepted tokens per row
                     b = int((r.get("accepted") or 0) // rows)
                     s["accept_hist"][b] = s["accept_hist"].get(b, 0) + 1
+        elif kind in ("route_admit", "kv_handoff", "engine_verdict",
+                      "tier_summary"):
+            f = out["fleet"]
+            if f is None:
+                f = out["fleet"] = {
+                    "routed": 0, "affinity_hits": 0,
+                    "queue_depth_max": 0,
+                    "handoffs": 0, "handoff_bytes": 0,
+                    "handoff_s": [], "redelivered": 0,
+                    "verdicts": [], "tiers": {},
+                }
+            if kind == "route_admit":
+                f["routed"] += 1
+                if r.get("affinity"):
+                    f["affinity_hits"] += 1
+                f["queue_depth_max"] = max(
+                    f["queue_depth_max"], r.get("queue_depth") or 0
+                )
+            elif kind == "kv_handoff":
+                f["handoffs"] += 1
+                f["handoff_bytes"] += r.get("bytes") or 0
+                if isinstance(r.get("handoff_s"), (int, float)):
+                    f["handoff_s"].append(r["handoff_s"])
+                if (r.get("attempts") or 1) > 1:
+                    f["redelivered"] += 1
+            elif kind == "engine_verdict":
+                f["verdicts"].append({
+                    k: r.get(k) for k in (
+                        "engine", "rung", "tier", "requeued", "reason",
+                    )
+                })
+            else:  # tier_summary (one rollup per tier per run)
+                f["tiers"][r.get("tier")] = {
+                    k: r.get(k) for k in (
+                        "completed", "p50_ttft_s", "p99_ttft_s",
+                        "p50_tpot_s", "p99_tpot_s",
+                    ) if r.get(k) is not None
+                }
         elif kind in ("tune_trial", "tune_result"):
             t = out["tuning"]
             if t is None:
@@ -338,6 +377,14 @@ def analyze(records: list[dict]) -> dict:
         s["accept_hist"] = {
             str(k): s["accept_hist"][k] for k in sorted(s["accept_hist"])
         }
+    if out["fleet"]:
+        f = out["fleet"]
+        hs = sorted(f.pop("handoff_s"))
+        f["handoff_s_mean"] = (sum(hs) / len(hs)) if hs else None
+        f["handoff_s_p99"] = _quantile(hs, 0.99) if hs else None
+        f["affinity_frac"] = (
+            f["affinity_hits"] / f["routed"] if f["routed"] else None
+        )
     if out["elasticity"]:
         el = out["elasticity"]
         # dicts keyed by epoch -> sorted lists for the --json face
@@ -773,6 +820,48 @@ def render_markdown(a: dict, events_dir: str) -> str:
                 f"`{hist}`",
             ]
     lines.append("")
+
+    # -- Serving fleet ------------------------------------------------
+    fl = a["fleet"]
+    if fl is not None:
+        lines += ["## Serving fleet", ""]
+        aff = fl["affinity_frac"]
+        ho_mean = fl["handoff_s_mean"]
+        ho_p99 = fl["handoff_s_p99"]
+        lines += [
+            f"**{fl['routed']} requests routed**, "
+            f"{fl['affinity_hits']} session-affinity hits "
+            f"({'-' if aff is None else f'{aff:.0%}'}), "
+            f"{fl['handoffs']} prefill->decode KV handoffs "
+            f"({fl['handoff_bytes']} bytes).",
+            "",
+            "| metric | value |",
+            "|---|---:|",
+            f"| handoff mean | "
+            f"{'-' if ho_mean is None else f'{ho_mean * 1e3:.1f} ms'} |",
+            f"| handoff p99 | "
+            f"{'-' if ho_p99 is None else f'{ho_p99 * 1e3:.1f} ms'} |",
+            f"| re-delivered handoffs | {fl['redelivered']} |",
+            f"| router queue depth max | {fl['queue_depth_max']} |",
+        ]
+        for tier in sorted(fl["tiers"]):
+            t = fl["tiers"][tier]
+            p50 = t.get("p50_ttft_s")
+            p99 = t.get("p99_ttft_s")
+            lines.append(
+                f"| {tier} tier | {t.get('completed', 0)} done, "
+                f"TTFT p50 "
+                f"{'-' if p50 is None else f'{p50 * 1e3:.1f} ms'} / p99 "
+                f"{'-' if p99 is None else f'{p99 * 1e3:.1f} ms'} |"
+            )
+        for v in fl["verdicts"]:
+            lines.append(
+                f"| engine verdict | `{v.get('engine')}` -> "
+                f"**{v.get('rung')}** ({v.get('tier')} tier, "
+                f"{v.get('requeued', 0)} requeued, "
+                f"{v.get('reason')}) |"
+            )
+        lines.append("")
 
     # -- Tuning -------------------------------------------------------
     lines += ["## Tuning", ""]

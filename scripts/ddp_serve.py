@@ -83,6 +83,22 @@ def build_parser() -> argparse.ArgumentParser:
                     help="tokens per pooled shared prefix")
     ap.add_argument("--zipf-alpha", type=float, default=1.1,
                     help="Zipf exponent over the prefix pool ranks")
+    ap.add_argument("--turns", type=int, default=1,
+                    help="multi-turn sessions: each base request seeds "
+                         "a session with N turns (follow-up prompts "
+                         "extend the prior turn's)")
+    ap.add_argument("--turn-gap", type=float, default=0.25,
+                    help="mean seconds between a session's turns")
+    ap.add_argument("--fleet", default=None, metavar="P:D",
+                    help="disaggregated fleet: P prefill + D decode "
+                         "engine processes behind the session-affinity "
+                         "router, KV handoff over TCP")
+    ap.add_argument("--kill-engine", default=None, metavar="NAME|auto",
+                    help="fleet fault injection: terminate this engine "
+                         "worker mid-run ('auto' picks a decode engine)")
+    ap.add_argument("--kill-after", type=float, default=None,
+                    help="seconds into the drive to kill (default: 60%% "
+                         "through the arrival trace)")
     ap.add_argument("--events-dir", default=None)
     ap.add_argument("--store", default=None,
                     help="ExecutableStore dir (warm-start AOT reuse)")
@@ -98,9 +114,162 @@ def _range(spec: str) -> tuple[int, int]:
     return lo, hi
 
 
+def _run_fleet(args) -> int:
+    """``--fleet P:D``: spawn the disaggregated tiers as worker
+    processes under the launcher, drive a (multi-turn) loadgen trace
+    through the router, and — under ``--smoke`` — assert the fleet
+    contract: every request completes (zero dropped, even through an
+    injected engine kill), at least one KV handoff crossed tiers, at
+    least one follow-up was affinity-routed, and the merged timeline
+    stays schema- and trace-valid."""
+    from distributeddataparallel_tpu.models.transformer import (
+        gpt2_124m,
+        tiny_lm,
+    )
+    from distributeddataparallel_tpu.serving import (
+        EngineConfig,
+        LoadConfig,
+        make_trace,
+    )
+    from distributeddataparallel_tpu.serving.fleet import (
+        FleetConfig,
+        FleetService,
+    )
+
+    try:
+        n_prefill, n_decode = (int(x) for x in args.fleet.split(":"))
+    except ValueError:
+        print(f"ddp_serve: bad --fleet {args.fleet!r} (want P:D)",
+              file=sys.stderr)
+        return 1
+
+    if args.smoke:
+        args.model = "tiny"
+        args.duration = min(args.duration, 1.5)
+        args.rate = min(args.rate, 6.0)
+        args.turns = max(args.turns, 2)
+        # Affinity keys hash the first KV block: keep prompts at least
+        # one block long so a follow-up's key matches its base turn's.
+        args.prompt_len = "20,40"
+        args.output_len = "6,12"
+        if args.kill_engine is None:
+            args.kill_engine = "auto"
+
+    vocab = (gpt2_124m() if args.model == "gpt2_124m"
+             else tiny_lm()).vocab_size
+    trace = make_trace(LoadConfig(
+        rate_rps=args.rate,
+        duration_s=args.duration,
+        prompt_len=_range(args.prompt_len),
+        output_len=_range(args.output_len),
+        vocab_size=vocab,
+        seed=args.seed,
+        prefix_pool=args.prefix_pool,
+        prefix_len=args.prefix_len,
+        zipf_alpha=args.zipf_alpha,
+        turns=args.turns,
+        turn_gap_s=args.turn_gap,
+    ))
+    kill_after = None
+    kill_name = None
+    if args.kill_engine:
+        last_arrival = trace[-1]["arrival_s"] if trace else 0.0
+        kill_after = (args.kill_after if args.kill_after is not None
+                      else 0.6 * last_arrival)
+        kill_name = (None if args.kill_engine == "auto"
+                     else args.kill_engine)
+    svc = FleetService(
+        model=args.model,
+        seq_len=args.seq_len,
+        seed=args.seed,
+        engine_config=EngineConfig(
+            num_slots=args.slots,
+            num_blocks=args.blocks,
+            block_size=args.block_size,
+            prefill_chunk=args.chunk,
+            max_prefill_chunks_per_step=args.max_prefill_chunks,
+            quantized_kv=args.quantize_kv,
+            quantize_weights=args.quantize_weights,
+            store_dir=args.store,
+            # Affinity hits only pay off if the home decode engine's
+            # prefix cache actually holds the session's blocks.
+            prefix_cache=True,
+            spec_k=args.spec_k,
+            spec_ngram=args.spec_ngram,
+        ),
+        fleet_config=FleetConfig(prefill=n_prefill, decode=n_decode),
+        events_dir=args.events_dir,
+        kill_after_s=kill_after,
+        kill_engine=kill_name,
+    )
+    out = svc.run(trace)
+    out["fleet"] = f"{n_prefill}:{n_decode}"
+    print(json.dumps(out, indent=1, sort_keys=True, default=str))
+
+    if not args.smoke:
+        return 0
+    failures = []
+    if out["completed"] < len(trace):
+        failures.append(
+            f"fleet smoke: only {out['completed']}/{len(trace)} "
+            "requests completed"
+        )
+    if out["dropped_req_total"] != 0:
+        failures.append(
+            f"fleet smoke: {out['dropped_req_total']} dropped requests "
+            "(engine-kill drain must requeue, not lose)"
+        )
+    if out["handoffs"] < 1:
+        failures.append("fleet smoke: no prefill->decode KV handoff")
+    if args.kill_engine and out["kills"] < 1:
+        failures.append("fleet smoke: engine kill did not fire")
+    if args.events_dir:
+        from distributeddataparallel_tpu.observability.events import (
+            load_timeline,
+        )
+        from distributeddataparallel_tpu.observability.schema import (
+            validate_file,
+        )
+        from distributeddataparallel_tpu.observability.trace_export import (
+            to_trace_events,
+            validate_trace,
+        )
+
+        problems = validate_file(
+            os.path.join(args.events_dir, "timeline.jsonl")
+        )
+        failures.extend(problems[:5])
+        records = load_timeline(args.events_dir)
+        failures.extend(validate_trace(to_trace_events(records))[:5])
+        kinds = {r.get("kind") for r in records}
+        needed = ["route_admit", "kv_handoff", "tier_summary"]
+        if args.kill_engine:
+            needed.append("engine_verdict")
+        for kind in needed:
+            if kind not in kinds:
+                failures.append(f"fleet smoke: no {kind} event")
+        if not any(r.get("kind") == "route_admit" and r.get("affinity")
+                   for r in records):
+            failures.append(
+                "fleet smoke: no affinity-routed follow-up turn"
+            )
+    if failures:
+        print("SMOKE FAIL:\n  " + "\n  ".join(failures), file=sys.stderr)
+        return 1
+    print("fleet smoke OK: "
+          f"{out['completed']}/{len(trace)} requests, "
+          f"{out['handoffs']} handoffs, {out['requeued']} requeued "
+          f"through {out['kills']} kill(s), "
+          f"p99_ttft={out.get('serve_p99_ttft_s', 0):.3f}s")
+    return 0
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     _ensure_cpu()
+
+    if args.fleet:
+        return _run_fleet(args)
 
     import jax
     import jax.numpy as jnp
@@ -179,6 +348,8 @@ def main(argv=None) -> int:
         prefix_pool=args.prefix_pool,
         prefix_len=args.prefix_len,
         zipf_alpha=args.zipf_alpha,
+        turns=args.turns,
+        turn_gap_s=args.turn_gap,
     ))
     out = run_load(engine, trace, clock=clock)
     out["requests"] = len(trace)

@@ -52,8 +52,16 @@ REGRESS_EXIT = 3
 #: improvement shrinks them, so they must not gate backwards
 _LOWER_BETTER = re.compile(
     r"(bubble|step_s|_s$|bytes|overhead|_us$|_ms$|restart|latency|skew"
-    r"|_frac$|_fraction$)"
+    r"|dropped|_frac$|_fraction$)"
 )
+
+#: loss-count metrics that must be exactly zero in a healthy run —
+#: the serving fleet's ``dropped_req_total`` (requests lost through an
+#: engine kill instead of drained-and-requeued).  A nonzero value fails
+#: the gate even when the baseline was just as bad: "no worse than a
+#: lossy baseline" is not a pass.  ``--allow-drops`` downgrades this to
+#: the ordinary lower-better comparison.
+_HARD_ZERO = re.compile(r"dropped(_[a-z0-9]+)*_total$")
 
 #: throughput names that END in a rate suffix (tok_s, img_s, ..._per_s)
 #: would otherwise hit _LOWER_BETTER's ``_s$`` and gate backwards —
@@ -128,6 +136,9 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--default-threshold", type=float, default=0.05,
                     help="tolerance for bench-headline metrics "
                          "(default 0.05)")
+    ap.add_argument("--allow-drops", action="store_true",
+                    help="gate dropped_*_total metrics as ordinary "
+                         "lower-better instead of hard-zero")
     ap.add_argument("--update-baseline", action="store_true",
                     help="record this run as the named baseline instead "
                          "of gating")
@@ -173,6 +184,23 @@ def main(argv: list[str] | None = None) -> int:
         summary, base, thresholds=thresholds,
         metrics=gate_metrics_for(summary, source, args.default_threshold),
     )
+    if not args.allow_drops:
+        for name in sorted(summary):
+            value = summary[name]
+            if not (_HARD_ZERO.search(name)
+                    and isinstance(value, (int, float))
+                    and not isinstance(value, bool) and value > 0):
+                continue
+            if name not in result["regressed"]:
+                result["regressed"].append(name)
+            result["ok"] = False
+            result["checks"] = [
+                c for c in result["checks"] if c["metric"] != name
+            ] + [{
+                "metric": name, "status": "regress", "value": value,
+                "baseline": base.get(name, 0.0), "bound": 0.0,
+                "direction": "hard-zero",
+            }]
     # GL002 attribution: the fingerprint is an identity, not a gated
     # metric (compare_metric treats non-numerics as missing), so it gets
     # explicit handling — same graph means a regression is environment

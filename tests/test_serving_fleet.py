@@ -1,0 +1,604 @@
+"""Disaggregated serving fleet tests: handoff, router, end-to-end.
+
+The load-bearing contracts:
+
+- a prefill→decode KV-block handoff is INVISIBLE to outputs: a fleet
+  (1 prefill + 2 decode engines behind the session-affinity router)
+  generates bitwise the tokens a single monolithic engine would, for
+  block-exact and mid-block prompts, the unrolled and scanned layer
+  layouts, int8 KV, and the prefix-cache + speculative fast path —
+  with ``BlockAllocator.check()`` holding every scheduler step on
+  every engine of both tiers;
+- a corrupted handoff frame is a RETRY, never silent divergence: the
+  per-block digest NAKs exactly the bad blocks, the sender re-ships
+  only those, and the decoded stream stays bitwise correct; a link
+  that corrupts every attempt exhausts the redelivery budget and
+  raises instead of injecting garbage;
+- the router spreads fresh requests least-outstanding-tokens, pins
+  multi-turn sessions to the decode engine holding their prefix
+  blocks (skipping the prefill tier on a hit), walks the heartbeat
+  hysteresis ladder (``gang_suspect`` → tombstone) on an injected
+  clock, and records ``engine_verdict`` rungs (``drain`` with tier
+  survivors, ``fail`` without) exactly like PR 16's ``gang_verdict``;
+- killing a decode engine mid-run drains-and-requeues every
+  outstanding request onto the survivor: zero dropped;
+- a fleet run under a ``VirtualClock`` is a pure function of
+  (seed, config) — replayed, it produces identical tokens and
+  identical route/handoff counters;
+- multi-turn loadgen traces extend each session's prompt strictly
+  (turn t is a prefix of turn t+1) from an rng independent of the
+  base draws, so ``turns=1`` traces stay bitwise pinned;
+- perf_gate infers the fleet headline directions (speedup higher,
+  latency lower) and hard-fails any nonzero ``dropped_*_total`` even
+  against an equally lossy baseline, unless ``--allow-drops``;
+- the fleet event kinds export to Perfetto: ``kv_handoff`` doubles as
+  the ``handoff_bytes`` counter track and ``route_admit`` as the
+  ``router_queue`` depth track.
+"""
+
+import json
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+sys.path.insert(0, "/root/repo")
+sys.path.insert(0, os.path.join("/root/repo", "scripts"))
+
+from distributeddataparallel_tpu.models import TransformerLM, tiny_lm
+from distributeddataparallel_tpu.serving import (
+    EngineConfig,
+    FleetConfig,
+    HandoffError,
+    HandoffReceiver,
+    HandoffSender,
+    InferenceEngine,
+    LoadConfig,
+    PipeChannel,
+    Router,
+    RouterError,
+    ServingFleet,
+    VirtualClock,
+    block_nbytes,
+    make_trace,
+    run_load,
+)
+from distributeddataparallel_tpu.serving.handoff import MAX_ATTEMPTS
+
+
+def _unrolled(**over):
+    base = dict(
+        vocab_size=97, num_layers=2, num_heads=2, d_model=32, d_ff=64,
+        max_seq_len=64, positional="learned", norm="layernorm",
+        activation="gelu", tie_embeddings=True,
+    )
+    base.update(over)
+    return tiny_lm(**base)
+
+
+def _scanned(**over):
+    base = dict(
+        vocab_size=97, num_layers=2, num_heads=4, num_kv_heads=2,
+        d_model=32, d_ff=64, max_seq_len=64, scan_layers=True,
+        tie_embeddings=False,
+    )
+    base.update(over)
+    return tiny_lm(**base)
+
+
+def _model(cfg_fn):
+    cfg = cfg_fn()
+    model = TransformerLM(cfg)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32)
+    )["params"]
+    return cfg, model, params
+
+
+def _ecfg(**over):
+    base = dict(
+        num_slots=4, num_blocks=48, block_size=8, prefill_chunk=8
+    )
+    base.update(over)
+    return EngineConfig(**base)
+
+
+#: prompt lengths that cross the interesting boundaries at block_size
+#: 8: one exactly block-aligned (16), one mid-block (13), one longer
+#: multi-block (21)
+_PROMPT_LENS = (16, 13, 21)
+
+
+def _prompts(vocab, lens=_PROMPT_LENS, seed=0):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(1, vocab, size=n).tolist() for n in lens]
+
+
+def _ref_outputs(model, params, ecfg, prompts, n_new):
+    """What a single monolithic engine generates for ``prompts``."""
+    eng = InferenceEngine(model, params, ecfg, time_fn=VirtualClock())
+    rids = [eng.submit(p, n_new) for p in prompts]
+    while eng.has_work():
+        eng.step()
+    return [list(eng.completed[r].generated) for r in rids]
+
+
+def _drive(fleet, clock, max_steps=800):
+    steps = 0
+    while fleet.has_work():
+        fleet.step()
+        clock.tick()
+        steps += 1
+        assert steps < max_steps, "fleet failed to drain"
+    return steps
+
+
+def _fleet_case(cfg_fn, n_new=10, **ecfg_over):
+    """Run the 3-prompt parity scenario on a 1:2 fleet and return
+    (fleet, outputs, reference outputs)."""
+    cfg, model, params = _model(cfg_fn)
+    ecfg = _ecfg(**ecfg_over)
+    clock = VirtualClock()
+    fleet = ServingFleet(
+        model, params, ecfg, FleetConfig(prefill=1, decode=2),
+        time_fn=clock, check_invariants=True,
+    )
+    prompts = _prompts(cfg.vocab_size)
+    fids = [fleet.submit(p, n_new) for p in prompts]
+    _drive(fleet, clock)
+    outs = [list(fleet.completed[f].generated) for f in fids]
+    refs = _ref_outputs(model, params, ecfg, prompts, n_new)
+    return fleet, outs, refs
+
+
+# ------------------------------------------------------- handoff parity
+
+
+def test_fleet_parity_plain_unrolled():
+    fleet, outs, refs = _fleet_case(_unrolled)
+    assert outs == refs
+    s = fleet.summary()
+    # every fresh prompt went prefill-tier → handoff → decode-tier
+    assert s["handoffs"] == len(_PROMPT_LENS)
+    assert s["dropped_req_total"] == 0 and s["re_handoff_blocks"] == 0
+
+
+def test_fleet_parity_scanned():
+    # exercises the (L, N, bs, H, D) pool layout end to end, including
+    # the layer-major moveaxis in extract and the batched landing
+    fleet, outs, refs = _fleet_case(_scanned)
+    assert outs == refs
+    assert fleet.summary()["handoffs"] == len(_PROMPT_LENS)
+
+
+def test_fleet_parity_quantized_kv():
+    # int8 KV ships q/scale leaves raw — never re-quantized in transit
+    _, outs, refs = _fleet_case(_unrolled, quantized_kv=True)
+    assert outs == refs
+
+
+def test_fleet_parity_fastpath():
+    # prefix cache + speculative decoding on the decode tier must not
+    # change what a handed-off sequence generates
+    _, outs, refs = _fleet_case(_unrolled, prefix_cache=True, spec_k=2)
+    assert outs == refs
+
+
+# -------------------------------------------- corruption & redelivery
+
+
+class _FlipOnce:
+    """Channel wrapper that flips one byte of the Nth send, once."""
+
+    def __init__(self, chan, nth):
+        self._chan = chan
+        self._nth = nth
+        self._sends = 0
+
+    def send(self, frame):
+        self._sends += 1
+        if self._sends == self._nth:
+            frame = bytearray(frame)
+            frame[len(frame) // 2] ^= 0xFF
+            frame = bytes(frame)
+        self._chan.send(frame)
+
+    def __getattr__(self, name):
+        return getattr(self._chan, name)
+
+
+def test_handoff_corrupted_block_redelivered():
+    cfg, model, params = _model(_unrolled)
+    ecfg = _ecfg()
+    clock = VirtualClock()
+    prefill = InferenceEngine(model, params, ecfg, time_fn=clock)
+    decode = InferenceEngine(model, params, ecfg, time_fn=clock)
+    prompt = _prompts(cfg.vocab_size, lens=(16,))[0]
+    rid = prefill.submit(prompt, 1)
+    while prefill.has_work():
+        prefill.step()
+    payload = prefill.extract_handoff(rid, max_new_tokens=8)
+    assert all(len(b) == block_nbytes(prefill.pool) for b in payload.blocks)
+
+    a, b = PipeChannel.pair()
+    # frame 1 is the header; frame 2 is block 0 — corrupt it once
+    sender = HandoffSender(_FlipOnce(a, 2), time_fn=clock)
+    receiver = HandoffReceiver(b)
+    sender.offer(payload)
+    got = receiver.poll()          # digest mismatch on block 0 → NAK
+    assert got == [] and receiver.rejected_blocks == 1
+    done = sender.poll()           # consumes NAK, re-ships block 0
+    assert done == [] and sender.redelivered_blocks == 1
+    got = receiver.poll()
+    assert len(got) == 1 and got[0].blocks == payload.blocks
+    (rec,) = sender.poll()
+    assert rec["attempts"] == 2 and sender.in_flight == 0
+
+    # the redelivered payload still injects and decodes bitwise right
+    new_rid = decode.inject_handoff(got[0])
+    while decode.has_work():
+        decode.step()
+    ref = _ref_outputs(model, params, ecfg, [prompt], 8)[0]
+    assert list(decode.completed[new_rid].generated) == ref
+
+
+def test_handoff_gives_up_after_redelivery_budget():
+    class _FlipAlways(_FlipOnce):
+        def send(self, frame):
+            # corrupt every block frame (anything not JSON-parseable
+            # as a control frame — cheap heuristic: big frames)
+            if len(frame) > 512:
+                frame = bytearray(frame)
+                frame[0] ^= 0xFF
+                frame = bytes(frame)
+            self._chan.send(frame)
+
+    cfg, model, params = _model(_unrolled)
+    clock = VirtualClock()
+    eng = InferenceEngine(model, params, _ecfg(), time_fn=clock)
+    rid = eng.submit(_prompts(cfg.vocab_size, lens=(16,))[0], 1)
+    while eng.has_work():
+        eng.step()
+    payload = eng.extract_handoff(rid, max_new_tokens=4)
+    assert len(payload.blocks[0]) > 512  # the heuristic must trigger
+
+    a, b = PipeChannel.pair()
+    sender = HandoffSender(_FlipAlways(a, 0), time_fn=clock)
+    receiver = HandoffReceiver(b)
+    sender.offer(payload)
+    with pytest.raises(HandoffError, match="still corrupt"):
+        for _ in range(MAX_ATTEMPTS + 1):
+            assert receiver.poll() == []  # every delivery rejected
+            sender.poll()
+
+
+def test_fleet_corrupted_frame_no_divergence():
+    """End-to-end: one flipped byte inside the fleet's handoff channel
+    costs a re-handoff, not a wrong token."""
+    cfg, model, params = _model(_unrolled)
+    ecfg = _ecfg()
+    clock = VirtualClock()
+    fleet = ServingFleet(
+        model, params, ecfg, FleetConfig(prefill=1, decode=2),
+        time_fn=clock, check_invariants=True,
+    )
+    for sender in fleet._senders.values():
+        sender._chan = _FlipOnce(sender._chan, 2)
+    prompts = _prompts(cfg.vocab_size)
+    fids = [fleet.submit(p, 10) for p in prompts]
+    _drive(fleet, clock)
+    outs = [list(fleet.completed[f].generated) for f in fids]
+    assert outs == _ref_outputs(model, params, ecfg, prompts, 10)
+    s = fleet.summary()
+    assert s["re_handoff_blocks"] >= 1
+    assert s["dropped_req_total"] == 0
+
+
+# ----------------------------------------------------------- router
+
+
+class _Events:
+    def __init__(self):
+        self.records = []
+
+    def emit(self, kind, **fields):
+        self.records.append({"kind": kind, **fields})
+
+    def kinds(self):
+        return [r["kind"] for r in self.records]
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def _router(clock=None, events=None, decode=2, prefill=1):
+    r = Router(
+        block_size=8, heartbeat_timeout_s=2.0,
+        events=events, time_fn=clock or _Clock(),
+    )
+    for i in range(prefill):
+        r.register_engine(f"prefill-{i}", "prefill")
+    for i in range(decode):
+        r.register_engine(f"decode-{i}", "decode")
+    return r
+
+
+def test_router_least_outstanding_tokens():
+    r = _router(decode=3, prefill=0)
+    # 3 fresh requests (distinct prompts — identical ones would share
+    # an affinity key and stick on purpose) spread over all engines
+    r0 = r.route(0, list(range(0, 10)), 20)
+    r1 = r.route(1, list(range(10, 20)), 5)
+    r2 = r.route(2, list(range(20, 30)), 5)
+    assert {r0["decode"], r1["decode"], r2["decode"]} == {
+        "decode-0", "decode-1", "decode-2"
+    }
+    # next request goes to whichever engine holds the fewest tokens —
+    # NOT round-robin: r0's engine (30 tokens) must lose to the 15s
+    r3 = r.route(3, list(range(30, 40)), 5)
+    assert r3["decode"] != r0["decode"]
+
+
+def test_router_affinity_skips_prefill_and_sticks():
+    ev = _Events()
+    r = _router(events=ev)
+    base = list(range(20))           # >= block_size: hashable prefix
+    first = r.route(0, base, 8, session="s0")
+    assert first["prefill"] == "prefill-0"
+    home = first["decode"]
+    # the follow-up extends the prompt; same first block → same key
+    follow = r.route(1, base + [55, 56], 8, session="s0")
+    assert follow["decode"] == home and follow["prefill"] is None
+    assert r.affinity_hits == 1
+    admits = [x for x in ev.records if x["kind"] == "route_admit"]
+    assert [a["affinity"] for a in admits] == [False, True]
+    # a prompt shorter than one block keys on the raw token tuple —
+    # extending it CHANGES the key, so no (false) affinity hit
+    r.route(2, [1, 2, 3], 4, session="tiny")
+    r.route(3, [1, 2, 3, 4], 4, session="tiny")
+    assert r.affinity_hits == 1
+
+
+def test_router_heartbeat_hysteresis_and_drain():
+    clock = _Clock()
+    ev = _Events()
+    r = _router(clock=clock, events=ev)
+    rec = r.route(0, list(range(20)), 8)
+    owner = rec["prefill"]
+    clock.t = 1.2                    # past suspect (1.0), not timeout
+    assert r.check() == []
+    suspects = [x for x in ev.records if x["kind"] == "gang_suspect"]
+    assert len(suspects) == 3        # every silent engine suspected once
+    assert r.check() == [] and len(
+        [x for x in ev.records if x["kind"] == "gang_suspect"]
+    ) == 3                           # hysteresis: no re-fire
+    r.heartbeat(owner)               # owner recovers...
+    clock.t = 2.5                    # ...the others cross the timeout
+    drained = r.check()
+    assert drained == []             # dead engines held no requests
+    assert r.alive_engines("prefill") == [owner] if owner else True
+    verdicts = {
+        x["engine"]: x for x in ev.records if x["kind"] == "engine_verdict"
+    }
+    assert len(verdicts) == 2 and all(
+        v["reason"] == "heartbeat" for v in verdicts.values()
+    )
+
+
+def test_router_mark_dead_drains_and_purges_affinity():
+    ev = _Events()
+    r = _router(events=ev)
+    base = list(range(20))
+    rec = r.route(0, base, 8, session="s0")
+    r.handoff_done(0)                # decode engine now owns fid 0
+    home = rec["decode"]
+    drained = r.mark_dead(home, reason="kill")
+    assert [d["fid"] for d in drained] == [0]
+    assert r.mark_dead(home) == []   # idempotent tombstone
+    verdict = next(
+        x for x in ev.records if x["kind"] == "engine_verdict"
+    )
+    assert verdict["rung"] == "drain" and verdict["requeued"] == 1
+    # affinity purged: the re-route must pick the surviving engine
+    rec2 = r.route(1, base + [9], 8, session="s0")
+    assert rec2["decode"] != home and rec2["prefill"] is not None
+
+
+def test_router_fail_rung_and_no_engine_error():
+    ev = _Events()
+    r = _router(events=ev, decode=1)
+    r.mark_dead("decode-0")
+    verdict = next(
+        x for x in ev.records if x["kind"] == "engine_verdict"
+    )
+    assert verdict["rung"] == "fail"  # no survivor left in the tier
+    with pytest.raises(RouterError):
+        r.route(0, list(range(20)), 4)
+
+
+# -------------------------------------------------- kill-drain, replay
+
+
+def test_fleet_kill_drain_zero_dropped():
+    cfg, model, params = _model(_unrolled)
+    clock = VirtualClock()
+    fleet = ServingFleet(
+        model, params, _ecfg(), FleetConfig(prefill=1, decode=2),
+        time_fn=clock, check_invariants=True,
+    )
+    rng = np.random.default_rng(7)
+    fids = [
+        fleet.submit(rng.integers(1, cfg.vocab_size, 16 + i).tolist(), 8)
+        for i in range(6)
+    ]
+    for _ in range(3):               # get requests in flight
+        fleet.step()
+        clock.tick()
+    fleet.kill_engine("decode-0")
+    _drive(fleet, clock)
+    assert sorted(fleet.completed) == sorted(fids)
+    s = fleet.summary()
+    assert s["dropped_req_total"] == 0 and s["kills"] == 1
+    # the survivor's allocator still satisfies the partition invariant
+    fleet.engines["decode-1"].allocator.check()
+
+
+def test_fleet_virtual_clock_replay_deterministic():
+    cfg, model, params = _model(_unrolled)
+    lcfg = LoadConfig(
+        rate_rps=40.0, duration_s=0.4, prompt_len=(10, 20),
+        output_len=(4, 8), vocab_size=cfg.vocab_size, seed=3,
+        turns=2, turn_gap_s=0.05,
+    )
+    trace = make_trace(lcfg)
+
+    def one_run():
+        clock = VirtualClock()
+        fleet = ServingFleet(
+            model, params, _ecfg(prefix_cache=True),
+            FleetConfig(prefill=1, decode=2), time_fn=clock,
+        )
+        out = run_load(fleet, trace, clock=clock)
+        toks = [
+            list(fleet.completed[f].generated)
+            for f in sorted(fleet.completed)
+        ]
+        keys = ("completed", "handoffs", "routed", "affinity_hits",
+                "requeued", "dropped_req_total", "tokens_out")
+        return toks, {k: out[k] for k in keys}
+
+    toks_a, sum_a = one_run()
+    toks_b, sum_b = one_run()
+    assert toks_a == toks_b and sum_a == sum_b
+    assert sum_a["completed"] == len(trace)
+    assert sum_a["handoffs"] >= 1 and sum_a["affinity_hits"] >= 1
+
+
+# -------------------------------------------------- loadgen multi-turn
+
+
+def test_make_trace_multiturn_extends_sessions():
+    cfg = LoadConfig(
+        rate_rps=20.0, duration_s=0.5, prompt_len=(8, 16),
+        vocab_size=101, seed=5, turns=3, turn_gap_s=0.1,
+    )
+    trace = make_trace(cfg)
+    arrivals = [r["arrival_s"] for r in trace]
+    assert arrivals == sorted(arrivals)
+    sessions = {}
+    for r in trace:
+        sessions.setdefault(r["session"], []).append(r)
+    assert sessions and all(len(v) == 3 for v in sessions.values())
+    for turns in sessions.values():
+        turns.sort(key=lambda r: r["turn"])
+        for prev, nxt in zip(turns, turns[1:]):
+            p, n = list(prev["prompt"]), list(nxt["prompt"])
+            assert len(n) > len(p) and n[: len(p)] == p
+            assert nxt["arrival_s"] > prev["arrival_s"]
+
+
+def test_make_trace_turns1_bitwise_pinned():
+    """The follow-up rng is independent of the base draws: a turns=2
+    trace's turn-0 records are exactly the turns=1 trace."""
+    kw = dict(
+        rate_rps=25.0, duration_s=0.6, prompt_len=(6, 12),
+        output_len=(3, 6), vocab_size=89, seed=11,
+    )
+    base = make_trace(LoadConfig(**kw))           # turns defaults to 1
+    multi = make_trace(LoadConfig(**kw, turns=2))
+    turn0 = [r for r in multi if r["turn"] == 0]
+    assert len(turn0) == len(base) == len(multi) // 2
+    for a, b in zip(base, turn0):
+        assert a["arrival_s"] == b["arrival_s"]
+        assert list(a["prompt"]) == list(b["prompt"])
+        assert a["max_new_tokens"] == b["max_new_tokens"]
+
+
+# ------------------------------------------------- perf_gate directions
+
+
+def test_perf_gate_fleet_headline_directions():
+    import perf_gate
+
+    assert perf_gate._bench_direction("fleet_tok_s_speedup") == "higher"
+    assert perf_gate._bench_direction("fleet_p99_ttft_improvement") == "higher"
+    assert perf_gate._bench_direction("fleet_p99_ttft_s") == "lower"
+    assert perf_gate._bench_direction("handoff_s") == "lower"
+    assert perf_gate._bench_direction("dropped_req_total") == "lower"
+    # the neighbors keep their directions
+    assert perf_gate._bench_direction("serve_tok_s") == "higher"
+    assert perf_gate._bench_direction("tune_gain_frac") == "higher"
+
+
+def _gate(tmp_path, headline, argv_extra=(), name="flt"):
+    import perf_gate
+
+    run = tmp_path / "BENCH_fleet.json"
+    run.write_text(json.dumps({"parsed": {"headline": headline}}))
+    store = str(tmp_path / "runs")
+    base_args = [str(run), "--store", store, "--baseline", name]
+    assert perf_gate.main(base_args + ["--update-baseline"]) == 0
+    return perf_gate.main(base_args + list(argv_extra))
+
+
+def test_perf_gate_hard_zero_dropped(tmp_path):
+    import perf_gate
+
+    # identical run and baseline, but dropped_req_total is nonzero —
+    # "no worse than a lossy baseline" must still FAIL
+    lossy = {"fleet_tok_s_speedup": 1.4, "dropped_req_total": 2.0}
+    assert _gate(tmp_path, lossy) == perf_gate.REGRESS_EXIT
+    # --allow-drops downgrades to the ordinary lower-better compare,
+    # which passes against the equal baseline
+    assert _gate(
+        tmp_path, lossy, ["--allow-drops"], name="flt2"
+    ) == 0
+    # a clean run (zero drops) passes without the flag
+    clean = {"fleet_tok_s_speedup": 1.4, "dropped_req_total": 0.0}
+    assert _gate(tmp_path, clean, name="flt3") == 0
+
+
+# --------------------------------------------------- perfetto export
+
+
+def test_trace_export_fleet_tracks():
+    from distributeddataparallel_tpu.observability.trace_export import (
+        to_trace_events,
+        validate_trace,
+    )
+
+    records = [
+        {"kind": "run_start", "ts": 0.0, "proc": "supervisor"},
+        {"kind": "route_admit", "ts": 0.1, "proc": "supervisor",
+         "req": 0, "engine": "decode-0", "prefill": "prefill-0",
+         "affinity": False, "session": "s0", "queue_depth": 1},
+        {"kind": "kv_handoff", "ts": 0.2, "proc": 0, "req": 5,
+         "blocks": 3, "bytes": 12288, "attempts": 1,
+         "handoff_s": 0.01, "src": "prefill-0", "dst": "decode-0"},
+        {"kind": "engine_verdict", "ts": 0.3, "proc": "supervisor",
+         "engine": "decode-1", "rung": "drain", "tier": "decode",
+         "requeued": 2, "reason": "kill"},
+    ]
+    trace = to_trace_events(records)
+    assert validate_trace(trace) == []
+    by = {}
+    for e in trace["traceEvents"]:
+        by.setdefault((e["ph"], e["name"]), []).append(e)
+    # route_admit: instant + router queue-depth counter sample
+    assert ("i", "route_admit") in by
+    (queue,) = by[("C", "router_queue")]
+    assert queue["args"] == {"router_queue": 1.0}
+    # kv_handoff: handoff-bytes counter track
+    (hand,) = by[("C", "handoff_bytes")]
+    assert hand["args"] == {"handoff_bytes": 12288.0}
+    # engine_verdict: a global instant carrying the rung
+    (verdict,) = by[("i", "engine_verdict")]
+    assert verdict["args"]["rung"] == "drain"
