@@ -1,13 +1,17 @@
 """ddplint: static SPMD-invariant checking for the DDP reproduction.
 
-Two layers — graph rules over the traced/lowered train step
-(``graph_lint``) and AST rules over the package source (``ast_rules``)
-— with a shared rule registry (``rules``).  CLI: ``scripts/ddplint.py``.
+Three layers — graph/flow/schedule rules over the traced/lowered train
+step (``graph_lint``/``shard_flow``/``schedule_lint``), AST rules over
+the package source (``ast_rules``/``sync_lint``), and protocol rules
+over the declared distributed-protocol state machines (``protocol``,
+explored by a small-scope model checker) plus recorded event timelines
+(``conformance``) — with a shared rule registry (``rules``).  CLI:
+``scripts/ddplint.py``.
 
 Import note: this package root only re-exports the stdlib-only pieces;
 ``graph_lint`` (which imports jax) is imported lazily by the callers
-that need it, so ``analysis.ast_rules`` stays usable in jax-free
-interpreters.
+that need it, so ``analysis.ast_rules``, ``analysis.protocol``, and
+``analysis.conformance`` stay usable in jax-free interpreters.
 """
 
 from distributeddataparallel_tpu.analysis.rules import (  # noqa: F401

@@ -18,10 +18,18 @@ program* rather than trusting the Python source):
   tick table and the grad-sync bucket order become lintable artifacts
   instead of opaque code;
 - **AST rules (AL*)** run over the package source
-  (``analysis.ast_rules``) — they catch host-side hot-path hazards
-  (accidental device syncs, wall-clock/RNG inside traced code,
-  swallowed exceptions, unregistered telemetry kinds) that never show
-  up in a jaxpr because they happen *around* it.
+  (``analysis.ast_rules`` for the train-step dispatch path,
+  ``analysis.sync_lint`` for the runtime/serving protocol code) — they
+  catch host-side hazards (accidental device syncs, wall-clock/RNG
+  inside traced code, swallowed exceptions, unregistered telemetry
+  kinds, bare socket dials, lock-discipline breaks) that never show up
+  in a jaxpr because they happen *around* it;
+- **protocol rules (PL*)** run over the declared protocol state
+  machines (``analysis.protocol``) and recorded event timelines
+  (``analysis.conformance``) — the rendezvous epochs, router request
+  lifecycle, handoff NAK loop, and allocator block lifecycle become
+  checkable specs that a small-scope model checker explores
+  exhaustively, and every smoke timeline is replayed against them.
 
 Rule-ID index (full descriptions in ``RULES``):
 
@@ -45,6 +53,16 @@ AL101   ast    host-sync
 AL102   ast    time-in-jit
 AL103   ast    broad-except
 AL104   ast    event-kind
+AL105   ast    blocking-socket
+AL106   ast    wallclock-in-virtual-path
+AL107   ast    host-sync-in-serve-loop
+AL108   ast    lock-discipline
+PL401   proto  protocol-invariant
+PL402   proto  protocol-deadlock
+PL403   proto  spec-unreachable-state
+PL404   proto  spec-dead-transition
+PL405   proto  timeline-conformance
+PL406   proto  spec-malformed
 ======  =====  ==================================================
 
 Waivers: AST findings can be waived per line with a pragma comment
@@ -180,6 +198,79 @@ RULES: dict[str, tuple[str, str, str, str]] = {
         "observability.schema.EVENT_KINDS (schema drift: consumers "
         "reject or misparse the record)",
         "# ddplint: allow[event-kind]",
+    ),
+    "AL105": (
+        "ast", "blocking-socket",
+        "socket.create_connection / socket.socket call outside a "
+        "retry_call wrapper (a transient connect race crashes instead "
+        "of taking the RetryPolicy backoff)",
+        "# ddplint: allow[blocking-socket]",
+    ),
+    "AL106": (
+        "ast", "wallclock-in-virtual-path",
+        "time.time()/time.monotonic() called in a VirtualClock-"
+        "replayable module (forks virtual and real time; replays stop "
+        "being deterministic) — pass the injected time_fn instead",
+        "# ddplint: allow[wallclock]",
+    ),
+    "AL107": (
+        "ast", "host-sync-in-serve-loop",
+        "jax.device_get / .item() / np.asarray inside a per-step "
+        "serving-loop function (one device->host sync per decode step "
+        "serializes the fleet)",
+        "# ddplint: allow[serve-host-sync]",
+    ),
+    "AL108": (
+        "ast", "lock-discipline",
+        "attribute mutated under `with self.<lock>:` in one method but "
+        "bare in another (outside __init__) — the lock either protects "
+        "the attribute everywhere or protects nothing",
+        "# ddplint: allow[lock-discipline]",
+    ),
+    "PL401": (
+        "proto", "protocol-invariant",
+        "a reachable state of a declared protocol spec violates one of "
+        "its safety invariants (forked epoch history, dropped+completed "
+        "request, double block injection, refcount leak); reported "
+        "with the minimal counterexample trace",
+        "none",
+    ),
+    "PL402": (
+        "proto", "protocol-deadlock",
+        "a reachable protocol state has no enabled transition while "
+        "some entity is outside the declared quiescent states (a "
+        "request/block/member stuck forever)",
+        "none",
+    ),
+    "PL403": (
+        "proto", "spec-unreachable-state",
+        "a declared protocol state no interleaving reaches at the "
+        "explored scope — the spec promises behavior the model cannot "
+        "exhibit (spec drift or dead spec)",
+        "none",
+    ),
+    "PL404": (
+        "proto", "spec-dead-transition",
+        "a declared protocol transition never enabled in any reachable "
+        "state — dead spec entry or a guard that contradicts the rest "
+        "of the machine",
+        "none",
+    ),
+    "PL405": (
+        "proto", "timeline-conformance",
+        "a recorded event timeline disagrees with the protocol specs "
+        "(duplicate epoch, affinity hit with a prefill engine, handoff "
+        "attempts outside the NAK budget, routing to a dead engine) — "
+        "the executed run drifted from the checked plan",
+        "none",
+    ),
+    "PL406": (
+        "proto", "spec-malformed",
+        "the protocol spec itself is structurally broken: unknown "
+        "initial/guard states, duplicate transition names, or a fired "
+        "move whose entity did not make the declared source->target "
+        "hop",
+        "none",
     ),
 }
 

@@ -61,6 +61,8 @@ def guarded_worker(fn, process_id, *args):
 
 
 def _free_port() -> int:
+    # ddplint: allow[blocking-socket] — local loopback bind to probe a
+    # free port; there is no remote peer whose absence a retry could fix
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         return s.getsockname()[1]

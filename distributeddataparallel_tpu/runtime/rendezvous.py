@@ -713,6 +713,10 @@ class TCPRendezvousClient:
         self._disconnect()
         addr = self._resolve()
         host, port = addr.rsplit(":", 1)
+        # ddplint: allow[blocking-socket] — retry lives one level up:
+        # every RPC goes through _call, whose RetryPolicy loop
+        # reconnects on refused/reset; wrapping the dial here too would
+        # square the backoff
         self._sock = socket.create_connection(
             (host, int(port)), timeout=self._timeout_s
         )
@@ -813,10 +817,18 @@ del _op
 def elect_rehost(survivors: list[str]) -> str:
     """The deterministic re-host owner: the lexicographically smallest
     survivor — same rule as the epoch proposer, so no election protocol
-    is needed on top of the membership the gang already agrees on."""
+    is needed on top of the membership the gang already agrees on.
+
+    Delegates to ``analysis.protocol.elect_rehost_owner`` (both modules
+    are stdlib-only): the election rule the protocol model checker
+    explores is, by construction, the rule the gang executes."""
+    from distributeddataparallel_tpu.analysis.protocol import (
+        elect_rehost_owner,
+    )
+
     if not survivors:
         raise ValueError("no survivors to elect a re-host owner from")
-    return sorted(str(s) for s in survivors)[0]
+    return elect_rehost_owner(survivors)
 
 
 def rehost_store(

@@ -691,6 +691,9 @@ class InferenceEngine:
                     self.params, self.pool, jnp.asarray(tables),
                     jnp.asarray(toks), jnp.asarray(pos),
                 )
+                # ddplint: allow[serve-host-sync] — the ONE budgeted
+                # sync per speculative step: acceptance comparison needs
+                # the verify program's greedy tokens on the host
                 g = np.asarray(g)
                 drafted = accepted = 0
                 for slot, req in running.items():
@@ -734,6 +737,7 @@ class InferenceEngine:
                 # next tokens at once) — completion detection needs the
                 # values; this is the serving analog of the train
                 # loop's bounded dispatch, with depth 0.
+                # ddplint: allow[serve-host-sync] — this is that sync
                 nxt = np.asarray(nxt)
                 for slot, req in running.items():
                     req.generated.append(int(nxt[slot]))

@@ -40,6 +40,9 @@ from typing import Any, Callable
 import jax
 import numpy as np
 
+from distributeddataparallel_tpu.analysis.protocol import (
+    HANDOFF_MAX_ATTEMPTS,
+)
 from distributeddataparallel_tpu.runtime.rendezvous import (
     RetryPolicy,
     retry_call,
@@ -50,7 +53,9 @@ Pytree = Any
 
 #: Digest-mismatch redelivery budget per handoff before the sender gives
 #: up — a link that corrupts four attempts in a row is dead, not noisy.
-MAX_ATTEMPTS = 4
+#: Sourced from the declared protocol spec (analysis.protocol), so the
+#: budget the model checker explores is the budget this sender enforces.
+MAX_ATTEMPTS = HANDOFF_MAX_ATTEMPTS
 
 _LEN = struct.Struct(">I")
 

@@ -32,6 +32,8 @@ from __future__ import annotations
 import time
 from typing import Any, Callable
 
+from distributeddataparallel_tpu.analysis.protocol import verdict_rung
+
 #: FNV-1a 64-bit offset basis / prime — MUST match
 #: ``serving.kv_cache.block_hash`` (the affinity key is the trie's
 #: root-level child hash, computed router-side without importing jax).
@@ -240,7 +242,11 @@ class Router:
         eng.outstanding_tokens = 0
         for key in [k for k, v in self._affinity.items() if v == name]:
             del self._affinity[key]
-        rung = "drain" if self.alive_engines(eng.tier) else "fail"
+        # rung names come from the declared protocol spec
+        # (analysis.protocol.VERDICT_RUNGS): the ladder the model
+        # checker and the timeline-conformance replay verify is the
+        # ladder this router emits
+        rung = verdict_rung(bool(self.alive_engines(eng.tier)))
         self.emit(
             "engine_verdict",
             engine=name,

@@ -598,7 +598,10 @@ class BackgroundPrecompiler:
                 )
             self._pending += 1
             self._idle.clear()
-        self._q.put((name, key, build))
+            # enqueue under the lock: dropping it first lets join() slip
+            # the shutdown sentinel in ahead of this job, which would
+            # then sit behind the sentinel and never compile
+            self._q.put((name, key, build))
 
     def start(self) -> "BackgroundPrecompiler":
         self._thread.start()

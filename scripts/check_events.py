@@ -8,6 +8,16 @@ Usage:
 Usage (static, no JSONL files — cross-check emitters vs the registry):
     python scripts/check_events.py --schema-sync
 
+Usage (protocol conformance — replay a timeline against the specs):
+    python scripts/check_events.py --conformance EVENTS_DIR_OR_FILE
+
+``--conformance`` replays each input (a merged ``timeline.jsonl`` or an
+events *directory*, merged on the fly) against the protocol specs in
+``analysis.protocol`` via ``analysis.conformance.check_timeline`` —
+duplicate membership epochs, affinity admissions that still hit the
+prefill tier, handoff attempt counts outside the NAK budget, and
+routing to a dead engine all fail as PL405 findings.
+
 Exit 0 when every record in every file is schema-valid (and, with
 ``--expect-order``, the listed kinds appear in that relative order);
 exit 1 otherwise, printing each problem.  Used by tests/test_observability
@@ -107,22 +117,47 @@ def main(argv: list[str] | None = None) -> int:
         help="statically cross-check EventLog.emit kinds against "
         "EVENT_KINDS (both directions); needs no event files",
     )
+    ap.add_argument(
+        "--conformance",
+        action="store_true",
+        help="replay each input (timeline file or events dir) against "
+        "the protocol specs (analysis.conformance, PL405)",
+    )
     args = ap.parse_args(argv)
     if not args.files and not args.schema_sync:
         ap.error("provide events JSONL file(s) and/or --schema-sync")
 
     problems = []
+    n_conformant = 0
     if args.schema_sync:
         problems.extend(check_schema_sync())
     for path in args.files:
         if not os.path.exists(path):
             problems.append(f"{path}: no such file")
             continue
-        problems.extend(f"{path}: {p}" for p in validate_file(path))
-        if args.expect_order:
-            problems.extend(
-                check_order(path, [k.strip() for k in args.expect_order.split(",")])
-            )
+        if os.path.isdir(path):
+            if not args.conformance:
+                problems.append(
+                    f"{path}: is a directory (only --conformance "
+                    "accepts events directories)"
+                )
+                continue
+        else:
+            problems.extend(f"{path}: {p}" for p in validate_file(path))
+            if args.expect_order:
+                problems.extend(
+                    check_order(
+                        path,
+                        [k.strip() for k in args.expect_order.split(",")],
+                    )
+                )
+        if args.conformance:
+            from distributeddataparallel_tpu.analysis import conformance
+
+            found = conformance.check_path(path)
+            problems.extend(str(f) for f in found)
+            if not found:
+                n_conformant += 1
     for p in problems:
         print(p, file=sys.stderr)
     if not problems:
@@ -133,6 +168,10 @@ def main(argv: list[str] | None = None) -> int:
             parts.append(
                 f"schema-sync OK ({len(EVENT_KINDS)} kinds, "
                 "emitters and registry agree)"
+            )
+        if args.conformance:
+            parts.append(
+                f"protocol conformance OK ({n_conformant} timeline(s))"
             )
         print("check_events: " + "; ".join(parts))
     return 1 if problems else 0

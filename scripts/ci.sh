@@ -6,10 +6,19 @@
 #
 # Order is cheapest-first so drift fails in seconds:
 #   1. ddplint --ast            AST rules (host-sync, broad-except,
-#                               unregistered emit kinds) — stdlib-only.
+#                               unregistered emit kinds, plus the
+#                               sync_lint AL105-AL108 concurrency
+#                               rules) — stdlib-only.
 #                               Exit 2 (a checker emitting a rule id the
 #                               registry doesn't know) is an operational
 #                               hard failure, distinct from findings
+#   1b. ddplint --protocol      small-scope model check of the declared
+#                               rendezvous / router / handoff /
+#                               allocator state machines (PL4xx) —
+#                               stdlib-only, exhaustive, sub-second.
+#                               The fleet/chaos smokes below also replay
+#                               their recorded timelines against the
+#                               same specs (check_events --conformance)
 #   2. ddp_meshsim --check      compile-only scale smoke: cnn + gpt2-small
 #                               (dp AND the zero2/zero3 sharded-update
 #                               variants) lowered/linted/sized on fake 8-
@@ -106,6 +115,9 @@ cd "$(dirname "$0")/.."
 echo "== ddplint --ast =="
 python scripts/ddplint.py --ast
 
+echo "== ddplint --protocol (model-check the declared state machines) =="
+python scripts/ddplint.py --protocol
+
 echo "== ddp_meshsim --check =="
 python scripts/ddp_meshsim.py --check
 
@@ -124,6 +136,8 @@ echo "== ddp_serve --fleet 1:2 --smoke (disaggregated prefill/decode) =="
 FLEET_SMOKE_DIR="$(mktemp -d)"
 python scripts/ddp_serve.py --fleet 1:2 --smoke \
     --events-dir "${FLEET_SMOKE_DIR}"
+echo "== check_events --conformance (fleet smoke timeline) =="
+python scripts/check_events.py --conformance "${FLEET_SMOKE_DIR}"
 rm -rf "${FLEET_SMOKE_DIR}"
 
 echo "== elastic shrink smoke (4 -> 3) =="
@@ -172,6 +186,8 @@ rm -rf "${INTEGRITY_SMOKE_DIR}"
 echo "== multi-host chaos smoke (host-kill -> resize; rdzv-kill -> re-host) =="
 HOSTGANG_SMOKE_DIR="$(mktemp -d)"
 JAX_PLATFORMS=cpu python scripts/chaos_smoke.py "${HOSTGANG_SMOKE_DIR}"
+echo "== check_events --conformance (chaos smoke timeline) =="
+python scripts/check_events.py --conformance "${HOSTGANG_SMOKE_DIR}"
 rm -rf "${HOSTGANG_SMOKE_DIR}"
 
 echo "== ddp_tune --check =="

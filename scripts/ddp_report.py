@@ -37,6 +37,9 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from distributeddataparallel_tpu.analysis.conformance import (  # noqa: E402
+    check_timeline,
+)
 from distributeddataparallel_tpu.observability.events import (  # noqa: E402
     load_timeline,
 )
@@ -412,6 +415,10 @@ def analyze(records: list[dict]) -> dict:
                     max(0.0, mean_restart - d["seconds"])
                     for d in el["downtimes"]
                 ), 3)
+
+    # Protocol conformance: replay the whole timeline against the
+    # declared state machines (analysis.protocol) — PL405 per violation.
+    out["conformance"] = [str(f) for f in check_timeline(records)]
     return out
 
 
@@ -763,6 +770,25 @@ def render_markdown(a: dict, events_dir: str) -> str:
         for l in a["lint"]:
             for f in l["findings"]:
                 lines += ["", f"- `{f}`"]
+    lines.append("")
+
+    # -- Protocol -----------------------------------------------------
+    lines += ["## Protocol", ""]
+    conf = a.get("conformance") or []
+    if not conf:
+        lines.append(
+            "Timeline conforms to the declared protocol specs "
+            "(rendezvous membership, request lifecycle, handoff NAK "
+            "budget — `analysis.protocol`): no PL405 violations."
+        )
+    else:
+        lines += [
+            f"**{len(conf)} PL405 violation(s)** — the recorded "
+            "timeline contradicts the declared protocol state "
+            "machines:",
+            "",
+        ]
+        lines += [f"- `{f}`" for f in conf]
     lines.append("")
 
     # -- Serving ------------------------------------------------------

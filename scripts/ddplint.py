@@ -4,20 +4,29 @@
 Layers (rule table: ``--list-rules``; registry in
 ``distributeddataparallel_tpu/analysis/rules.py``):
 
-  --ast     AST rules over the package source, dpp.py, and scripts/.
-            Stdlib-only: runs in any interpreter, no jax import.
-  --graph   Graph rules over the *traced/lowered* train steps of the
-            repo's own factories, exercised on tiny CPU-sized configs.
-            Traces and lowers but never compiles, so it is fast and
-            CPU-safe (forces JAX_PLATFORMS=cpu + 8 host devices).
-            This layer also runs the sharding-flow pass (SF2xx) over
-            each lowered module and — for steps that attach a schedule
-            IR (pipeline stages, bucketed grad-sync) — the
-            schedule-as-data lint (SL3xx).
+  --ast      AST rules over the package source, dpp.py, and scripts/
+             (AL1xx: the train-step pass in ``ast_rules`` plus the
+             concurrency/clock pass in ``sync_lint``).  Stdlib-only:
+             runs in any interpreter, no jax import.
+  --graph    Graph rules over the *traced/lowered* train steps of the
+             repo's own factories, exercised on tiny CPU-sized configs.
+             Traces and lowers but never compiles, so it is fast and
+             CPU-safe (forces JAX_PLATFORMS=cpu + 8 host devices).
+             This layer also runs the sharding-flow pass (SF2xx) over
+             each lowered module and — for steps that attach a schedule
+             IR (pipeline stages, bucketed grad-sync) — the
+             schedule-as-data lint (SL3xx).
+  --protocol Protocol rules (PL4xx): the small-scope model checker
+             exhaustively explores the declared rendezvous / router /
+             handoff / allocator state machines (2–4 actors, >=1
+             fault) — invariant violations arrive with a minimal
+             counterexample trace.  Stdlib-only, sub-second.
 
-With neither flag, both layers run.  ``--changed-only`` narrows the AST
-layer to files in ``git diff --name-only HEAD`` and skips the graph
-layer unless step-defining code changed — the fast local pre-push mode.
+With no layer flag, all three layers run.  ``--changed-only`` narrows
+the AST layer to files in ``git diff --name-only HEAD``, skips the
+graph layer unless step-defining code changed, and skips the protocol
+layer unless analysis/runtime/serving code changed — the fast local
+pre-push mode.
 ``--events-dir DIR`` additionally writes one schema-valid
 ``lint_report`` event per layer to ``DIR/events-lint.jsonl`` so run
 reports can show lint health next to runtime telemetry.
@@ -52,6 +61,14 @@ _GRAPH_TRIGGERS = (
     "dpp.py",
 )
 
+#: a protocol-layer run is warranted when the specs or the live modules
+#: they model changed
+_PROTOCOL_TRIGGERS = (
+    "distributeddataparallel_tpu/analysis/",
+    "distributeddataparallel_tpu/runtime/",
+    "distributeddataparallel_tpu/serving/",
+)
+
 #: graph-lint driver modes; "all" expands to every key
 DEFAULT_MODES = ("dp", "zero", "bucket", "bf16")
 ALL_MODES = ("dp", "zero", "bucket", "bf16", "fsdp", "pp", "serve")
@@ -83,7 +100,7 @@ def _changed_files(root: Path | None = None) -> list[str]:
 
 
 def run_ast(changed_only: bool, *, root: Path | None = None) -> list:
-    from distributeddataparallel_tpu.analysis import ast_rules
+    from distributeddataparallel_tpu.analysis import ast_rules, sync_lint
 
     root = root or ROOT
     targets = ast_rules.default_targets(root)
@@ -95,7 +112,25 @@ def run_ast(changed_only: bool, *, root: Path | None = None) -> list:
         ]
         if not targets:
             return []
-    return ast_rules.lint_paths(targets, root)
+    return (ast_rules.lint_paths(targets, root)
+            + sync_lint.lint_paths(targets, root))
+
+
+def run_protocol(*, verbose: bool = True) -> list:
+    """Exhaustively explore every shipped protocol spec (PL4xx)."""
+    from distributeddataparallel_tpu.analysis import protocol
+
+    findings: list = []
+    for rep in protocol.explore_all():
+        findings += rep.findings
+        if verbose:
+            status = "ok" if rep.ok else f"{len(rep.findings)} finding(s)"
+            print(
+                f"ddplint proto [{rep.spec}] {status} "
+                f"states={rep.n_states} moves={rep.n_moves} "
+                f"complete={rep.complete}"
+            )
+    return findings
 
 
 def _graph_cases(modes):
@@ -389,6 +424,10 @@ def main(argv=None) -> int:
                     help="run the AST layer (AL1xx rules)")
     ap.add_argument("--graph", action="store_true",
                     help="run the graph layer (GL0xx rules)")
+    ap.add_argument("--protocol", action="store_true",
+                    help="run the protocol layer (PL4xx rules): "
+                         "model-check the declared rendezvous/router/"
+                         "handoff/allocator state machines")
     ap.add_argument("--changed-only", action="store_true",
                     help="lint only files changed vs HEAD; skip the "
                          "graph layer unless step code changed")
@@ -413,8 +452,10 @@ def main(argv=None) -> int:
         print(rule_table())
         return 0
 
-    do_ast = args.ast or not args.graph
-    do_graph = args.graph or not args.ast
+    any_layer = args.ast or args.graph or args.protocol
+    do_ast = args.ast or not any_layer
+    do_graph = args.graph or not any_layer
+    do_protocol = args.protocol or not any_layer
     modes = ALL_MODES if args.modes == "all" else tuple(
         m.strip() for m in args.modes.split(",") if m.strip()
     )
@@ -426,6 +467,13 @@ def main(argv=None) -> int:
     by_layer: dict[str, list] = {}
     if do_ast:
         by_layer["ast"] = run_ast(args.changed_only)
+    if do_protocol:
+        if args.changed_only and not any(
+            c.startswith(_PROTOCOL_TRIGGERS) for c in _changed_files()
+        ):
+            print("ddplint proto: skipped (no protocol-adjacent changes)")
+        else:
+            by_layer["protocol"] = run_protocol()
     if do_graph:
         if args.changed_only and not any(
             c.startswith(_GRAPH_TRIGGERS) for c in _changed_files()

@@ -47,44 +47,48 @@ from distributeddataparallel_tpu.observability.events import (  # noqa: E402
 
 REGRESS_EXIT = 3
 
-#: metric-name patterns that mean "lower is better" in bench headlines;
-#: *_frac/_fraction are idle/waste shares (bubble, overhead, skew) — an
-#: improvement shrinks them, so they must not gate backwards
-_LOWER_BETTER = re.compile(
-    r"(bubble|step_s|_s$|bytes|overhead|_us$|_ms$|restart|latency|skew"
-    r"|dropped|_frac$|_fraction$)"
+#: Direction inference for bench-headline metric names: ONE ordered
+#: (pattern, direction) table, first match wins, default "higher".
+#: The ORDER carries the semantics — every earlier row exists to
+#: override a later, broader one:
+#:
+#: 1. "higher" WIN suffixes first.  Throughput rates (tok_s, img_s,
+#:    ..._per_s) and reclaimed_s (restart seconds the elastic resize
+#:    path gave BACK — it ends in _s and contains "restart", but more
+#:    of it is better) would otherwise hit row 3's ``_s$``/``restart``
+#:    and gate backwards; the win-shares gain_frac (autotune speedup),
+#:    _hit_frac (prefix-cache hit rate), _avoided_frac (prefill FLOPs
+#:    skipped) and _speedup would be shadowed by row 3's ``_frac$``.
+#: 2. "hard-zero" loss counters — the serving fleet's
+#:    ``dropped_req_total`` shape (requests lost through an engine kill
+#:    instead of drained-and-requeued).  A nonzero value fails the gate
+#:    even when the baseline was just as bad: "no worse than a lossy
+#:    baseline" is not a pass.  ``--allow-drops`` downgrades these to
+#:    ordinary lower-better.  Must precede row 3, whose ``dropped``
+#:    would claim them as merely lower-better.
+#: 3. "lower" cost/waste names: time (step_s, _s/_us/_ms, latency),
+#:    space (bytes), idle/waste shares (bubble, overhead, skew,
+#:    _frac/_fraction), and failure-adjacent counts (restart, dropped).
+#:
+#: Anything unmatched defaults to "higher" (plain throughput/score
+#: names).  tests/test_protocol_lint.py gates this table against every
+#: headline metric the bench scripts actually emit.
+_DIRECTION_TABLE: tuple[tuple[re.Pattern, str], ...] = (
+    (re.compile(r"(tok_s|img_s|_per_s|reclaimed_s|gain_frac|_hit_frac"
+                r"|_avoided_frac|_speedup)$"), "higher"),
+    (re.compile(r"dropped(_[a-z0-9]+)*_total$"), "hard-zero"),
+    (re.compile(r"(bubble|step_s|_s$|bytes|overhead|_us$|_ms$|restart"
+                r"|latency|skew|dropped|_frac$|_fraction$)"), "lower"),
 )
-
-#: loss-count metrics that must be exactly zero in a healthy run —
-#: the serving fleet's ``dropped_req_total`` (requests lost through an
-#: engine kill instead of drained-and-requeued).  A nonzero value fails
-#: the gate even when the baseline was just as bad: "no worse than a
-#: lossy baseline" is not a pass.  ``--allow-drops`` downgrades this to
-#: the ordinary lower-better comparison.
-_HARD_ZERO = re.compile(r"dropped(_[a-z0-9]+)*_total$")
-
-#: throughput names that END in a rate suffix (tok_s, img_s, ..._per_s)
-#: would otherwise hit _LOWER_BETTER's ``_s$`` and gate backwards —
-#: a serving tok/s IMPROVEMENT must not read as a regression.  Same for
-#: reclaimed_s: restart seconds the elastic resize path gave BACK
-#: (bench elastic_resize's restart_reclaimed_s) — it ends in _s and
-#: contains "restart", but more of it is better.  And the WIN-share
-#: suffixes: gain_frac (autotune speedup over the hand-picked config),
-#: _hit_frac (prefix-cache hit rate), _avoided_frac (prefill FLOPs the
-#: cache skipped), _speedup (fast-path tokens/s ratio) — they end in
-#: _frac (or look like a plain name) but more of each is better; this
-#: pattern is checked FIRST so _LOWER_BETTER's ``_frac$`` cannot
-#: shadow them.
-_HIGHER_BETTER = re.compile(
-    r"(tok_s|img_s|_per_s|reclaimed_s|gain_frac|_hit_frac|_avoided_frac"
-    r"|_speedup)$"
-)
+_DEFAULT_DIRECTION = "higher"
 
 
 def _bench_direction(name: str) -> str:
-    if _HIGHER_BETTER.search(name):
-        return "higher"
-    return "lower" if _LOWER_BETTER.search(name) else "higher"
+    """'higher' | 'lower' | 'hard-zero' for a headline metric name."""
+    for pattern, direction in _DIRECTION_TABLE:
+        if pattern.search(name):
+            return direction
+    return _DEFAULT_DIRECTION
 
 
 def load_run(path: str) -> tuple[dict, str]:
@@ -115,8 +119,15 @@ def gate_metrics_for(summary: dict, source: str,
     name-inferred direction)."""
     if source != "bench":
         return bl.GATE_METRICS
+    # hard-zero metrics still gate pairwise as lower-better here; the
+    # absolute value>0 check is main()'s post-pass over the same table
     return {
-        name: (_bench_direction(name), default_tol)
+        name: (
+            {"hard-zero": "lower"}.get(
+                _bench_direction(name), _bench_direction(name)
+            ),
+            default_tol,
+        )
         for name in sorted(summary)
     }
 
@@ -187,7 +198,7 @@ def main(argv: list[str] | None = None) -> int:
     if not args.allow_drops:
         for name in sorted(summary):
             value = summary[name]
-            if not (_HARD_ZERO.search(name)
+            if not (_bench_direction(name) == "hard-zero"
                     and isinstance(value, (int, float))
                     and not isinstance(value, bool) and value > 0):
                 continue

@@ -532,7 +532,10 @@ def test_perf_gate_fleet_headline_directions():
     assert perf_gate._bench_direction("fleet_p99_ttft_improvement") == "higher"
     assert perf_gate._bench_direction("fleet_p99_ttft_s") == "lower"
     assert perf_gate._bench_direction("handoff_s") == "lower"
-    assert perf_gate._bench_direction("dropped_req_total") == "lower"
+    # loss counters now classify as their own hard-zero direction (one
+    # ordered table row); gate_metrics_for maps them back to a
+    # lower-better pairwise compare
+    assert perf_gate._bench_direction("dropped_req_total") == "hard-zero"
     # the neighbors keep their directions
     assert perf_gate._bench_direction("serve_tok_s") == "higher"
     assert perf_gate._bench_direction("tune_gain_frac") == "higher"
