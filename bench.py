@@ -2709,11 +2709,12 @@ def _serving_fleet_child(out_path, env):
     )
     trace = make_trace(lcfg)
 
-    def build(prefill, decode):
+    def build(prefill, decode, events=None):
         fleet = ServingFleet(
             model, params, ecfg,
             FleetConfig(prefill=prefill, decode=decode,
                         prefill_chunks_per_step=4),
+            events=events,
         )
         # Warm every engine's programs outside the timed region, then
         # reset the stats the summary reads.  Each jitted program lives
@@ -2763,8 +2764,30 @@ def _serving_fleet_child(out_path, env):
         return out
 
     mono = timed(build(0, 3))
-    fleet = build(1, 2)
+    # The disagg run records its span timeline so the TTFT
+    # decomposition headlines come from the SAME trace the perf
+    # numbers do (warmup fids are filtered out below).
+    from distributeddataparallel_tpu.observability import critical_path
+    from distributeddataparallel_tpu.observability.events import (
+        EventLog,
+        read_events,
+    )
+
+    span_log_path = os.path.join(
+        os.path.dirname(out_path), "events-fleet.jsonl"
+    )
+    span_log = EventLog(span_log_path, "bench-fleet")
+    fleet = build(1, 2, events=span_log)
     disagg = timed(fleet)
+    span_log.close()
+    timed_fids = set(fleet.completed)
+    decomps = [
+        d for d in critical_path.request_decompositions(
+            read_events(span_log_path)
+        )
+        if d["req"] in timed_fids
+    ]
+    droll = critical_path.ttft_rollup(decomps)
 
     # Robustness run: same trace, one decode engine killed mid-drive.
     kfleet = build(1, 2)
@@ -2817,6 +2840,19 @@ def _serving_fleet_child(out_path, env):
             disagg["affinity_hits"] / max(disagg["routed"], 1), 3
         ),
         "tiers": disagg.get("tiers"),
+        # TTFT decomposition over the disagg run's span timeline:
+        # share fractions + the span-tree self-consistency error
+        # (all lower-better in perf_gate via _share_frac/_decomp_err).
+        "ttft_queue_share_frac": round(
+            droll.get("ttft_queue_share_frac", 0.0), 4
+        ),
+        "ttft_handoff_share_frac": round(
+            droll.get("ttft_handoff_share_frac", 0.0), 4
+        ),
+        "ttft_decomp_err_frac": round(
+            droll.get("ttft_decomp_err_frac", 1.0), 4
+        ),
+        "ttft_decomp_requests": droll.get("requests", 0),
         # Kill run (robustness, not perf): every request must still
         # complete — dropped_req_total is hard-zero in perf_gate.
         "dropped_req_total": len(kfleet.dropped),
@@ -2834,7 +2870,10 @@ def bench_serving_fleet() -> dict:
     run drains with zero dropped requests.  Headline keys
     fleet_tok_s_speedup (higher-better via _speedup$), fleet_p99_ttft_s
     / handoff_s (lower-better via _s$), dropped_req_total (lower-better
-    + hard-zero)."""
+    + hard-zero), plus the TTFT decomposition from the disagg run's
+    span timeline: ttft_queue_share_frac / ttft_handoff_share_frac /
+    ttft_decomp_err_frac (all lower-better via the _share_frac /
+    _decomp_err_frac row)."""
     import json as _json
     import multiprocessing as mp
     import os
@@ -3106,6 +3145,14 @@ def main() -> None:
             "fleet_p99_ttft_s": fleet.get("fleet_p99_ttft_s"),
             "handoff_s": fleet.get("handoff_s"),
             "dropped_req_total": fleet.get("dropped_req_total"),
+            # flat on purpose (perf_gate): the tracing rollup's
+            # _share_frac / _decomp_err_frac row pins all three
+            # lower-better
+            "ttft_queue_share_frac": fleet.get("ttft_queue_share_frac"),
+            "ttft_handoff_share_frac": fleet.get(
+                "ttft_handoff_share_frac"
+            ),
+            "ttft_decomp_err_frac": fleet.get("ttft_decomp_err_frac"),
             # (fleet_beats_mono stays in extras.serving_fleet — the
             # headline only carries what perf_gate can gate, and the
             # 1.9KB tail budget is nearly full)

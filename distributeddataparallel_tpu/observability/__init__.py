@@ -37,12 +37,25 @@ from .cost_model import (
     transformer_fwd_flops,
     xla_cost_analysis,
 )
+from .critical_path import (
+    check_lineage,
+    critical_path_of,
+    request_decompositions,
+    tier_rollups,
+    ttft_rollup,
+)
 from .events import (
     EventLog,
     events_path,
     load_timeline,
     merge_timeline,
     read_events,
+)
+from .httpmetrics import (
+    MetricsHTTPServer,
+    parse_prometheus_text,
+    prometheus_text,
+    scrape,
 )
 from .goodput import GoodputLedger, goodput_from_timeline
 from .memory import MemoryTelemetry, live_array_bytes
@@ -66,6 +79,14 @@ from .schema import (
 from .straggler import straggler_report
 from .trace import Tracer
 from .trace_export import to_trace_events, validate_trace, write_trace
+from .tracecontext import (
+    SpanContext,
+    derive_span_id,
+    derive_trace_id,
+    from_fields,
+    from_traceparent,
+    root_context,
+)
 
 __all__ = [
     "ENVELOPE",
@@ -81,15 +102,23 @@ __all__ = [
     "JsonlExporter",
     "MFUMeter",
     "MemoryTelemetry",
+    "MetricsHTTPServer",
     "MetricsRegistry",
     "ProfilerOrchestrator",
     "RunSummaryBuilder",
+    "SpanContext",
     "TextExporter",
     "Tracer",
     "append_run",
+    "check_lineage",
     "compare_to_baseline",
+    "critical_path_of",
     "default_rules",
+    "derive_span_id",
+    "derive_trace_id",
     "events_path",
+    "from_fields",
+    "from_traceparent",
     "goodput_from_timeline",
     "json_safe",
     "live_array_bytes",
@@ -99,15 +128,22 @@ __all__ = [
     "mlp_fwd_flops",
     "parse_alert_spec",
     "parse_profile_steps",
+    "parse_prometheus_text",
     "peak_flops_for",
     "profile_trace",
+    "prometheus_text",
     "read_events",
     "read_runs",
+    "request_decompositions",
+    "root_context",
     "run_summary_from_timeline",
     "save_baseline",
+    "scrape",
     "simple_cnn_fwd_flops",
     "straggler_report",
+    "tier_rollups",
     "to_trace_events",
+    "ttft_rollup",
     "train_step_flops",
     "transformer_fwd_flops",
     "validate_file",

@@ -16,7 +16,12 @@ from __future__ import annotations
 import json
 import math
 
-SCHEMA_VERSION = 1
+#: v2 added the OPTIONAL trace-context envelope fields (trace / span /
+#: parent).  v1 records — written by pre-tracing builds — still
+#: validate: the version check accepts anything in SUPPORTED_VERSIONS,
+#: and the trace fields are optional in both directions.
+SCHEMA_VERSION = 2
+SUPPORTED_VERSIONS = frozenset({1, 2})
 
 # Fields every record carries, in canonical order:
 #   v    — schema version (int)
@@ -28,6 +33,28 @@ SCHEMA_VERSION = 1
 #   proc — writer identity: process index (int) or "supervisor"
 #   kind — record type, one of EVENT_KINDS
 ENVELOPE = ("v", "ts", "seq", "proc", "kind")
+
+# Optional trace-context envelope fields (schema v2): any record MAY
+# carry them; a record opts into the trace-context contract by carrying
+# ``trace``, and from then on all three must be lowercase-hex ids of
+# the W3C shapes below (128-bit trace, 64-bit span), with ``parent``
+# additionally requiring ``span`` — a parent edge with no span of its
+# own is meaningless.  Without ``trace``, ``span``/``parent`` stay
+# free-form: the trainer's Tracer has emitted nesting-scope NAMES
+# (``parent: "epoch"``) in those fields since v1, and v1 records must
+# keep validating.  Propagation rules live in observability/tracecontext.
+#   trace  — 32-hex trace id: one request's end-to-end journey
+#   span   — 16-hex span id: this record's unit of work
+#   parent — 16-hex id of the parent span within the same trace
+TRACE_FIELDS = ("trace", "span", "parent")
+_TRACE_HEX_LEN = {"trace": 32, "span": 16, "parent": 16}
+
+
+def _is_hex_id(value, n: int) -> bool:
+    return (
+        isinstance(value, str) and len(value) == n
+        and all(c in "0123456789abcdef" for c in value)
+    )
 
 # kind -> required kind-specific fields.  Extra fields are allowed (the
 # schema is open for forward-compat); missing required fields are not.
@@ -153,10 +180,25 @@ def validate_record(rec, *, lineno: int | None = None) -> list[str]:
         if field not in rec:
             problems.append(f"{where}missing envelope field {field!r}")
     v = rec.get("v")
-    if v is not None and v != SCHEMA_VERSION:
+    if v is not None and v not in SUPPORTED_VERSIONS:
         problems.append(
-            f"{where}schema version {v!r} != supported {SCHEMA_VERSION}"
+            f"{where}schema version {v!r} not in supported "
+            f"{sorted(SUPPORTED_VERSIONS)}"
         )
+    # ``trace`` opts the record into the trace-context contract; bare
+    # ``span``/``parent`` are the Tracer's legacy nesting-scope names.
+    if rec.get("trace") is not None:
+        for field in TRACE_FIELDS:
+            value = rec.get(field)
+            if value is not None and not _is_hex_id(
+                value, _TRACE_HEX_LEN[field]
+            ):
+                problems.append(
+                    f"{where}{field} is not {_TRACE_HEX_LEN[field]}-hex: "
+                    f"{value!r}"
+                )
+        if rec.get("parent") is not None and rec.get("span") is None:
+            problems.append(f"{where}parent without span")
     kind = rec.get("kind")
     if kind is not None:
         if kind not in EVENT_KINDS:

@@ -19,7 +19,14 @@ Mapping:
 * discrete incidents — nan_skip, chaos_inject, watchdog_fire,
   restart_attempt / restart_exhausted, loader_starved, alert — become
   ``"i"`` instant events, so a restart is a visible mark on the
-  supervisor track at the moment it happened.
+  supervisor track at the moment it happened;
+* spans carrying schema-v2 trace context get a *per-trace thread
+  track* (``req:<trace8>``) inside their process — concurrent requests
+  stop rendering as one falsely-nested pile on tid 0 — and each
+  multi-span trace is stitched across processes with legacy flow
+  events (``"s"``/``"t"``/``"f"`` sharing the trace id), so clicking a
+  request's root span in Perfetto draws arrows through its prefill →
+  handoff → decode spans on whichever engines served it.
 
 Timestamps are microseconds relative to the earliest instant in the
 run (trace viewers want small numbers, not epoch µs).
@@ -126,6 +133,11 @@ def to_trace_events(records: list[dict]) -> dict:
 
     events: list[dict] = []
     seen_pids: dict[int, str] = {}
+    # Per-trace track + flow bookkeeping: trace id -> tid (tid 0 stays
+    # the writer's "main" track), and trace id -> its span events in
+    # append order (flow-stitched after the scan).
+    trace_tids: dict[str, int] = {}
+    flow_groups: dict[str, list[dict]] = {}
     for rec in records:
         proc = rec.get("proc")
         kind = rec.get("kind")
@@ -139,16 +151,27 @@ def to_trace_events(records: list[dict]) -> dict:
             dur_s = rec.get("dur_s")
             if not isinstance(dur_s, (int, float)):
                 continue
-            events.append({
+            trace_id = rec.get("trace")
+            tid = 0
+            if isinstance(trace_id, str) and trace_id:
+                tid = trace_tids.setdefault(trace_id, len(trace_tids) + 1)
+            ev = {
                 "ph": "X",
                 "name": str(rec.get("name", "span")),
                 "cat": "span",
                 "pid": pid,
-                "tid": 0,
+                "tid": tid,
                 "ts": us(_span_start_s(rec)),
                 "dur": float(dur_s) * 1e6,
-                "args": _args(rec, ("step", "epoch", "depth", "parent")),
-            })
+                "args": _args(
+                    rec,
+                    ("step", "epoch", "depth", "parent", "trace",
+                     "span", "req", "engine"),
+                ),
+            }
+            events.append(ev)
+            if tid:
+                flow_groups.setdefault(trace_id, []).append(ev)
             # step spans double as the step_s counter samples
             if rec.get("name") == "step":
                 events.append({
@@ -199,10 +222,42 @@ def to_trace_events(records: list[dict]) -> dict:
                     },
                 })
 
+    # Flow stitching: each multi-span trace becomes one flow (legacy
+    # "s"/"t"/"f" phases sharing the trace id), anchored at each span's
+    # start on its own pid/tid — the cross-process arrow through a
+    # request's prefill/handoff/decode hops.
+    flows: list[dict] = []
+    for trace_id, group in flow_groups.items():
+        if len(group) < 2:
+            continue
+        group = sorted(group, key=lambda e: (e["ts"], e["ts"] + e["dur"]))
+        for i, anchor in enumerate(group):
+            ph = "s" if i == 0 else ("f" if i == len(group) - 1 else "t")
+            fev = {
+                "ph": ph,
+                "name": f"req:{trace_id[:8]}",
+                "cat": "trace",
+                "id": trace_id[:16],
+                "pid": anchor["pid"],
+                "tid": anchor["tid"],
+                "ts": anchor["ts"],
+            }
+            if ph == "f":
+                fev["bp"] = "e"  # bind to the enclosing slice
+            flows.append(fev)
+    events.extend(flows)
+
     # Per-track monotonic order (viewers require ts-sorted streams per
     # track; a global ts sort gives that and keeps the file diffable).
     events.sort(key=lambda e: (e["ts"], e["pid"]))
 
+    trace8_of_tid = {
+        tid: f"req:{trace_id[:8]}" for trace_id, tid in trace_tids.items()
+    }
+    trace_threads = sorted({
+        (e["pid"], e["tid"]) for e in events
+        if e.get("ph") == "X" and e.get("tid")
+    })
     meta: list[dict] = []
     for pid in sorted(seen_pids):
         meta.append({
@@ -217,14 +272,22 @@ def to_trace_events(records: list[dict]) -> dict:
             "ph": "M", "name": "thread_name", "pid": pid, "tid": 0,
             "args": {"name": "main"},
         })
+    for pid, tid in trace_threads:
+        meta.append({
+            "ph": "M", "name": "thread_name", "pid": pid, "tid": tid,
+            "args": {"name": trace8_of_tid.get(tid, f"trace {tid}")},
+        })
     return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
 
 
 def validate_trace(trace: dict) -> list[str]:
     """Structural check of a trace_event object (empty list = valid):
-    required top-level shape, required per-event fields by phase, and
-    per-(pid, tid) monotonic timestamps.  Used by tests and by
-    ``ddp_trace.py --check`` before handing the file to a viewer."""
+    required top-level shape, required per-event fields by phase,
+    per-(pid, tid) monotonic timestamps, and flow integrity — every
+    flow id must open with exactly one ``"s"``, close with a ``"f"``,
+    and never continue (``"t"``/``"f"``) before it opened.  Used by
+    tests and by ``ddp_trace.py --check`` before handing the file to a
+    viewer."""
     problems = []
     if not isinstance(trace, dict) or "traceEvents" not in trace:
         return ["trace is not an object with a traceEvents array"]
@@ -232,12 +295,13 @@ def validate_trace(trace: dict) -> list[str]:
     if not isinstance(events, list):
         return ["traceEvents is not an array"]
     last_ts: dict[tuple, float] = {}
+    flows: dict[str, list[tuple[str, float]]] = {}  # id -> [(ph, ts)]
     for i, ev in enumerate(events):
         if not isinstance(ev, dict):
             problems.append(f"event {i}: not an object")
             continue
         ph = ev.get("ph")
-        if ph not in ("X", "C", "i", "M"):
+        if ph not in ("X", "C", "i", "M", "s", "t", "f"):
             problems.append(f"event {i}: unsupported phase {ph!r}")
             continue
         for field in ("name", "pid", "tid"):
@@ -253,12 +317,37 @@ def validate_trace(trace: dict) -> list[str]:
             problems.append(f"event {i}: complete event without dur")
         if ph == "i" and ev.get("s") not in ("g", "p", "t"):
             problems.append(f"event {i}: instant event bad scope {ev.get('s')!r}")
+        if ph in ("s", "t", "f"):
+            fid = ev.get("id")
+            if not isinstance(fid, (str, int)):
+                problems.append(f"event {i}: flow event without id")
+                continue
+            flows.setdefault(str(fid), []).append((ph, float(ts)))
         key = (ev.get("pid"), ev.get("tid"))
         if ts < last_ts.get(key, float("-inf")):
             problems.append(
                 f"event {i}: ts {ts} regresses on track {key}"
             )
         last_ts[key] = float(ts)
+    # Flow integrity, order-insensitive (same-ts events from different
+    # pids interleave arbitrarily in the global sort): each id opens
+    # exactly once, closes exactly once, and the open/close bracket
+    # every step in time.
+    for fid, phases in sorted(flows.items()):
+        n_s = sum(1 for ph, _ in phases if ph == "s")
+        n_f = sum(1 for ph, _ in phases if ph == "f")
+        if n_s != 1 or n_f != 1:
+            problems.append(
+                f"flow {fid}: {n_s} start(s) / {n_f} finish(es), want "
+                "exactly 1 of each — dangling flow id"
+            )
+            continue
+        t_s = next(t for ph, t in phases if ph == "s")
+        t_f = next(t for ph, t in phases if ph == "f")
+        if any(not t_s <= t <= t_f for ph, t in phases if ph == "t"):
+            problems.append(
+                f"flow {fid}: step outside its start/finish window"
+            )
     return problems
 
 

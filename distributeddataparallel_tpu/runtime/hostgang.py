@@ -190,9 +190,20 @@ class HostGangMember:
                         "rendezvous server never published its address"
                     )
                 time.sleep(0.02)
+        # Membership-session span context: one root per member process,
+        # deterministic from (gang root, member name) so a respawned
+        # incarnation rejoins the SAME trace — its RPCs correlate with
+        # the pre-crash ones in the merged timeline.
+        from distributeddataparallel_tpu.observability.tracecontext import (
+            root_context,
+        )
+
         return TCPRendezvousClient(
             address_book=self.book,
             retry=RetryPolicy(attempts=6, base_s=0.05, max_s=0.4),
+            trace=root_context(
+                "hostgang", os.path.basename(self.root), self.name
+            ).to_fields(),
         )
 
     # -- lifecycle ------------------------------------------------------

@@ -551,15 +551,24 @@ class _Handler(socketserver.StreamRequestHandler):
                 # process answers nobody.  Dropping the socket mid-
                 # request is exactly the reset the client must absorb.
                 return
+            tfields = {}
             try:
                 req = json.loads(raw.decode())
                 op = req.pop("op")
+                # Trace-context envelope (schema v2): plain-data fields
+                # riding the payload, NOT store-method kwargs — pop
+                # before dispatch, echo in the reply so both sides of
+                # the RPC correlate under one span context.
+                tfields = {
+                    k: req.pop(k)
+                    for k in ("trace", "span", "parent") if k in req
+                }
                 if op not in _TCP_OPS:
                     raise ValueError(f"unknown op {op!r}")
                 result = getattr(store, op)(**req)
                 if isinstance(result, set):
                     result = sorted(result)
-                reply = {"ok": True, "result": result, "gen": gen}
+                reply = {"ok": True, "result": result, "gen": gen, **tfields}
             # ddplint: allow[broad-except] — protocol boundary: every
             # failure becomes a structured error reply, never a dead socket
             except Exception as exc:  # noqa: BLE001
@@ -567,6 +576,7 @@ class _Handler(socketserver.StreamRequestHandler):
                     "ok": False, "gen": gen,
                     "error": f"{type(exc).__name__}: {exc}",
                     "fenced": isinstance(exc, RendezvousFencedError),
+                    **tfields,
                 }
             self.wfile.write((json.dumps(reply) + "\n").encode())
             self.wfile.flush()
@@ -671,12 +681,22 @@ class TCPRendezvousClient:
     def __init__(self, address: str | None = None, *,
                  timeout_s: float = 60.0,
                  retry: RetryPolicy | None = None,
-                 address_book: AddressBook | None = None):
+                 address_book: AddressBook | None = None,
+                 trace: dict | None = None):
         if address is None and address_book is None:
             raise ValueError("need an address or an address_book")
         self._static_address = address
         self._book = address_book
         self._timeout_s = float(timeout_s)
+        # Span-context fields stamped onto every RPC payload (and echoed
+        # back by the server).  Plain data — the server pops them before
+        # dispatching to the store, so old servers that predate schema
+        # v2 are the only ones that would choke; within one build the
+        # wire stays compatible in both directions (absent = no trace).
+        self.trace = {
+            k: str(v) for k, v in (trace or {}).items()
+            if k in ("trace", "span", "parent") and v
+        }
         self.retry = retry or RetryPolicy()
         self.generation_seen = -1
         self.epoch_cache: dict[int, dict] = {}
@@ -738,7 +758,9 @@ class TCPRendezvousClient:
     def _rpc_once(self, op: str, kw: dict):
         if self._sock is None:
             self._connect()
-        self._sock.sendall((json.dumps({"op": op, **kw}) + "\n").encode())
+        self._sock.sendall(
+            (json.dumps({"op": op, **self.trace, **kw}) + "\n").encode()
+        )
         raw = self._rfile.readline()
         if not raw:
             raise ConnectionError("rendezvous server closed the connection")

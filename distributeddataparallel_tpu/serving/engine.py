@@ -80,6 +80,11 @@ from distributeddataparallel_tpu.serving.kv_cache import (
     scatter_spec,
     set_pool_blocks,
 )
+from distributeddataparallel_tpu.observability.tracecontext import (
+    SpanContext,
+    from_fields,
+    root_context,
+)
 from distributeddataparallel_tpu.serving.scheduler import (
     Request,
     Scheduler,
@@ -128,6 +133,7 @@ class InferenceEngine:
         events=None,
         registry=None,
         time_fn=time.monotonic,
+        name: str = "engine",
     ):
         from distributeddataparallel_tpu.models.generate import (
             _quant_decode_model,
@@ -145,6 +151,10 @@ class InferenceEngine:
         self.events = events
         self.registry = registry
         self._time = time_fn
+        #: Fleet-unique engine name ("prefill-0", "decode-1", ...);
+        #: span ids derive from it, so it must be stable across a
+        #: VirtualClock replay.
+        self.name = name
         self._step_idx = 0
         self._next_rid = 0
         self.completed: dict[int, Request] = {}
@@ -154,6 +164,10 @@ class InferenceEngine:
             deque()
         )
         self.handoffs_in = 0
+        # rids whose trace ROOT this engine created itself (no parent
+        # context arrived with the submit): _finish emits the root span
+        # record for these, a fleet parent owns it otherwise.
+        self._own_roots: set[int] = set()
 
         quantized = config.quantize_weights
         if quantized:
@@ -361,7 +375,15 @@ class InferenceEngine:
         *,
         arrival_s: float | None = None,
         session=None,
+        trace: dict | None = None,
     ) -> int:
+        """``trace`` is the PARENT span-context fields (a fleet's
+        per-request root) — the engine derives its own child spans from
+        it.  When absent the engine starts a trace of its own (this
+        request span becomes the root), so standalone runs get the same
+        span tree shape minus the fleet layer.  Ids derive from
+        ``(self.name, rid)`` — pure functions of the submit order, so a
+        VirtualClock replay reproduces them byte-identically."""
         rid = self._next_rid
         self._next_rid += 1
         req = Request(
@@ -372,9 +394,18 @@ class InferenceEngine:
                 self._time() if arrival_s is None else float(arrival_s)
             ),
             session=session,
+            trace=self._parent_ctx(trace, rid).to_fields(),
         )
         self.scheduler.submit(req)
         return rid
+
+    def _parent_ctx(self, trace: dict | None, rid: int) -> SpanContext:
+        """The context this request's engine-local spans parent to."""
+        ctx = from_fields(trace)
+        if ctx is None:
+            ctx = root_context("engine", self.name, rid)
+            self._own_roots.add(rid)
+        return ctx
 
     def has_work(self) -> bool:
         return bool(self._pending_injections) or self.scheduler.has_work()
@@ -405,6 +436,11 @@ class InferenceEngine:
             "arrival_s": req.arrival_s,
             "first_token_s": req.first_token_s,
             "ctx_len": req.ctx_len,
+            # Parent span-context fields ride the handoff frame header
+            # as plain data: the decode engine derives ITS spans from
+            # the same parent, so the request's span tree stays
+            # connected across the process boundary.
+            "trace": req.trace,
         }
         return HandoffPayload(
             meta, extract_kv_blocks(self.pool, req.final_blocks)
@@ -426,6 +462,7 @@ class InferenceEngine:
             max_new_tokens=int(meta["max_new_tokens"]),
             arrival_s=float(meta.get("arrival_s") or 0.0),
             session=meta.get("session"),
+            trace=self._parent_ctx(meta.get("trace"), rid).to_fields(),
         )
         req.generated = [int(t) for t in meta.get("generated") or ()]
         req.first_token_s = meta.get("first_token_s")
@@ -489,6 +526,8 @@ class InferenceEngine:
                 slot=req.slot,
                 queued_s=req.admit_s - req.arrival_s,
                 handoff=True,
+                engine=self.name,
+                **self._span_of(req, "decode"),
             )
             if self.config.prefix_cache:
                 # Publish the landed context into the prefix trie so
@@ -505,8 +544,44 @@ class InferenceEngine:
         if self.events is not None:
             self.events.emit(kind, **fields)
 
+    def _child_fields(self, req: Request, role: str) -> dict:
+        """Span-context envelope fields for this engine's ``role`` span
+        of ``req`` — a deterministic child of the request's parent
+        context (the fleet root, or this engine's own root).  All
+        engine-local spans parent DIRECTLY on that context, never on
+        each other: a killed engine then can't orphan a sibling span it
+        emitted before dying."""
+        ctx = from_fields(req.trace)
+        if ctx is None:
+            return {}
+        return ctx.child(role, self.name, req.rid).to_fields()
+
+    def _span_of(self, req: Request, role: str) -> dict:
+        """trace + span (no parent) marking a NON-span record as
+        belonging to one of this request's spans — membership
+        annotation, not a tree edge."""
+        fields = self._child_fields(req, role)
+        fields.pop("parent", None)
+        return fields
+
     def _observe_ttft(self, req: Request) -> None:
         req.first_token_s = self._time()
+        # The prefill segment of the request's span tree: admission to
+        # first token on THIS engine.  ``start_s``/``end_s`` are in the
+        # engine's injected clock domain (EventLog's ``ts`` is always
+        # wall), which is what lets critical_path decompose TTFT
+        # consistently under a VirtualClock.
+        start = req.admit_s if req.admit_s is not None else req.arrival_s
+        self.emit(
+            "span",
+            name=f"prefill:{req.rid}",
+            dur_s=req.first_token_s - start,
+            start_s=start,
+            end_s=req.first_token_s,
+            req=req.rid,
+            engine=self.name,
+            **self._child_fields(req, "prefill"),
+        )
         if self.registry is not None:
             self.registry.histogram("serve_ttft_s").observe(
                 req.first_token_s - req.arrival_s
@@ -533,6 +608,8 @@ class InferenceEngine:
             latency_s=req.done_s - req.arrival_s,
             preemptions=req.preemptions,
             retired_blocks=retired,
+            engine=self.name,
+            **self._span_of(req, "serve"),
         )
         # A per-request span on the timeline: Perfetto renders it as a
         # complete ("X") slice via the existing span mapping.
@@ -540,7 +617,45 @@ class InferenceEngine:
             "span",
             name=f"request:{req.rid}",
             dur_s=req.done_s - req.arrival_s,
+            start_s=req.arrival_s,
+            end_s=req.done_s,
+            req=req.rid,
+            engine=self.name,
+            **self._child_fields(req, "serve"),
         )
+        # The decode segment: first token (or handoff injection) to
+        # completion.  Zero-length for a prefill-tier one-token run —
+        # skipped, there is no decode phase to show.
+        dstart = (
+            req.admit_s if req.handoff
+            else (req.first_token_s or req.done_s)
+        )
+        if dstart is not None and req.done_s > dstart:
+            self.emit(
+                "span",
+                name=f"decode:{req.rid}",
+                dur_s=req.done_s - dstart,
+                start_s=dstart,
+                end_s=req.done_s,
+                req=req.rid,
+                engine=self.name,
+                **self._child_fields(req, "decode"),
+            )
+        if req.rid in self._own_roots:
+            # Standalone run: nobody upstream owns the trace, so the
+            # engine closes it with the root span itself.
+            self._own_roots.discard(req.rid)
+            self.emit(
+                "span",
+                name=f"req:{req.rid}",
+                dur_s=req.done_s - req.arrival_s,
+                start_s=req.arrival_s,
+                end_s=req.done_s,
+                ttft_s=ttft,
+                req=req.rid,
+                engine=self.name,
+                **(req.trace or {}),
+            )
         if self.registry is not None:
             self.registry.counter("serve_requests_done").inc()
             self.registry.counter("serve_tokens_out").inc(
@@ -616,6 +731,8 @@ class InferenceEngine:
                 ctx_tokens=req.ctx_len,
                 slot=req.slot,
                 queued_s=req.admit_s - req.arrival_s,
+                engine=self.name,
+                **self._span_of(req, "serve"),
             )
             if self.config.prefix_cache:
                 self.prefix_admits += 1
@@ -628,6 +745,7 @@ class InferenceEngine:
                         req=req.rid,
                         tokens=req.prefix_hit_tokens,
                         ctx=req.ctx_len,
+                        **self._span_of(req, "serve"),
                     )
 
         c = self.config
@@ -644,7 +762,8 @@ class InferenceEngine:
                 jnp.int32(start + n),
             )
             self.emit(
-                "prefill_chunk", req=req.rid, start=start, len=n
+                "prefill_chunk", req=req.rid, start=start, len=n,
+                **self._span_of(req, "prefill"),
             )
             if self.config.prefix_cache:
                 # Rows [0, start + n) are finalized: publish the full

@@ -55,6 +55,13 @@ from distributeddataparallel_tpu.serving.engine import (
     EngineConfig,
     InferenceEngine,
 )
+from distributeddataparallel_tpu.observability.httpmetrics import (
+    scrape as scrape_metrics,
+)
+from distributeddataparallel_tpu.observability.tracecontext import (
+    SpanContext,
+    root_context,
+)
 from distributeddataparallel_tpu.serving.handoff import (
     MAX_ATTEMPTS,
     HandoffReceiver,
@@ -100,6 +107,14 @@ def _prefill_tier_config(
 
 def _pct(values, q: float) -> float:
     return float(np.percentile(np.asarray(values, np.float64), q))
+
+
+def _req_root(fid) -> SpanContext:
+    """The root span context of fleet request ``fid``.  Derived (never
+    drawn), so any fleet component — either execution mode, any
+    incarnation after a requeue — recovers the same trace id from the
+    fid alone, and a VirtualClock replay reproduces ids byte-for-byte."""
+    return root_context("req", fid)
 
 
 # ---------------------------------------------------------------------------
@@ -150,14 +165,15 @@ class ServingFleet:
         for i in range(fleet_config.prefill):
             name = f"prefill-{i}"
             self.engines[name] = InferenceEngine(
-                model, params, pcfg, events=events, time_fn=time_fn
+                model, params, pcfg, events=events, time_fn=time_fn,
+                name=name,
             )
             self.router.register_engine(name, "prefill")
         for i in range(fleet_config.decode):
             name = f"decode-{i}"
             self.engines[name] = InferenceEngine(
                 model, params, engine_config, events=events,
-                time_fn=time_fn,
+                time_fn=time_fn, name=name,
             )
             self.router.register_engine(name, "decode")
         self._senders: dict[tuple[str, str], HandoffSender] = {}
@@ -200,7 +216,8 @@ class ServingFleet:
         )
         try:
             record = self.router.route(
-                fid, prompt, max_new_tokens, session=session
+                fid, prompt, max_new_tokens, session=session,
+                trace=_req_root(fid).to_fields(),
             )
         except RouterError:
             self.dropped.append(fid)
@@ -219,12 +236,14 @@ class ServingFleet:
             rid = self.engines[eng_name].submit(
                 record["prompt"], record["max_new_tokens"],
                 arrival_s=arrival, session=record["session"],
+                trace=record["trace"],
             )
         else:
             eng_name = record["prefill"]
             rid = self.engines[eng_name].submit(
                 record["prompt"], 1,
                 arrival_s=arrival, session=record["session"],
+                trace=record["trace"],
             )
         self._rid2fid[(eng_name, rid)] = fid
 
@@ -237,6 +256,7 @@ class ServingFleet:
             record = self.router.route(
                 fid, record["prompt"], record["max_new_tokens"],
                 session=record["session"],
+                trace=record.get("trace") or _req_root(fid).to_fields(),
             )
         except RouterError:
             self.dropped.append(fid)
@@ -296,13 +316,32 @@ class ServingFleet:
                 fid = self._rid2fid.pop((name, rid), None)
                 if fid is None:
                     continue
-                self.completed[fid] = eng.completed.pop(rid)
+                req = eng.completed.pop(rid)
+                self.completed[fid] = req
                 self.router.complete(fid)
+                self._emit_root_span(fid, req)
         for name, eng_state in self.router.engines.items():
             if eng_state.alive:
                 self.router.heartbeat(name)
         for record in self.router.check():
             self._redispatch(record)
+
+    def _emit_root_span(self, fid, req) -> None:
+        """Close the request's trace: the root span, arrival to
+        completion in the fleet clock domain, carrying the measured
+        TTFT — the number critical_path's decomposition must re-derive
+        from the child spans to within tolerance."""
+        arrival = self._arrival.get(fid, req.arrival_s)
+        self.emit(
+            "span",
+            name=f"req:{fid}",
+            dur_s=req.done_s - arrival,
+            start_s=arrival,
+            end_s=req.done_s,
+            ttft_s=(req.first_token_s or req.done_s) - arrival,
+            req=fid,
+            **_req_root(fid).to_fields(),
+        )
 
     def _pump_handoffs(self) -> None:
         """Run the sender/receiver state machines to quiescence: frames
@@ -322,15 +361,36 @@ class ServingFleet:
                     self.handoffs += 1
                     self.handoff_bytes += done["bytes"]
                     self.handoff_s_sum += done["handoff_s"]
+                    fid = done["meta"]["fid"]
+                    # Handoff counter in the span name parts: a fid
+                    # re-handed-off after a kill gets a distinct span id
+                    # per attempt, deterministically.
+                    hctx = _req_root(fid).child(
+                        "handoff", p, d, self.handoffs
+                    )
+                    end = self._time()
                     self.emit(
                         "kv_handoff",
-                        req=done["meta"]["fid"],
+                        req=fid,
                         blocks=done["blocks"],
                         bytes=done["bytes"],
                         attempts=done["attempts"],
                         handoff_s=done["handoff_s"],
                         src=p,
                         dst=d,
+                        trace=hctx.trace_id,
+                        span=hctx.span_id,
+                    )
+                    self.emit(
+                        "span",
+                        name=f"handoff:{fid}",
+                        dur_s=done["handoff_s"],
+                        start_s=end - done["handoff_s"],
+                        end_s=end,
+                        req=fid,
+                        src=p,
+                        dst=d,
+                        **hctx.to_fields(),
                     )
                     progress = True
             if not progress:
@@ -529,6 +589,12 @@ def fleet_worker(process_id: int, cfg_json: str) -> None:
         EventLog,
         events_path,
     )
+    from distributeddataparallel_tpu.observability.httpmetrics import (
+        MetricsHTTPServer,
+    )
+    from distributeddataparallel_tpu.observability.registry import (
+        MetricsRegistry,
+    )
     from distributeddataparallel_tpu.runtime.rendezvous import retry_call
 
     P = cfg["prefill"]
@@ -563,8 +629,15 @@ def fleet_worker(process_id: int, cfg_json: str) -> None:
             events_path(cfg["events_dir"], process_id), process_id
         )
         events.emit("run_start", argv=[name], role="serve")
+    # Live pull-based metrics: every worker serves its registry on a
+    # loopback /metrics endpoint; the port rides the hello message so
+    # the parent (and ddp_monitor --scrape) can poll it mid-run.
+    registry = MetricsRegistry()
+    registry.gauge("serve_tok_s").set(0.0)
+    metrics_srv = MetricsHTTPServer(registry)
     engine = InferenceEngine(
-        model, params, ecfg, events=events, time_fn=time.time
+        model, params, ecfg, events=events, registry=registry,
+        time_fn=time.time, name=name,
     )
 
     listener = None
@@ -587,6 +660,7 @@ def fleet_worker(process_id: int, cfg_json: str) -> None:
     _send_line(psock, {
         "op": "hello", "name": name, "tier": tier,
         "handoff_addr": handoff_addr,
+        "metrics_addr": metrics_srv.address,
     })
     parent = _LineReader(psock)
 
@@ -597,6 +671,9 @@ def fleet_worker(process_id: int, cfg_json: str) -> None:
     hb_s = cfg.get("heartbeat_s", 0.25)
     last_beat = 0.0
     running = True
+    handoffs_out = 0
+    tokens_done = 0
+    t_start = time.time()  # ddplint: allow[wallclock]
 
     def _fail_handoff(fid) -> None:
         try:
@@ -612,6 +689,7 @@ def fleet_worker(process_id: int, cfg_json: str) -> None:
                         msg["prompt"], 1,
                         arrival_s=msg["arrival_s"],
                         session=msg.get("session"),
+                        trace=msg.get("trace"),
                     )
                     pending_handoff[rid] = msg
                 else:
@@ -619,6 +697,7 @@ def fleet_worker(process_id: int, cfg_json: str) -> None:
                         msg["prompt"], msg["max_new_tokens"],
                         arrival_s=msg["arrival_s"],
                         session=msg.get("session"),
+                        trace=msg.get("trace"),
                     )
                     rid2fid[rid] = msg["fid"]
             elif msg["op"] == "shutdown":
@@ -646,18 +725,37 @@ def fleet_worker(process_id: int, cfg_json: str) -> None:
         for target, snd in list(senders.items()):
             try:
                 for done in snd.poll():
+                    fid = done["meta"]["fid"]
+                    handoffs_out += 1
+                    hctx = _req_root(fid).child(
+                        "handoff", name, target, handoffs_out
+                    )
+                    end = time.time()  # ddplint: allow[wallclock]
                     engine.emit(
                         "kv_handoff",
-                        req=done["meta"]["fid"],
+                        req=fid,
                         blocks=done["blocks"],
                         bytes=done["bytes"],
                         attempts=done["attempts"],
                         handoff_s=done["handoff_s"],
                         dst=target,
+                        trace=hctx.trace_id,
+                        span=hctx.span_id,
+                    )
+                    engine.emit(
+                        "span",
+                        name=f"handoff:{fid}",
+                        dur_s=done["handoff_s"],
+                        start_s=end - done["handoff_s"],
+                        end_s=end,
+                        req=fid,
+                        src=name,
+                        dst=target,
+                        **hctx.to_fields(),
                     )
                     _send_line(psock, {
                         "op": "handoff_done",
-                        "fid": done["meta"]["fid"],
+                        "fid": fid,
                         "bytes": done["bytes"],
                     })
             except (ConnectionError, OSError):
@@ -693,6 +791,12 @@ def fleet_worker(process_id: int, cfg_json: str) -> None:
                 fid = rid2fid.pop(rid, None)
                 if fid is None:
                     continue
+                tokens_done += len(req.generated)
+                # ddplint: allow[wallclock] — live throughput gauge for
+                # the /metrics scrape; this worker runs on time.time
+                registry.gauge("serve_tok_s").set(
+                    tokens_done / max(time.time() - t_start, 1e-9)
+                )
                 _send_line(psock, {
                     "op": "done",
                     "fid": fid,
@@ -729,6 +833,7 @@ def fleet_worker(process_id: int, cfg_json: str) -> None:
         # wide completion records.
         events.emit("run_end", status="ok")
         events.close()
+    metrics_srv.close()
     psock.close()
 
 
@@ -774,12 +879,22 @@ class FleetService:
         self.handoffs = 0
         self.kills = 0
         self.requeued = 0
+        #: Mid-run /metrics pulls, one per live endpoint (workers +
+        #: this router process): name -> parsed series dict.  The fleet
+        #: smoke asserts the required series are present and parseable.
+        self.metrics_scrape: dict[str, dict] = {}
 
     def run(self, trace: list[dict]) -> dict:
         from distributeddataparallel_tpu.observability.events import (
             EventLog,
             events_path,
             merge_timeline,
+        )
+        from distributeddataparallel_tpu.observability.httpmetrics import (
+            MetricsHTTPServer,
+        )
+        from distributeddataparallel_tpu.observability.registry import (
+            MetricsRegistry,
         )
         from distributeddataparallel_tpu.runtime.launcher import spawn
 
@@ -808,6 +923,16 @@ class FleetService:
             heartbeat_timeout_s=self.heartbeat_timeout_s,
             events=events,
         )
+        # The router process's own /metrics endpoint: live queue depth
+        # plus running per-tier TTFT quantile gauges (initialized to 0
+        # so the series EXIST before the first completion — a scrape's
+        # required-series check must not race the first done message).
+        registry = MetricsRegistry()
+        registry.bind("router_queue_depth", lambda: router.queue_depth)
+        for tier in ("prefill", "decode"):
+            for q in ("p50", "p99"):
+                registry.gauge(f"fleet_{tier}_{q}_ttft_s").set(0.0)
+        self.metrics_server = MetricsHTTPServer(registry)
         cfg_json = json.dumps({
             "parent_addr": list(server.getsockname()),
             "prefill": fc.prefill,
@@ -824,8 +949,11 @@ class FleetService:
             env=dict(_WORKER_ENV),
         )
         try:
-            return self._drive(trace, router, server, procs, events)
+            return self._drive(
+                trace, router, server, procs, events, registry
+            )
         finally:
+            self.metrics_server.close()
             server.close()
             # Graceful first (workers flush tier_summary/run_end to
             # their event files on shutdown), then force the rest.
@@ -841,14 +969,17 @@ class FleetService:
                 merge_timeline(self.events_dir)
 
     # -- internals ----------------------------------------------------
-    def _drive(self, trace, router, server, procs, events) -> dict:
+    def _drive(self, trace, router, server, procs, events,
+               registry) -> dict:
         conns: dict[str, _LineReader] = {}
         proc_of: dict[str, int] = {}
         handoff_addrs: dict[str, list] = {}
+        metrics_addrs: dict[str, str] = {}
         pending: dict[int, dict] = {}
         arrival_abs: dict[int, float] = {}
         completed: dict[int, dict] = {}
         dropped: set[int] = set()
+        tier_ttft: dict[str, list[float]] = {"prefill": [], "decode": []}
         fc = self.fleet_config
 
         # Handshake: every worker dials in and names itself.  The
@@ -878,6 +1009,8 @@ class FleetService:
                         router.register_engine(name, msg["tier"])
                         if msg.get("handoff_addr"):
                             handoff_addrs[name] = msg["handoff_addr"]
+                        if msg.get("metrics_addr"):
+                            metrics_addrs[name] = msg["metrics_addr"]
                         # launcher spawned tiers in process_id order:
                         # prefill-i -> i, decode-i -> prefill + i.
                         idx = (
@@ -904,7 +1037,10 @@ class FleetService:
 
         def send_request(fid, prompt, max_new, session) -> None:
             try:
-                record = router.route(fid, prompt, max_new, session=session)
+                record = router.route(
+                    fid, prompt, max_new, session=session,
+                    trace=_req_root(fid).to_fields(),
+                )
             except RouterError:
                 dropped.add(fid)
                 pending.pop(fid, None)
@@ -915,6 +1051,7 @@ class FleetService:
                 "op": "submit", "fid": fid, "prompt": record["prompt"],
                 "max_new_tokens": max_new, "session": session,
                 "arrival_s": arrival_abs[fid],
+                "trace": record["trace"],
             }
             if record["prefill"]:
                 msg["handoff_to"] = record["decode"]
@@ -973,6 +1110,38 @@ class FleetService:
                             pending.pop(fid, None)
                             # ddplint: allow[wallclock]
                             last_progress = time.monotonic()
+                            tier = (
+                                "prefill" if msg.get("handoff")
+                                else "decode"
+                            )
+                            tier_ttft[tier].append(msg["ttft_s"])
+                            for q in (50, 99):
+                                registry.gauge(
+                                    f"fleet_{tier}_p{q}_ttft_s"
+                                ).set(_pct(tier_ttft[tier], q))
+                            if events is not None:
+                                # Root span: the workers' serve/prefill
+                                # spans all parent on this (same fid-
+                                # derived context on every process).
+                                start = arrival_abs[fid]
+                                events.emit(
+                                    "span",
+                                    name=f"req:{fid}",
+                                    dur_s=msg["latency_s"],
+                                    start_s=start,
+                                    end_s=start + msg["latency_s"],
+                                    ttft_s=msg["ttft_s"],
+                                    req=fid,
+                                    **_req_root(fid).to_fields(),
+                                )
+                            if not self.metrics_scrape:
+                                # First completion: the fleet is warm —
+                                # pull every live /metrics endpoint
+                                # exactly once, mid-run by construction
+                                # (requests are still outstanding).
+                                self._scrape_fleet(
+                                    router, metrics_addrs
+                                )
                     elif op == "handoff_done":
                         self.handoffs += 1
                         # ddplint: allow[wallclock]
@@ -1002,6 +1171,22 @@ class FleetService:
         elapsed = time.time() - t0  # ddplint: allow[wallclock]
         return self._summary(completed, dropped, elapsed, events, trace)
 
+    def _scrape_fleet(self, router, metrics_addrs: dict) -> None:
+        """Pull every live endpoint's /metrics once (workers + this
+        router process).  Parse failures are recorded, not raised — the
+        smoke turns them into assertions with the run's context."""
+        targets = {"router": self.metrics_server.address}
+        for name, addr in metrics_addrs.items():
+            if router.engines[name].alive:
+                targets[name] = addr
+        for name, addr in targets.items():
+            try:
+                self.metrics_scrape[name] = scrape_metrics(
+                    addr, timeout=2.0
+                )
+            except (OSError, ValueError) as exc:
+                self.metrics_scrape[name] = {"_error": str(exc)}
+
     def _summary(self, completed, dropped, elapsed, events, trace) -> dict:
         recs = list(completed.values())
         out = {
@@ -1012,6 +1197,7 @@ class FleetService:
             "requeued": self.requeued,
             "kills": self.kills,
             "elapsed_s": elapsed,
+            "metrics_scrape": self.metrics_scrape,
         }
         if recs:
             tokens = sum(r["tokens"] for r in recs)

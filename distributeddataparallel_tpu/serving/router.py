@@ -143,11 +143,16 @@ class Router:
         return root_block_hash(prompt, self.block_size)
 
     def route(
-        self, fid, prompt, max_new_tokens: int, *, session=None
+        self, fid, prompt, max_new_tokens: int, *, session=None,
+        trace: dict | None = None,
     ) -> dict:
         """Decide owners for one request; returns the route record
         (``prefill`` is None on an affinity hit — the home decode
-        engine serves the whole request from its prefix cache)."""
+        engine serves the whole request from its prefix cache).
+
+        ``trace`` is the request's root span-context fields — carried
+        on the route record (so drain/requeue keeps the trace) and
+        stamped onto the ``route_admit`` event as plain data."""
         key = self.affinity_key(prompt)
         home = self._affinity.get(key)
         affinity = home is not None and self.engines[home].alive
@@ -164,6 +169,7 @@ class Router:
             "decode": decode,
             "prefill": prefill,
             "tokens": len(prompt) + int(max_new_tokens),
+            "trace": trace,
         }
         owner = prefill or decode
         eng = self.engines[owner]
@@ -172,6 +178,12 @@ class Router:
         self.routed += 1
         if affinity:
             self.affinity_hits += 1
+        # Membership annotation (trace + root span, no parent edge):
+        # the admission decision belongs to the request's root span.
+        tfields = {
+            k: trace[k] for k in ("trace", "span")
+            if isinstance(trace, dict) and trace.get(k)
+        }
         self.emit(
             "route_admit",
             req=fid,
@@ -180,6 +192,7 @@ class Router:
             affinity=affinity,
             session=session,
             queue_depth=self.queue_depth,
+            **tfields,
         )
         return record
 

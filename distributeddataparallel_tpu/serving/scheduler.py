@@ -68,6 +68,11 @@ class Request:
     #: True when this request's context KV arrived via a prefill→decode
     #: handoff instead of local prefill.
     handoff: bool = False
+    #: Trace-context envelope fields as plain data ({"trace", "span",
+    #: "parent"}, see observability/tracecontext) — rides the request
+    #: across router / handoff / process boundaries; None when the
+    #: caller doesn't trace.
+    trace: dict | None = None
 
     def __post_init__(self):
         self.prompt = np.asarray(self.prompt, np.int32).reshape(-1)

@@ -11,6 +11,15 @@ Usage (static, no JSONL files — cross-check emitters vs the registry):
 Usage (protocol conformance — replay a timeline against the specs):
     python scripts/check_events.py --conformance EVENTS_DIR_OR_FILE
 
+Usage (span lineage — trace-context integrity over a timeline):
+    python scripts/check_events.py --lineage EVENTS_DIR_OR_FILE
+
+``--lineage`` rebuilds the schema-v2 span trees
+(``observability.critical_path.check_lineage``) and fails on any
+orphan span (parent id never emitted — a process died without its
+parent record, or a propagation bug dropped the context), on traces
+with zero or multiple roots, and on parent edges that cross traces.
+
 ``--conformance`` replays each input (a merged ``timeline.jsonl`` or an
 events *directory*, merged on the fly) against the protocol specs in
 ``analysis.protocol`` via ``analysis.conformance.check_timeline`` —
@@ -123,12 +132,20 @@ def main(argv: list[str] | None = None) -> int:
         help="replay each input (timeline file or events dir) against "
         "the protocol specs (analysis.conformance, PL405)",
     )
+    ap.add_argument(
+        "--lineage",
+        action="store_true",
+        help="check span-tree lineage in each input (timeline file or "
+        "events dir): every span's parent exists, exactly one root per "
+        "trace, no cross-trace parent edges",
+    )
     args = ap.parse_args(argv)
     if not args.files and not args.schema_sync:
         ap.error("provide events JSONL file(s) and/or --schema-sync")
 
     problems = []
     n_conformant = 0
+    n_lineage = 0
     if args.schema_sync:
         problems.extend(check_schema_sync())
     for path in args.files:
@@ -136,10 +153,10 @@ def main(argv: list[str] | None = None) -> int:
             problems.append(f"{path}: no such file")
             continue
         if os.path.isdir(path):
-            if not args.conformance:
+            if not (args.conformance or args.lineage):
                 problems.append(
-                    f"{path}: is a directory (only --conformance "
-                    "accepts events directories)"
+                    f"{path}: is a directory (only --conformance/"
+                    "--lineage accept events directories)"
                 )
                 continue
         else:
@@ -158,6 +175,18 @@ def main(argv: list[str] | None = None) -> int:
             problems.extend(str(f) for f in found)
             if not found:
                 n_conformant += 1
+        if args.lineage:
+            from distributeddataparallel_tpu.analysis.conformance import (
+                load_records,
+            )
+            from distributeddataparallel_tpu.observability.critical_path import (
+                check_lineage,
+            )
+
+            found = check_lineage(load_records(path))
+            problems.extend(f"{path}: lineage: {p}" for p in found)
+            if not found:
+                n_lineage += 1
     for p in problems:
         print(p, file=sys.stderr)
     if not problems:
@@ -172,6 +201,10 @@ def main(argv: list[str] | None = None) -> int:
         if args.conformance:
             parts.append(
                 f"protocol conformance OK ({n_conformant} timeline(s))"
+            )
+        if args.lineage:
+            parts.append(
+                f"span lineage OK ({n_lineage} timeline(s))"
             )
         print("check_events: " + "; ".join(parts))
     return 1 if problems else 0

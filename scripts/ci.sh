@@ -138,6 +138,8 @@ python scripts/ddp_serve.py --fleet 1:2 --smoke \
     --events-dir "${FLEET_SMOKE_DIR}"
 echo "== check_events --conformance (fleet smoke timeline) =="
 python scripts/check_events.py --conformance "${FLEET_SMOKE_DIR}"
+echo "== check_events --lineage (span trees across process boundaries) =="
+python scripts/check_events.py --lineage "${FLEET_SMOKE_DIR}"
 rm -rf "${FLEET_SMOKE_DIR}"
 
 echo "== elastic shrink smoke (4 -> 3) =="

@@ -6,6 +6,18 @@ Usage:
     python scripts/ddp_monitor.py EVENTS_DIR --follow   # live tail
     python scripts/ddp_monitor.py EVENTS_DIR --follow --interval 0.5
 
+Usage (scrape mode — no filesystem access to the run needed):
+    python scripts/ddp_monitor.py --scrape H1:P1,H2:P2 [--follow]
+
+``--scrape`` is the pull-based counterpart for the serving fleet: each
+fleet process exposes a live ``/metrics`` endpoint
+(``observability.httpmetrics``; the router prints its address, workers
+advertise theirs in the hello message), and the monitor polls the
+comma-separated endpoints and renders one row per process —
+``serve_tok_s`` on engines, ``router_queue_depth`` and the per-tier
+TTFT gauges on the router.  A dead endpoint is a ``DOWN`` row, not a
+crash; exit 1 only when every endpoint is down.
+
 One-shot mode prints a per-rank table (last step, last step time, last
 MFU, seconds since the rank last wrote, nan-skips, status) plus every
 fired alert, then exits **2 if any alert fired**, 0 when healthy, 1
@@ -183,6 +195,77 @@ def _fmt_alert(rec: dict) -> str:
             f"threshold {rec.get('threshold')}")
 
 
+#: series promoted to columns in the scrape table (everything else is
+#: rolled up into a "+N more" count per endpoint)
+_SCRAPE_COLUMNS = (
+    "serve_tok_s",
+    "router_queue_depth",
+    "fleet_prefill_p50_ttft_s",
+    "fleet_prefill_p99_ttft_s",
+    "fleet_decode_p50_ttft_s",
+    "fleet_decode_p99_ttft_s",
+)
+
+
+def scrape_table(targets: list[str]) -> tuple[str, int]:
+    """Poll every ``host:port`` /metrics endpoint once; returns the
+    rendered table and the number of endpoints that answered."""
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    from distributeddataparallel_tpu.observability.httpmetrics import (
+        scrape,
+    )
+
+    lines = []
+    up = 0
+    for addr in targets:
+        try:
+            series = scrape(addr)
+        except (OSError, ValueError) as exc:
+            lines.append(f"{addr:<22}  DOWN ({exc})")
+            continue
+        up += 1
+        cells = [
+            f"{name}={series[name]:g}"
+            for name in _SCRAPE_COLUMNS if name in series
+        ]
+        extra = len(series) - len(cells)
+        if extra > 0:
+            cells.append(f"+{extra} more")
+        lines.append(
+            f"{addr:<22}  " + ("  ".join(cells) if cells else "(empty)")
+        )
+    return "\n".join(lines), up
+
+
+def _run_scrape(args) -> int:
+    targets = [t.strip() for t in args.scrape.split(",") if t.strip()]
+    if not targets:
+        print("ddp_monitor: --scrape needs host:port[,host:port...]",
+              file=sys.stderr)
+        return 1
+    if not args.follow:
+        table, up = scrape_table(targets)
+        print(table)
+        return 0 if up else 1
+    t_end = (time.time() + args.max_seconds
+             if args.max_seconds is not None else None)
+    up_ever = 0
+    try:
+        while True:
+            table, up = scrape_table(targets)
+            up_ever = max(up_ever, up)
+            print(table)
+            print("---")
+            if t_end is not None and time.time() >= t_end:
+                break
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        pass
+    return 0 if up_ever else 1
+
+
 def _tails(events_dir: str, known: dict[str, _Tail]) -> list[_Tail]:
     for path in sorted(glob.glob(os.path.join(events_dir, "events-*.jsonl"))):
         if path not in known:
@@ -192,7 +275,9 @@ def _tails(events_dir: str, known: dict[str, _Tail]) -> list[_Tail]:
 
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("events_dir", help="directory holding events-*.jsonl")
+    ap.add_argument("events_dir", nargs="?", default=None,
+                    help="directory holding events-*.jsonl (omit with "
+                         "--scrape)")
     ap.add_argument("--follow", action="store_true",
                     help="keep tailing (one-shot status is the default)")
     ap.add_argument("--interval", type=float, default=2.0,
@@ -200,8 +285,15 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--max-seconds", type=float, default=None,
                     help="stop following after this long (for scripting "
                          "and tests; default: until interrupted)")
+    ap.add_argument("--scrape", default=None, metavar="HOST:PORT,...",
+                    help="poll live /metrics endpoints instead of "
+                         "tailing event files")
     args = ap.parse_args(argv)
 
+    if args.scrape is not None:
+        return _run_scrape(args)
+    if args.events_dir is None:
+        ap.error("provide an events directory (or --scrape endpoints)")
     if not os.path.isdir(args.events_dir):
         print(f"ddp_monitor: no such directory: {args.events_dir}",
               file=sys.stderr)

@@ -40,6 +40,12 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from distributeddataparallel_tpu.analysis.conformance import (  # noqa: E402
     check_timeline,
 )
+from distributeddataparallel_tpu.observability.critical_path import (  # noqa: E402
+    check_lineage,
+    request_decompositions,
+    tier_rollups,
+    ttft_rollup,
+)
 from distributeddataparallel_tpu.observability.events import (  # noqa: E402
     load_timeline,
 )
@@ -91,6 +97,7 @@ def analyze(records: list[dict]) -> dict:
         "run_summary": None,
         "serving": None,
         "fleet": None,
+        "ttft_decomposition": None,
         "tuning": None,
     }
     if worker_procs:
@@ -415,6 +422,17 @@ def analyze(records: list[dict]) -> dict:
                     max(0.0, mean_restart - d["seconds"])
                     for d in el["downtimes"]
                 ), 3)
+
+    # TTFT decomposition: rebuild the schema-v2 span trees and account
+    # for every completed request's first-token latency (queue wait /
+    # prefill / handoff / decode), with the gateable share headlines
+    # and the lineage problems (orphan spans, multi-root traces).
+    decomps = request_decompositions(records)
+    if decomps:
+        roll = ttft_rollup(decomps)
+        roll["tiers"] = tier_rollups(decomps)
+        roll["lineage_problems"] = check_lineage(records)
+        out["ttft_decomposition"] = roll
 
     # Protocol conformance: replay the whole timeline against the
     # declared state machines (analysis.protocol) — PL405 per violation.
@@ -887,6 +905,44 @@ def render_markdown(a: dict, events_dir: str) -> str:
                 f"{v.get('requeued', 0)} requeued, "
                 f"{v.get('reason')}) |"
             )
+        lines.append("")
+
+    # -- TTFT decomposition -------------------------------------------
+    td = a["ttft_decomposition"]
+    if td is not None:
+        lines += ["## TTFT decomposition", ""]
+        err = td.get("ttft_decomp_err_frac")
+        lines += [
+            f"**{td['requests']} traced request(s)** — span-tree "
+            "accounting of each first-token latency "
+            f"(worst self-consistency error "
+            f"{'-' if err is None else f'{err:.1%}'}; gate ≤ 5%).",
+            "",
+            "| segment | share of TTFT | p50 | p99 |",
+            "|---|---:|---:|---:|",
+        ]
+        for seg in ("queue", "prefill", "handoff", "decode"):
+            share = td.get(f"ttft_{seg}_share_frac")
+            p50 = td.get(f"{seg}_p50_s")
+            p99 = td.get(f"{seg}_p99_s")
+            lines.append(
+                f"| {seg} | {'-' if share is None else f'{share:.1%}'} | "
+                f"{'-' if p50 is None else f'{p50 * 1e3:.1f} ms'} | "
+                f"{'-' if p99 is None else f'{p99 * 1e3:.1f} ms'} |"
+            )
+        for tier, roll in sorted((td.get("tiers") or {}).items()):
+            if not roll.get("requests"):
+                continue
+            q = roll.get("ttft_queue_share_frac")
+            lines.append(
+                f"| {tier}-tier rollup | {roll['requests']} request(s), "
+                f"queue share {'-' if q is None else f'{q:.1%}'} | | |"
+            )
+        problems = td.get("lineage_problems") or []
+        if problems:
+            lines += [""] + [
+                f"- **lineage problem**: {p}" for p in problems[:5]
+            ]
         lines.append("")
 
     # -- Tuning -------------------------------------------------------

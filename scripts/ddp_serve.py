@@ -120,8 +120,12 @@ def _run_fleet(args) -> int:
     through the router, and — under ``--smoke`` — assert the fleet
     contract: every request completes (zero dropped, even through an
     injected engine kill), at least one KV handoff crossed tiers, at
-    least one follow-up was affinity-routed, and the merged timeline
-    stays schema- and trace-valid."""
+    least one follow-up was affinity-routed, the merged timeline stays
+    schema- and trace-valid, every span tree is lineage-clean across
+    the three process boundaries (router, prefill, decode), every
+    completed request's TTFT decomposition reproduces its measured
+    TTFT within 5%, and the mid-run /metrics scrape of every live
+    process parsed and carried the required series."""
     from distributeddataparallel_tpu.models.transformer import (
         gpt2_124m,
         tiny_lm,
@@ -253,14 +257,85 @@ def _run_fleet(args) -> int:
             failures.append(
                 "fleet smoke: no affinity-routed follow-up turn"
             )
+        # Distributed tracing: span trees must survive three process
+        # boundaries (router -> prefill -> decode) with zero orphans,
+        # and each request's critical-path decomposition must account
+        # for its measured TTFT.
+        from distributeddataparallel_tpu.observability.critical_path import (
+            check_lineage,
+            request_decompositions,
+            ttft_rollup,
+        )
+
+        failures.extend(
+            f"fleet smoke: {p}" for p in check_lineage(records)[:5]
+        )
+        decomps = request_decompositions(records)
+        if len(decomps) < out["completed"]:
+            failures.append(
+                f"fleet smoke: TTFT decomposition covers only "
+                f"{len(decomps)}/{out['completed']} completed requests"
+            )
+        bad = [d for d in decomps if d["err_frac"] > 0.05]
+        if bad:
+            failures.append(
+                f"fleet smoke: {len(bad)} request(s) decompose to "
+                "more than 5% off their measured TTFT (worst "
+                f"{max(d['err_frac'] for d in bad):.1%}, "
+                f"req {max(bad, key=lambda d: d['err_frac'])['req']})"
+            )
+        out["ttft_decomp"] = ttft_rollup(decomps)
+    # Live /metrics plane: the service scraped every live endpoint
+    # mid-run (at the first completion, while requests were still
+    # outstanding); each payload must have parsed and carried the
+    # series the monitor renders.
+    scraped = out.get("metrics_scrape") or {}
+    router_series = scraped.get("router")
+    if not isinstance(router_series, dict) or "_error" in router_series:
+        failures.append(
+            "fleet smoke: router /metrics scrape failed "
+            f"({(router_series or {}).get('_error', 'never scraped')})"
+        )
+    else:
+        for name in ("router_queue_depth",
+                     "fleet_prefill_p50_ttft_s",
+                     "fleet_prefill_p99_ttft_s",
+                     "fleet_decode_p50_ttft_s",
+                     "fleet_decode_p99_ttft_s"):
+            if name not in router_series:
+                failures.append(
+                    f"fleet smoke: router /metrics missing {name}"
+                )
+    workers = {k: v for k, v in scraped.items() if k != "router"}
+    if not workers:
+        failures.append("fleet smoke: no engine /metrics endpoint scraped")
+    for wname, series in sorted(workers.items()):
+        if not isinstance(series, dict) or "_error" in series:
+            failures.append(
+                f"fleet smoke: engine {wname} /metrics scrape failed "
+                f"({(series or {}).get('_error', 'bad payload')})"
+            )
+        elif "serve_tok_s" not in series:
+            failures.append(
+                f"fleet smoke: engine {wname} /metrics missing "
+                "serve_tok_s"
+            )
     if failures:
         print("SMOKE FAIL:\n  " + "\n  ".join(failures), file=sys.stderr)
         return 1
+    roll = out.get("ttft_decomp") or {}
+    decomp_note = (
+        f", ttft queue_share={roll['ttft_queue_share_frac']:.2f} "
+        f"decomp_err={roll['ttft_decomp_err_frac']:.3f} "
+        f"over {roll['requests']} traced request(s)"
+        if roll.get("requests") else ""
+    )
     print("fleet smoke OK: "
           f"{out['completed']}/{len(trace)} requests, "
           f"{out['handoffs']} handoffs, {out['requeued']} requeued "
           f"through {out['kills']} kill(s), "
-          f"p99_ttft={out.get('serve_p99_ttft_s', 0):.3f}s")
+          f"p99_ttft={out.get('serve_p99_ttft_s', 0):.3f}s"
+          f"{decomp_note}")
     return 0
 
 
@@ -396,6 +471,15 @@ def main(argv=None) -> int:
                            "request_done"):
                 if needed not in kinds:
                     failures.append(f"smoke: no {needed} event")
+            # Standalone engine derives its own root span per request;
+            # the resulting trees must still be lineage-clean.
+            from distributeddataparallel_tpu.observability.critical_path import (  # noqa: E501
+                check_lineage,
+            )
+
+            failures.extend(
+                f"smoke: {p}" for p in check_lineage(records)[:5]
+            )
 
         # Phase 2: the serving fast path — prefix cache + speculative
         # decoding on a shared-prefix Zipf trace.  Gates that the radix

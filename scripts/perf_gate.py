@@ -59,14 +59,22 @@ REGRESS_EXIT = 3
 #:    and gate backwards; the win-shares gain_frac (autotune speedup),
 #:    _hit_frac (prefix-cache hit rate), _avoided_frac (prefill FLOPs
 #:    skipped) and _speedup would be shadowed by row 3's ``_frac$``.
-#: 2. "hard-zero" loss counters — the serving fleet's
+#: 2. "lower" TTFT-decomposition shares, pinned EXPLICITLY.  The
+#:    tracing rollup's ``ttft_*_share_frac`` (queue/handoff seconds as
+#:    a share of total TTFT) and ``ttft_decomp_err_frac`` (span-tree
+#:    self-consistency error) are lower-better; today row 4's broad
+#:    ``_frac$`` would catch them, but these gate the fleet smoke, and
+#:    their direction must not silently flip if someone later widens
+#:    row 1 with another ``..._frac`` win suffix (the ``gain_frac``
+#:    shape is one keystroke away from ``share_frac``).
+#: 3. "hard-zero" loss counters — the serving fleet's
 #:    ``dropped_req_total`` shape (requests lost through an engine kill
 #:    instead of drained-and-requeued).  A nonzero value fails the gate
 #:    even when the baseline was just as bad: "no worse than a lossy
 #:    baseline" is not a pass.  ``--allow-drops`` downgrades these to
-#:    ordinary lower-better.  Must precede row 3, whose ``dropped``
+#:    ordinary lower-better.  Must precede row 4, whose ``dropped``
 #:    would claim them as merely lower-better.
-#: 3. "lower" cost/waste names: time (step_s, _s/_us/_ms, latency),
+#: 4. "lower" cost/waste names: time (step_s, _s/_us/_ms, latency),
 #:    space (bytes), idle/waste shares (bubble, overhead, skew,
 #:    _frac/_fraction), and failure-adjacent counts (restart, dropped).
 #:
@@ -76,6 +84,7 @@ REGRESS_EXIT = 3
 _DIRECTION_TABLE: tuple[tuple[re.Pattern, str], ...] = (
     (re.compile(r"(tok_s|img_s|_per_s|reclaimed_s|gain_frac|_hit_frac"
                 r"|_avoided_frac|_speedup)$"), "higher"),
+    (re.compile(r"(_share_frac|_decomp_err_frac)$"), "lower"),
     (re.compile(r"dropped(_[a-z0-9]+)*_total$"), "hard-zero"),
     (re.compile(r"(bubble|step_s|_s$|bytes|overhead|_us$|_ms$|restart"
                 r"|latency|skew|dropped|_frac$|_fraction$)"), "lower"),

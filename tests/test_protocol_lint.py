@@ -522,6 +522,9 @@ BENCH_HEADLINE_DIRECTIONS = {
     "dropped_req_total": "hard-zero",
     "tuned_step_s": "lower",
     "tune_gain_frac": "higher",
+    "ttft_queue_share_frac": "lower",
+    "ttft_handoff_share_frac": "lower",
+    "ttft_decomp_err_frac": "lower",
 }
 
 
@@ -531,12 +534,17 @@ def test_bench_headline_directions_exhaustive():
 
 
 def test_direction_table_order_carries_semantics():
-    # row 1 (win suffixes) must beat row 3's broad cost patterns:
+    # row 1 (win suffixes) must beat row 4's broad cost patterns:
     # "step_speedup" CONTAINS "step_s", "_hit_frac" ends in "_frac",
     # "reclaimed_s" ends in "_s" and sits next to "restart"
     assert perf_gate._bench_direction("step_speedup") == "higher"
     assert perf_gate._bench_direction("restart_reclaimed_s") == "higher"
-    # row 2 (hard-zero) must beat row 3's plain "dropped"
+    # row 2 pins the TTFT-decomposition shares lower-better explicitly
+    # — even a future "..._share_frac"-shaped win suffix in row 1 must
+    # not flip them (and decomp error is never a win)
+    assert perf_gate._bench_direction("ttft_queue_share_frac") == "lower"
+    assert perf_gate._bench_direction("ttft_decomp_err_frac") == "lower"
+    # row 3 (hard-zero) must beat row 4's plain "dropped"
     assert perf_gate._bench_direction("dropped_req_total") == "hard-zero"
     assert perf_gate._bench_direction("dropped_frames") == "lower"
     # unmatched names default higher

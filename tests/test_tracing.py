@@ -1,0 +1,438 @@
+"""Fleet-wide distributed tracing tests: context, spans, decomposition.
+
+The load-bearing contracts:
+
+- trace/span ids are **derived, never drawn** — same name parts, same
+  ids — so a ``VirtualClock`` replay of the same (seed, config) fleet
+  run emits byte-identical trace ids (the property that makes traces
+  diffable across replays);
+- every completed request's span tree decomposes its measured TTFT
+  into queue/prefill/handoff/decode within 5% (``err_frac``), even
+  with an engine killed mid-run — a disconnected tree shows up as
+  queue time leaking into the error, not as a silent gap;
+- ``check_lineage`` is structural: exactly one root per trace, every
+  parent edge lands in the same trace, orphans and cross-trace edges
+  produce distinct diagnostics;
+- schema v2 admits the trace fields (hex-shape-checked) and still
+  validates v1 records without them;
+- the Perfetto export stitches one flow per multi-span trace and
+  ``validate_trace`` catches a dangling flow id;
+- the rendezvous RPC transport echoes trace fields in replies without
+  them ever reaching store-method dispatch;
+- the /metrics plane round-trips: registry → Prometheus text → scrape
+  → parsed floats, and malformed payloads raise instead of zero-fill.
+"""
+
+import json
+import os
+import socket
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+sys.path.insert(0, "/root/repo")
+
+from distributeddataparallel_tpu.models import TransformerLM, tiny_lm
+from distributeddataparallel_tpu.observability import (
+    MetricsHTTPServer,
+    MetricsRegistry,
+    check_lineage,
+    critical_path_of,
+    parse_prometheus_text,
+    prometheus_text,
+    request_decompositions,
+    root_context,
+    scrape,
+    tier_rollups,
+    to_trace_events,
+    ttft_rollup,
+    validate_record,
+    validate_trace,
+)
+from distributeddataparallel_tpu.observability.events import (
+    EventLog,
+    read_events,
+)
+from distributeddataparallel_tpu.observability.tracecontext import (
+    SpanContext,
+    derive_span_id,
+    derive_trace_id,
+    from_fields,
+    from_traceparent,
+)
+from distributeddataparallel_tpu.serving import (
+    EngineConfig,
+    FleetConfig,
+    LoadConfig,
+    ServingFleet,
+    VirtualClock,
+    make_trace,
+    run_load,
+)
+
+
+def _model():
+    cfg = tiny_lm(
+        vocab_size=97, num_layers=2, num_heads=2, d_model=32, d_ff=64,
+        max_seq_len=64, positional="learned", norm="layernorm",
+        activation="gelu", tie_embeddings=True,
+    )
+    model = TransformerLM(cfg)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32)
+    )["params"]
+    return cfg, model, params
+
+
+def _ecfg(**over):
+    base = dict(num_slots=4, num_blocks=48, block_size=8, prefill_chunk=8)
+    base.update(over)
+    return EngineConfig(**base)
+
+
+def _drive(fleet, clock, max_steps=800):
+    steps = 0
+    while fleet.has_work():
+        fleet.step()
+        clock.tick()
+        steps += 1
+        assert steps < max_steps, "fleet failed to drain"
+
+
+# ---------------------------------------------------- context algebra
+
+
+def test_trace_ids_deterministic_and_scoped():
+    assert derive_trace_id(3, "req-0") == derive_trace_id(3, "req-0")
+    assert derive_trace_id(3, "req-0") != derive_trace_id(3, "req-1")
+    # unit separator: concatenation cannot collide across part splits
+    assert derive_trace_id("ab", "c") != derive_trace_id("a", "bc")
+    tid = derive_trace_id("x")
+    assert len(tid) == 32 and int(tid, 16) >= 0
+    # span ids are scoped to their trace: same parts, different trace
+    other = derive_trace_id("y")
+    assert derive_span_id(tid, "root") != derive_span_id(other, "root")
+    with pytest.raises(ValueError):
+        derive_trace_id()
+
+
+def test_root_and_child_contexts():
+    root = root_context("req", "f-7")
+    assert root.parent_id is None
+    child = root.child("prefill", "prefill-0", 4)
+    assert child.trace_id == root.trace_id
+    assert child.parent_id == root.span_id
+    # deterministic: re-deriving the same child gives the same id
+    assert child == root.child("prefill", "prefill-0", 4)
+    # serialization round-trips through plain fields
+    assert from_fields(child.to_fields()) == child
+    assert from_fields(root.to_fields()) == root
+    assert "parent" not in root.to_fields()
+    # W3C interop shape
+    tp = child.traceparent()
+    assert tp == f"00-{child.trace_id}-{child.span_id}-01"
+    parsed = from_traceparent(tp)
+    assert (parsed.trace_id, parsed.span_id) == \
+        (child.trace_id, child.span_id)
+
+
+def test_malformed_contexts_rejected():
+    with pytest.raises(ValueError):
+        SpanContext(trace_id="zz" * 16, span_id="ab" * 8)
+    with pytest.raises(ValueError):
+        SpanContext(trace_id="ab" * 16, span_id="ab" * 7)  # 14 hex
+    assert from_fields({"trace": "nope", "span": "ab" * 8}) is None
+    assert from_fields({"trace": "ab" * 16}) is None
+    assert from_fields(None) is None
+    assert from_traceparent("01-xx-yy-zz") is None
+
+
+# ------------------------------------------------- schema v2 envelope
+
+
+def _rec(**over):
+    base = dict(v=2, ts=1.0, seq=0, proc="w0", kind="span",
+                name="x", dur_s=0.1)
+    base.update(over)
+    return base
+
+
+def test_schema_v2_trace_fields():
+    ctx = root_context("req", "f-0").child("serve", "decode-0", 1)
+    assert validate_record(_rec(**ctx.to_fields())) == []
+    # v1 records without trace fields still validate
+    assert validate_record(_rec(v=1)) == []
+    # the Tracer's legacy nesting-scope names in span/parent (no
+    # ``trace`` field) predate v2 and must keep validating
+    assert validate_record(_rec(v=1, parent="epoch")) == []
+    assert validate_record(_rec(parent="epoch")) == []
+    # hex-shape enforcement once ``trace`` opts the record in
+    assert any(
+        "not 32-hex" in p
+        for p in validate_record(_rec(trace="abc", span="ab" * 8))
+    )
+    assert any(
+        "not 16-hex" in p
+        for p in validate_record(_rec(trace="ab" * 16, span="xyz"))
+    )
+    # a parent edge with no span of its own is meaningless
+    assert any(
+        "parent without span" in p
+        for p in validate_record(
+            _rec(trace="ab" * 16, parent="ab" * 8)
+        )
+    )
+
+
+# ---------------------------------------------- lineage + decomposition
+
+
+def _span(trace, span, name, start, end, parent=None, **extra):
+    rec = dict(
+        v=2, ts=end, seq=0, proc="w0", kind="span", name=name,
+        dur_s=end - start, start_s=start, end_s=end,
+        trace=trace, span=span,
+    )
+    if parent is not None:
+        rec["parent"] = parent
+    rec.update(extra)
+    return rec
+
+
+def _clean_tree(fid="f-0", ttft=1.0):
+    root = root_context("req", fid)
+    pre = root.child("prefill", "prefill-0", 1)
+    dec = root.child("decode", "decode-0", 1)
+    return [
+        _span(root.trace_id, root.span_id, f"req:{fid}", 0.0, 2.0,
+              ttft_s=ttft, req=fid),
+        _span(pre.trace_id, pre.span_id, f"prefill:{fid}", 0.2, 1.0,
+              parent=pre.parent_id),
+        _span(dec.trace_id, dec.span_id, f"decode:{fid}", 1.0, 2.0,
+              parent=dec.parent_id),
+    ]
+
+
+def test_check_lineage_clean_and_broken():
+    assert check_lineage(_clean_tree()) == []
+    # orphan: parent id never emitted anywhere
+    recs = _clean_tree()
+    recs[1]["parent"] = "ab" * 8
+    assert any("orphan" in p for p in check_lineage(recs))
+    # two roots in one trace
+    recs = _clean_tree()
+    del recs[1]["parent"]
+    probs = check_lineage(recs)
+    assert any("2 root spans" in p for p in probs)
+    # cross-trace edge: parent exists, but in a different trace
+    recs = _clean_tree("f-0") + _clean_tree("f-1")
+    recs[4]["parent"] = recs[0]["span"]  # f-1's prefill -> f-0's root
+    assert any("cross-trace edge" in p for p in check_lineage(recs))
+
+
+def test_decomposition_clips_merges_and_balances():
+    recs = _clean_tree(ttft=1.0)  # window [0, 1]: 0.2 q, 0.8 prefill
+    (d,) = request_decompositions(recs)
+    assert d["req"] == "f-0" and d["ttft_s"] == 1.0
+    assert d["prefill_s"] == pytest.approx(0.8)
+    assert d["queue_s"] == pytest.approx(0.2)
+    # decode span [1.0, 2.0] is entirely outside the TTFT window
+    assert d["decode_s"] == 0.0 and d["handoff_s"] == 0.0
+    assert d["err_frac"] == pytest.approx(0.0)
+    roll = ttft_rollup([d])
+    assert roll["ttft_queue_share_frac"] == pytest.approx(0.2)
+    assert roll["ttft_prefill_share_frac"] == pytest.approx(0.8)
+    assert roll["ttft_decomp_err_frac"] == pytest.approx(0.0)
+    tiers = tier_rollups([d])
+    assert tiers["decode"]["requests"] == 1  # no handoff -> decode tier
+    assert tiers["prefill"]["requests"] == 0
+    path = critical_path_of(recs, d["trace"])
+    assert [s["name"] for s in path] == \
+        ["req:f-0", "prefill:f-0", "decode:f-0"]
+
+
+# --------------------------------------------- fleet end-to-end tracing
+
+
+def _traced_fleet_run(tmp_path, tag, kill=None, n_req=6, n_new=8):
+    cfg, model, params = _model()
+    log = EventLog(str(tmp_path / f"events-{tag}.jsonl"), f"fleet-{tag}")
+    clock = VirtualClock()
+    fleet = ServingFleet(
+        model, params, _ecfg(), FleetConfig(prefill=1, decode=2),
+        time_fn=clock, events=log, check_invariants=True,
+    )
+    rng = np.random.default_rng(11)
+    fids = [
+        fleet.submit(rng.integers(1, cfg.vocab_size, 12 + i).tolist(),
+                     n_new)
+        for i in range(n_req)
+    ]
+    if kill:
+        for _ in range(3):          # get requests in flight first
+            fleet.step()
+            clock.tick()
+        fleet.kill_engine(kill)
+    _drive(fleet, clock)
+    summary = fleet.summary()   # emits tier_summary while the log is open
+    log.close()
+    return fleet, fids, summary, \
+        read_events(str(tmp_path / f"events-{tag}.jsonl"))
+
+
+def test_fleet_kill_decomposition_within_5pct(tmp_path):
+    fleet, fids, s, records = _traced_fleet_run(
+        tmp_path, "kill", kill="decode-0"
+    )
+    assert sorted(fleet.completed) == sorted(fids)
+    assert s["dropped_req_total"] == 0 and s["kills"] == 1
+    # zero orphan spans even though one engine died mid-request
+    assert check_lineage(records) == []
+    decomps = request_decompositions(records)
+    assert sorted(d["req"] for d in decomps) == sorted(fids)
+    for d in decomps:
+        # per-request: segments must re-derive the measured TTFT
+        assert d["err_frac"] <= 0.05, d
+        assert d["spans"] >= 2, d  # root + at least one engine child
+    # time lost to the killed engine surfaces as queue wait, not error
+    roll = ttft_rollup(decomps)
+    assert 0.0 <= roll["ttft_queue_share_frac"] <= 1.0
+    assert roll["ttft_decomp_err_frac"] <= 0.05
+    # handed-off requests classify into the prefill (disaggregated)
+    # tier even though handoff rides after the first token
+    tiers = tier_rollups(decomps)
+    assert tiers["prefill"]["requests"] >= 1
+    assert tiers["prefill"]["requests"] + tiers["decode"]["requests"] \
+        == len(decomps)
+
+
+def test_fleet_replay_trace_ids_byte_identical(tmp_path):
+    cfg, model, params = _model()
+    lcfg = LoadConfig(
+        rate_rps=40.0, duration_s=0.3, prompt_len=(10, 20),
+        output_len=(4, 8), vocab_size=cfg.vocab_size, seed=3,
+        turns=2, turn_gap_s=0.05,
+    )
+    trace = make_trace(lcfg)
+
+    def one_run(tag):
+        log = EventLog(str(tmp_path / f"ev-{tag}.jsonl"), f"run-{tag}")
+        clock = VirtualClock()
+        fleet = ServingFleet(
+            model, params, _ecfg(), FleetConfig(prefill=1, decode=2),
+            time_fn=clock, events=log,
+        )
+        out = run_load(fleet, trace, clock=clock)
+        log.close()
+        assert out["dropped_req_total"] == 0
+        spans = [
+            (r["trace"], r["span"], r.get("parent"), r["name"])
+            for r in read_events(str(tmp_path / f"ev-{tag}.jsonl"))
+            if r.get("kind") == "span"
+        ]
+        return sorted(spans)
+
+    spans_a, spans_b = one_run("a"), one_run("b")
+    assert spans_a and spans_a == spans_b
+    # and the trees those ids form are structurally clean
+    assert check_lineage(
+        read_events(str(tmp_path / "ev-a.jsonl"))
+    ) == []
+
+
+# --------------------------------------------------- Perfetto flows
+
+
+def test_trace_export_flow_events_and_validation():
+    recs = _clean_tree()
+    trace = to_trace_events(recs)
+    assert validate_trace(trace) == []
+    flows = [e for e in trace["traceEvents"]
+             if e.get("ph") in ("s", "t", "f")]
+    assert flows, "multi-span trace produced no flow events"
+    tid16 = recs[0]["trace"][:16]
+    assert {e["id"] for e in flows} == {tid16}
+    assert sorted(e["ph"] for e in flows) == sorted("stf")
+    # per-trace track naming: thread_name metadata carries req:<trace8>
+    names = [
+        e for e in trace["traceEvents"]
+        if e.get("ph") == "M" and e.get("name") == "thread_name"
+        and e["args"]["name"].startswith("req:")
+    ]
+    assert names and names[0]["args"]["name"] == f"req:{recs[0]['trace'][:8]}"
+    # a dangling flow (start without finish) is a validation failure
+    broken = dict(trace)
+    broken["traceEvents"] = [
+        e for e in trace["traceEvents"] if e.get("ph") != "f"
+    ]
+    assert any("dangling flow" in p for p in validate_trace(broken))
+
+
+# ----------------------------------------------- rendezvous RPC echo
+
+
+def test_rendezvous_rpc_echoes_trace_fields(tmp_path):
+    from distributeddataparallel_tpu.runtime.rendezvous import (
+        RendezvousStore,
+        TCPRendezvousClient,
+        TCPRendezvousServer,
+    )
+
+    store = RendezvousStore(str(tmp_path / "rdzv"))
+    ctx = root_context("hostgang", "gang", "w0")
+    with TCPRendezvousServer(store) as srv:
+        # the high-level client stamps every RPC with its context
+        with TCPRendezvousClient(
+            srv.address, trace=ctx.to_fields()
+        ) as c:
+            c.join("w0")
+            assert "w0" in c.alive()
+        # raw-wire check: trace fields ride the payload, are echoed in
+        # the reply, and never reach store-method dispatch as kwargs
+        host, port = srv.address.rsplit(":", 1)
+        with socket.create_connection((host, int(port)), timeout=5) as sk:
+            msg = {"op": "roster", **ctx.to_fields()}
+            sk.sendall((json.dumps(msg) + "\n").encode())
+            reply = json.loads(sk.makefile().readline())
+            assert reply["ok"] is True
+            assert reply["trace"] == ctx.trace_id
+            assert reply["span"] == ctx.span_id
+            # error replies echo them too (correlatable failures)
+            bad = {"op": "no_such_op", **ctx.to_fields()}
+            sk.sendall((json.dumps(bad) + "\n").encode())
+            reply = json.loads(sk.makefile().readline())
+            assert reply["ok"] is False
+            assert reply["trace"] == ctx.trace_id
+    assert store.roster() == ["w0"] or store.alive() == ["w0"]
+
+
+# ------------------------------------------------- /metrics plane
+
+
+def test_httpmetrics_roundtrip():
+    reg = MetricsRegistry()
+    reg.counter("serve_tok_s")  # pre-initialized gauge-style series
+    reg.gauge("router_queue_depth").set(3)
+    reg.counter("requests_total").inc(7)
+    srv = MetricsHTTPServer(reg)
+    try:
+        got = scrape(srv.address)
+    finally:
+        srv.close()
+    assert got["router_queue_depth"] == 3.0
+    assert got["requests_total"] == 7.0
+    assert "serve_tok_s" in got  # present even while still zero
+    # text rendering is the parseable subset by construction
+    assert parse_prometheus_text(prometheus_text(reg)) == got
+
+
+def test_parse_prometheus_text_rejects_malformed():
+    with pytest.raises(ValueError):
+        parse_prometheus_text("this is not a sample line\n")
+    with pytest.raises(ValueError):
+        parse_prometheus_text("name 1.0 extra\n")
+    # comments and blanks are fine
+    assert parse_prometheus_text("# TYPE x gauge\n\nx 2\n") == {"x": 2.0}
